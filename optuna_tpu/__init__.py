@@ -1,0 +1,73 @@
+"""optuna_tpu — a TPU-native hyperparameter-optimization framework.
+
+Same capabilities as Optuna (define-by-run search spaces, study/trial runtime,
+the full sampler/pruner suite, pluggable distributed storage, importance,
+visualization, artifacts, CLI) with the numeric plane rebuilt JAX-first:
+jit-compiled GP fitting and acquisition optimization, vmap-batched TPE KDE and
+CMA-ES updates, XLA/Pallas kernels for nondominated sorting and WFG
+hypervolume, and pod-scale distributed studies synchronized over ICI.
+
+Top-level re-exports mirror ``optuna/__init__.py:28-54``.
+"""
+
+from optuna_tpu import distributions, exceptions, importance, logging, pruners, samplers
+from optuna_tpu import search_space, storages, study, trial
+from optuna_tpu.exceptions import TrialPruned
+from optuna_tpu.study import (
+    Study,
+    StudyDirection,
+    StudySummary,
+    copy_study,
+    create_study,
+    delete_study,
+    get_all_study_names,
+    get_all_study_summaries,
+    load_study,
+)
+from optuna_tpu.trial import FixedTrial, FrozenTrial, Trial, TrialState, create_trial
+from optuna_tpu.version import __version__
+
+__all__ = [
+    "FixedTrial",
+    "FrozenTrial",
+    "Study",
+    "StudyDirection",
+    "StudySummary",
+    "Trial",
+    "TrialPruned",
+    "TrialState",
+    "__version__",
+    "artifacts",
+    "cli",
+    "copy_study",
+    "create_study",
+    "create_trial",
+    "delete_study",
+    "distributions",
+    "exceptions",
+    "get_all_study_names",
+    "get_all_study_summaries",
+    "importance",
+    "integration",
+    "load_study",
+    "logging",
+    "pruners",
+    "samplers",
+    "search_space",
+    "storages",
+    "study",
+    "terminator",
+    "trial",
+    "visualization",
+]
+
+
+def __getattr__(name: str):
+    # Heavy/optional subpackages load lazily (reference uses _LazyImport,
+    # ``optuna/_imports.py:111``).
+    _lazy_subpackages = {"artifacts", "cli", "integration", "terminator", "visualization"}
+    if name in _lazy_subpackages:
+        import importlib
+
+        return importlib.import_module(f"optuna_tpu.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

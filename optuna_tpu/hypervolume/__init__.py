@@ -1,0 +1,11 @@
+"""Exact hypervolume computation + subset selection.
+
+Parity target: ``optuna/_hypervolume/`` (2D O(N log N) scan and 3D O(N^2)
+cummin trick ``wfg.py:8-39``, ND WFG recursion ``wfg.py:41-107``, greedy HSSP
+``hssp.py:45,143``, box decomposition for EHVI ``box_decomposition.py``).
+"""
+
+from optuna_tpu.hypervolume.hssp import solve_hssp
+from optuna_tpu.hypervolume.wfg import compute_hypervolume
+
+__all__ = ["compute_hypervolume", "solve_hssp"]

@@ -1,0 +1,9 @@
+"""Device-side numeric kernels (XLA/Pallas).
+
+This package owns every piece of math the reference delegates to native
+backends (SURVEY.md §2.7): truncated-normal special functions (vendored
+SciPy/FreeBSD C in the reference), batched L-BFGS-B (Fortran + greenlets
+there), QMC sequences, hypervolume and nondomination kernels, CMA-ES linear
+algebra. Everything here is functionally pure, fixed-shape, and jit/vmap
+friendly.
+"""

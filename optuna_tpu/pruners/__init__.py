@@ -1,0 +1,51 @@
+"""Pruners package (reference ``optuna/pruners/__init__.py``)."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from optuna_tpu.pruners._base import BasePruner
+from optuna_tpu.pruners._median import MedianPruner
+from optuna_tpu.pruners._nop import NopPruner
+from optuna_tpu.pruners._percentile import PercentilePruner
+from optuna_tpu.trial._frozen import FrozenTrial
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+__all__ = [
+    "BasePruner",
+    "MedianPruner",
+    "NopPruner",
+    "PercentilePruner",
+    "PatientPruner",
+    "ThresholdPruner",
+    "SuccessiveHalvingPruner",
+    "HyperbandPruner",
+    "WilcoxonPruner",
+    "_filter_study",
+]
+
+
+def _filter_study(study: "Study", trial: FrozenTrial) -> "Study":
+    """Give Hyperband its bracket-restricted view of the study; identity for
+    every other pruner (reference ``optuna/pruners/__init__.py:32``)."""
+    pruner = study.pruner
+    if type(pruner).__name__ == "HyperbandPruner" and hasattr(pruner, "_create_bracket_study"):
+        return pruner._create_bracket_study(study, trial)  # type: ignore[attr-defined]
+    return study
+
+
+def __getattr__(name: str):  # lazily expose pruners implemented in later stages
+    _lazy = {
+        "PatientPruner": "optuna_tpu.pruners._patient",
+        "ThresholdPruner": "optuna_tpu.pruners._threshold",
+        "SuccessiveHalvingPruner": "optuna_tpu.pruners._successive_halving",
+        "HyperbandPruner": "optuna_tpu.pruners._hyperband",
+        "WilcoxonPruner": "optuna_tpu.pruners._wilcoxon",
+    }
+    if name in _lazy:
+        import importlib
+
+        return getattr(importlib.import_module(_lazy[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,47 @@
+"""Samplers package (reference ``optuna/samplers/__init__.py``).
+
+Heavy samplers (TPE/GP/CMA-ES/NSGA) are lazily imported so that importing the
+top-level package never triggers JAX compilation.
+"""
+
+from __future__ import annotations
+
+from optuna_tpu.samplers._base import BaseSampler
+from optuna_tpu.samplers._lazy_random_state import LazyRandomState
+from optuna_tpu.samplers._random import RandomSampler
+
+__all__ = [
+    "BaseSampler",
+    "BruteForceSampler",
+    "CmaEsSampler",
+    "GPSampler",
+    "GridSampler",
+    "LazyRandomState",
+    "NSGAIISampler",
+    "NSGAIIISampler",
+    "PartialFixedSampler",
+    "QMCSampler",
+    "RandomSampler",
+    "TPESampler",
+]
+
+_LAZY = {
+    "TPESampler": ("optuna_tpu.samplers._tpe.sampler", "TPESampler"),
+    "GPSampler": ("optuna_tpu.samplers._gp.sampler", "GPSampler"),
+    "CmaEsSampler": ("optuna_tpu.samplers._cmaes", "CmaEsSampler"),
+    "NSGAIISampler": ("optuna_tpu.samplers.nsgaii._sampler", "NSGAIISampler"),
+    "NSGAIIISampler": ("optuna_tpu.samplers._nsgaiii._sampler", "NSGAIIISampler"),
+    "QMCSampler": ("optuna_tpu.samplers._qmc", "QMCSampler"),
+    "GridSampler": ("optuna_tpu.samplers._grid", "GridSampler"),
+    "BruteForceSampler": ("optuna_tpu.samplers._brute_force", "BruteForceSampler"),
+    "PartialFixedSampler": ("optuna_tpu.samplers._partial_fixed", "PartialFixedSampler"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

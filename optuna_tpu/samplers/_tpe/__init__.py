@@ -1,0 +1,3 @@
+from optuna_tpu.samplers._tpe.sampler import TPESampler
+
+__all__ = ["TPESampler"]

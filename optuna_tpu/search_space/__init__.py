@@ -1,0 +1,13 @@
+"""Search-space algebra (reference ``optuna/search_space/__init__.py``)."""
+
+from optuna_tpu.search_space.group_decomposed import _GroupDecomposedSearchSpace
+from optuna_tpu.search_space.intersection import (
+    IntersectionSearchSpace,
+    intersection_search_space,
+)
+
+__all__ = [
+    "IntersectionSearchSpace",
+    "intersection_search_space",
+    "_GroupDecomposedSearchSpace",
+]

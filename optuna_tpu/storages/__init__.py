@@ -1,0 +1,76 @@
+"""Storages package: URL -> backend dispatch (reference ``optuna/storages/__init__.py:22-55``)."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Union
+
+from optuna_tpu.storages._base import BaseStorage
+from optuna_tpu.storages._callbacks import (
+    RetryFailedTrialCallback,
+    RetryHeartbeatStaleTrialCallback,
+)
+from optuna_tpu.storages._heartbeat import BaseHeartbeat, fail_stale_trials
+from optuna_tpu.storages._in_memory import InMemoryStorage
+
+__all__ = [
+    "BaseStorage",
+    "BaseHeartbeat",
+    "InMemoryStorage",
+    "RDBStorage",
+    "JournalStorage",
+    "GrpcStorageProxy",
+    "RetryFailedTrialCallback",
+    "RetryHeartbeatStaleTrialCallback",
+    "fail_stale_trials",
+    "get_storage",
+    "run_grpc_proxy_server",
+]
+
+_LAZY = {
+    "RDBStorage": ("optuna_tpu.storages._rdb.storage", "RDBStorage"),
+    "JournalStorage": ("optuna_tpu.storages.journal", "JournalStorage"),
+    "JournalFileBackend": ("optuna_tpu.storages.journal", "JournalFileBackend"),
+    "GrpcStorageProxy": ("optuna_tpu.storages._grpc.client", "GrpcStorageProxy"),
+    "run_grpc_proxy_server": ("optuna_tpu.storages._grpc.server", "run_grpc_proxy_server"),
+    "_CachedStorage": ("optuna_tpu.storages._cached_storage", "_CachedStorage"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def get_storage(storage: Union[None, str, BaseStorage]) -> BaseStorage:
+    """Resolve a storage spec: None -> fresh in-memory; URL string -> backend.
+
+    RDB URLs are wrapped in ``_CachedStorage`` exactly as the reference does
+    (``optuna/storages/__init__.py:41-55``).
+    """
+    if storage is None:
+        return InMemoryStorage()
+    if isinstance(storage, str):
+        if storage.startswith("sqlite://") or storage.startswith("rdb://"):
+            from optuna_tpu.storages._cached_storage import _CachedStorage
+            from optuna_tpu.storages._rdb.storage import RDBStorage
+
+            return _CachedStorage(RDBStorage(storage))
+        if storage.startswith("journal://") or storage.endswith(".journal"):
+            from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+
+            path = storage[len("journal://"):] if storage.startswith("journal://") else storage
+            return JournalStorage(JournalFileBackend(path))
+        if storage.startswith("grpc://"):
+            from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+
+            hostport = storage[len("grpc://"):]
+            host, _, port = hostport.partition(":")
+            return GrpcStorageProxy(host=host or "localhost", port=int(port or 13000))
+        raise ValueError(f"Unrecognized storage URL: {storage!r}")
+    if isinstance(storage, BaseStorage):
+        return storage
+    raise ValueError(f"Unsupported storage type: {type(storage)!r}")

@@ -1,0 +1,69 @@
+"""Failed-trial retry callbacks (reference ``optuna/storages/_callbacks.py:17-141``).
+
+Both callbacks re-enqueue a WAITING clone of a failed trial carrying
+``failed_trial``/``retry_history`` system attrs so importance/visualization
+can trace retry lineages.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import TYPE_CHECKING, Callable
+
+from optuna_tpu.trial._frozen import FrozenTrial, create_trial
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+
+class RetryFailedTrialCallback:
+    """``failed_trial_callback`` for storages: re-enqueue failed trials.
+
+    ``max_retry=None`` retries forever; ``inherit_intermediate_values`` copies
+    reported steps into the clone.
+    """
+
+    def __init__(
+        self, max_retry: int | None = None, inherit_intermediate_values: bool = False
+    ) -> None:
+        self._max_retry = max_retry
+        self._inherit_intermediate_values = inherit_intermediate_values
+
+    def __call__(self, study: "Study", trial: FrozenTrial) -> None:
+        system_attrs = dict(trial.system_attrs)
+        retry_history = list(system_attrs.get("retry_history", []))
+        original_trial_number = system_attrs.get("failed_trial", trial.number)
+        retry_history.append(trial.number)
+        if self._max_retry is not None and len(retry_history) > self._max_retry:
+            return
+
+        system_attrs["failed_trial"] = original_trial_number
+        system_attrs["retry_history"] = retry_history
+        system_attrs["fixed_params"] = trial.params
+        retried = create_trial(
+            state=TrialState.WAITING,
+            params=trial.params,
+            distributions=trial.distributions,
+            user_attrs=trial.user_attrs,
+            system_attrs=system_attrs,
+            intermediate_values=(
+                copy.deepcopy(trial.intermediate_values)
+                if self._inherit_intermediate_values
+                else None
+            ),
+        )
+        study.add_trial(retried)
+
+    @staticmethod
+    def retried_trial_number(trial: FrozenTrial) -> int | None:
+        return trial.system_attrs.get("failed_trial")
+
+    @staticmethod
+    def retry_history(trial: FrozenTrial) -> list[int]:
+        return list(trial.system_attrs.get("retry_history", []))
+
+
+# Heartbeat-flavoured alias kept for reference-API parity
+# (reference ``storages/_callbacks.py:17`` vs ``:84``).
+RetryHeartbeatStaleTrialCallback = RetryFailedTrialCallback

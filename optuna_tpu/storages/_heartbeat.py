@@ -1,0 +1,121 @@
+"""Heartbeat subsystem: worker-death detection and stale-trial failover.
+
+Parity target: ``optuna/storages/_heartbeat.py`` (``BaseHeartbeat:18``,
+``HeartbeatThread:117``, ``fail_stale_trials:156``). A daemon thread records
+liveness for each RUNNING trial; any worker observing a trial whose heartbeat
+has expired marks it FAIL and fires the failed-trial callback (typically a
+retry callback that re-enqueues a WAITING clone).
+"""
+
+from __future__ import annotations
+
+import abc
+import copy
+import threading
+from contextlib import contextmanager
+from types import TracebackType
+from typing import TYPE_CHECKING, Callable, Iterator
+
+from optuna_tpu import logging as logging_module
+from optuna_tpu.storages._base import BaseStorage
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+_logger = logging_module.get_logger(__name__)
+
+
+class BaseHeartbeat(abc.ABC):
+    """Mixin interface for storages supporting heartbeats."""
+
+    @abc.abstractmethod
+    def record_heartbeat(self, trial_id: int) -> None:
+        """Persist a liveness timestamp for the trial."""
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def _get_stale_trial_ids(self, study_id: int) -> list[int]:
+        """RUNNING trials whose heartbeat is older than the grace period."""
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def get_heartbeat_interval(self) -> int | None:
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def get_failed_trial_callback(self) -> Callable[["Study", FrozenTrial], None] | None:
+        raise NotImplementedError
+
+
+class HeartbeatThread:
+    """Daemon thread beating every ``heartbeat_interval`` seconds while the
+    objective runs (reference ``_heartbeat.py:117-144``)."""
+
+    def __init__(self, trial_id: int, heartbeat: BaseHeartbeat) -> None:
+        self._trial_id = trial_id
+        self._heartbeat = heartbeat
+        self._thread: threading.Thread | None = None
+        self._stop_event: threading.Event | None = None
+
+    def __enter__(self) -> None:
+        self._stop_event = threading.Event()
+        self._thread = threading.Thread(target=self._record_periodically, daemon=True)
+        self._thread.start()
+
+    def __exit__(
+        self,
+        exc_type: type[BaseException] | None,
+        exc_value: BaseException | None,
+        traceback: TracebackType | None,
+    ) -> None:
+        assert self._stop_event is not None and self._thread is not None
+        self._stop_event.set()
+        self._thread.join()
+
+    def _record_periodically(self) -> None:
+        assert self._stop_event is not None
+        interval = self._heartbeat.get_heartbeat_interval()
+        assert interval is not None
+        while True:
+            self._heartbeat.record_heartbeat(self._trial_id)
+            if self._stop_event.wait(timeout=interval):
+                break
+
+
+@contextmanager
+def get_heartbeat_thread(trial_id: int, storage: BaseStorage) -> Iterator[None]:
+    if is_heartbeat_enabled(storage):
+        assert isinstance(storage, BaseHeartbeat)
+        heartbeat_thread = HeartbeatThread(trial_id, storage)
+        with heartbeat_thread:
+            yield
+    else:
+        yield
+
+
+def is_heartbeat_enabled(storage: BaseStorage) -> bool:
+    return isinstance(storage, BaseHeartbeat) and storage.get_heartbeat_interval() is not None
+
+
+def fail_stale_trials(study: "Study") -> None:
+    """Mark dead workers' RUNNING trials FAIL, then fire the retry callback
+    (reference ``_heartbeat.py:156-203``). Called at each ``_run_trial`` start."""
+    storage = study._storage
+    if not isinstance(storage, BaseHeartbeat):
+        return
+    if not is_heartbeat_enabled(storage):
+        return
+
+    failed_trial_ids = []
+    for trial_id in storage._get_stale_trial_ids(study._study_id):
+        # The CAS may lose to the (still-alive) owner finishing concurrently.
+        if storage.set_trial_state_values(trial_id, state=TrialState.FAIL):
+            failed_trial_ids.append(trial_id)
+
+    failed_trial_callback = storage.get_failed_trial_callback()
+    if failed_trial_callback is not None:
+        for trial_id in failed_trial_ids:
+            failed_trial = copy.deepcopy(storage.get_trial(trial_id))
+            failed_trial_callback(study, failed_trial)

@@ -1,0 +1,27 @@
+"""Study package (reference ``optuna/study/__init__.py``)."""
+
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.study._study_summary import StudySummary
+from optuna_tpu.study.study import (
+    ObjectiveFuncType,
+    Study,
+    copy_study,
+    create_study,
+    delete_study,
+    get_all_study_names,
+    get_all_study_summaries,
+    load_study,
+)
+
+__all__ = [
+    "ObjectiveFuncType",
+    "Study",
+    "StudyDirection",
+    "StudySummary",
+    "copy_study",
+    "create_study",
+    "delete_study",
+    "get_all_study_names",
+    "get_all_study_summaries",
+    "load_study",
+]

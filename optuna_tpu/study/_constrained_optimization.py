@@ -1,0 +1,23 @@
+"""Constrained-optimization helpers (reference ``optuna/study/_constrained_optimization.py:12-59``).
+
+Protocol: the user passes ``constraints_func(frozen_trial) -> Sequence[float]``
+to a sampler; values are stored under the ``constraints`` system attr at
+trial end; a trial is feasible iff every component <= 0.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from optuna_tpu.trial._frozen import FrozenTrial
+
+_CONSTRAINTS_KEY = "constraints"
+
+
+def _get_feasible_trials(trials: Sequence[FrozenTrial]) -> list[FrozenTrial]:
+    feasible_trials = []
+    for trial in trials:
+        constraints = trial.system_attrs.get(_CONSTRAINTS_KEY)
+        if constraints is None or all(x <= 0.0 for x in constraints):
+            feasible_trials.append(trial)
+    return feasible_trials

@@ -1,0 +1,251 @@
+"""The optimize loop: sequential and thread-pool trial execution.
+
+Parity target: ``optuna/study/_optimize.py`` (``_optimize:39``,
+``_optimize_sequential:127``, ``_run_trial:186``: heartbeat + fail_stale +
+ask -> objective -> tell). Trial-level parallelism = ``n_jobs`` threads here;
+process/pod-level fan-out goes through shared storage CAS (see
+``optuna_tpu.parallel`` for the vectorized device-batch path).
+"""
+
+from __future__ import annotations
+
+import datetime
+import gc
+import itertools
+import os
+import sys
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+from optuna_tpu import exceptions, logging as logging_module
+from optuna_tpu.progress_bar import _ProgressBar
+from optuna_tpu.study._tell import _tell_with_warning
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+from optuna_tpu.trial._trial import Trial
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import ObjectiveFuncType, Study
+
+_logger = logging_module.get_logger(__name__)
+
+
+def _optimize(
+    study: "Study",
+    func: "ObjectiveFuncType",
+    n_trials: int | None = None,
+    timeout: float | None = None,
+    n_jobs: int = 1,
+    catch: tuple[type[Exception], ...] = (),
+    callbacks: Sequence[Callable[["Study", FrozenTrial], None]] | None = None,
+    gc_after_trial: bool = False,
+    show_progress_bar: bool = False,
+) -> None:
+    if not isinstance(catch, tuple):
+        raise TypeError("The catch argument is of type '{}' but must be a tuple.".format(
+            type(catch).__name__
+        ))
+    if study._thread_local.in_optimize_loop:
+        raise RuntimeError("Nested invocation of `Study.optimize` method isn't allowed.")
+    if show_progress_bar and n_trials is None and timeout is not None and n_jobs != 1:
+        _logger.warning("The timeout-based progress bar is not supported with n_jobs != 1.")
+        show_progress_bar = False
+
+    progress_bar = _ProgressBar(show_progress_bar, n_trials, timeout)
+    study._stop_flag = False
+
+    try:
+        if n_jobs == 1:
+            _optimize_sequential(
+                study,
+                func,
+                n_trials,
+                timeout,
+                catch,
+                callbacks,
+                gc_after_trial,
+                reseed_sampler_rng=False,
+                time_start=None,
+                progress_bar=progress_bar,
+            )
+        else:
+            if n_jobs == -1:
+                n_jobs = os.cpu_count() or 1
+            time_start = datetime.datetime.now()
+            futures: set[Future] = set()
+            with ThreadPoolExecutor(max_workers=n_jobs) as executor:
+                for n_submitted_trials in itertools.count():
+                    if study._stop_flag:
+                        break
+                    if (
+                        timeout is not None
+                        and (datetime.datetime.now() - time_start).total_seconds() > timeout
+                    ):
+                        break
+                    if n_trials is not None and n_submitted_trials >= n_trials:
+                        break
+                    if len(futures) >= n_jobs:
+                        completed, futures = wait(futures, return_when=FIRST_COMPLETED)
+                        for f in completed:
+                            f.result()  # propagate exceptions
+                    futures.add(
+                        executor.submit(
+                            _optimize_sequential,
+                            study,
+                            func,
+                            1,
+                            timeout,
+                            catch,
+                            callbacks,
+                            gc_after_trial,
+                            True,
+                            time_start,
+                            progress_bar,
+                        )
+                    )
+                for f in futures:
+                    f.result()
+    finally:
+        study._thread_local.in_optimize_loop = False
+        progress_bar.close()
+
+
+def _optimize_sequential(
+    study: "Study",
+    func: "ObjectiveFuncType",
+    n_trials: int | None,
+    timeout: float | None,
+    catch: tuple[type[Exception], ...],
+    callbacks: Sequence[Callable[["Study", FrozenTrial], None]] | None,
+    gc_after_trial: bool,
+    reseed_sampler_rng: bool,
+    time_start: datetime.datetime | None,
+    progress_bar: _ProgressBar | None,
+) -> None:
+    study._thread_local.in_optimize_loop = True
+    if reseed_sampler_rng:
+        study.sampler.reseed_rng()
+
+    if time_start is None:
+        time_start = datetime.datetime.now()
+
+    i_trial = 0
+    while True:
+        if study._stop_flag:
+            break
+        if n_trials is not None and i_trial >= n_trials:
+            break
+        i_trial += 1
+
+        if timeout is not None:
+            elapsed_seconds = (datetime.datetime.now() - time_start).total_seconds()
+            if elapsed_seconds >= timeout:
+                break
+
+        try:
+            frozen_trial = _run_trial(study, func, catch)
+        finally:
+            # The trial and its objective's locals can hold device buffers;
+            # an explicit gc between trials caps HBM/host growth (reference
+            # _optimize.py:150-161, issue #1340 in the upstream tracker).
+            if gc_after_trial:
+                gc.collect()
+
+        if callbacks is not None:
+            for callback in callbacks:
+                callback(study, frozen_trial)
+
+        if progress_bar is not None:
+            elapsed_seconds = (datetime.datetime.now() - time_start).total_seconds()
+            progress_bar.update(elapsed_seconds, study)
+
+
+def _run_trial(
+    study: "Study",
+    func: "ObjectiveFuncType",
+    catch: tuple[type[Exception], ...],
+) -> FrozenTrial:
+    from optuna_tpu.storages._heartbeat import (
+        fail_stale_trials,
+        get_heartbeat_thread,
+        is_heartbeat_enabled,
+    )
+
+    if is_heartbeat_enabled(study._storage):
+        fail_stale_trials(study)
+
+    trial = study.ask()
+
+    state: TrialState | None = None
+    value_or_values: float | Sequence[float] | None = None
+    func_err: Exception | KeyboardInterrupt | None = None
+    func_err_fail_exc_info: Any = None
+
+    with get_heartbeat_thread(trial._trial_id, study._storage):
+        try:
+            value_or_values = func(trial)
+        except exceptions.TrialPruned as e:
+            state = TrialState.PRUNED
+            func_err = e
+        except (Exception, KeyboardInterrupt) as e:
+            state = TrialState.FAIL
+            func_err = e
+            func_err_fail_exc_info = sys.exc_info()
+
+    # Use `_tell_with_warning` instead of `study.tell` so misbehaving
+    # objectives produce warnings rather than hard errors mid-loop.
+    try:
+        frozen_trial = _tell_with_warning(
+            study=study,
+            trial=trial,
+            value_or_values=value_or_values,
+            state=state,
+            suppress_warning=True,
+        )
+    except Exception:
+        frozen_trial = study._storage.get_trial(trial._trial_id)
+        raise
+    finally:
+        if frozen_trial.state == TrialState.COMPLETE:
+            study._log_completed_trial(frozen_trial)
+        elif frozen_trial.state == TrialState.PRUNED:
+            _logger.info(f"Trial {frozen_trial.number} pruned. {str(func_err)}")
+        elif frozen_trial.state == TrialState.FAIL:
+            if func_err is not None:
+                _log_failed_trial(
+                    frozen_trial,
+                    repr(func_err),
+                    exc_info=func_err_fail_exc_info,
+                    value_or_values=value_or_values,
+                )
+            elif frozen_trial.system_attrs.get("fail_reason") is not None:
+                _log_failed_trial(
+                    frozen_trial,
+                    frozen_trial.system_attrs["fail_reason"],
+                    value_or_values=value_or_values,
+                )
+        else:
+            raise AssertionError(f"Unexpected trial state {frozen_trial.state}.")
+
+    if (
+        frozen_trial.state == TrialState.FAIL
+        and func_err is not None
+        and not isinstance(func_err, catch)
+    ):
+        raise func_err
+    return frozen_trial
+
+
+def _log_failed_trial(
+    trial: FrozenTrial,
+    message: str | Warning,
+    exc_info: Any = None,
+    value_or_values: Any = None,
+) -> None:
+    _logger.warning(
+        f"Trial {trial.number} failed with parameters: {trial.params} because of the "
+        f"following error: {message}.",
+        exc_info=exc_info,
+    )
+    if value_or_values is not None:
+        _logger.warning(f"Trial {trial.number} failed with value {value_or_values}.")

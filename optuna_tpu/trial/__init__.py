@@ -1,0 +1,8 @@
+"""Trial package (reference ``optuna/trial/__init__.py``)."""
+
+from optuna_tpu.trial._fixed import FixedTrial
+from optuna_tpu.trial._frozen import FrozenTrial, create_trial
+from optuna_tpu.trial._state import TrialState
+from optuna_tpu.trial._trial import Trial
+
+__all__ = ["FixedTrial", "FrozenTrial", "Trial", "TrialState", "create_trial"]

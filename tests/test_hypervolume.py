@@ -1,0 +1,80 @@
+"""WFG hypervolume vs brute force (mirrors reference tests/hypervolume_tests/)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from optuna_tpu.hypervolume import compute_hypervolume, solve_hssp
+
+
+def _brute_force_hv(points: np.ndarray, ref: np.ndarray) -> float:
+    """Inclusion-exclusion over all subsets (exponential — tiny inputs only)."""
+    n = len(points)
+    total = 0.0
+    for r in range(1, n + 1):
+        for subset in itertools.combinations(range(n), r):
+            inter = np.max(points[list(subset)], axis=0)
+            vol = np.prod(np.maximum(ref - inter, 0.0))
+            total += ((-1) ** (r + 1)) * vol
+    return total
+
+
+@pytest.mark.parametrize("dim", [2, 3, 4])
+def test_hypervolume_matches_brute_force(dim):
+    rng = np.random.RandomState(42 + dim)
+    for _ in range(5):
+        points = rng.uniform(0, 1, size=(6, dim))
+        ref = np.full(dim, 1.1)
+        expected = _brute_force_hv(points, ref)
+        got = compute_hypervolume(points, ref)
+        np.testing.assert_allclose(got, expected, rtol=1e-9, atol=1e-12)
+
+
+def test_hypervolume_2d_simple():
+    pts = np.array([[0.0, 1.0], [1.0, 0.0], [0.5, 0.5]])
+    ref = np.array([2.0, 2.0])
+    # By hand: 2x2 square minus staircase = 3.25
+    np.testing.assert_allclose(compute_hypervolume(pts, ref), 3.25)
+
+
+def test_hypervolume_point_outside_ref():
+    pts = np.array([[3.0, 3.0]])
+    ref = np.array([2.0, 2.0])
+    assert compute_hypervolume(pts, ref) == 0.0
+
+
+def test_hypervolume_duplicate_points():
+    pts = np.array([[0.5, 0.5], [0.5, 0.5]])
+    ref = np.array([1.0, 1.0])
+    np.testing.assert_allclose(compute_hypervolume(pts, ref), 0.25)
+
+
+def test_solve_hssp_selects_extremes():
+    pts = np.array([[0.0, 1.0], [1.0, 0.0], [0.45, 0.55], [0.9, 0.9]])
+    ref = np.array([1.1, 1.1])
+    chosen = solve_hssp(pts, ref, 3)
+    assert len(chosen) == 3
+    assert 3 not in chosen  # the dominated point is never picked first
+
+
+def test_solve_hssp_greedy_quality():
+    rng = np.random.RandomState(0)
+    pts = rng.uniform(0, 1, size=(12, 2))
+    ref = np.full(2, 1.1)
+    chosen = solve_hssp(pts, ref, 5)
+    hv_greedy = compute_hypervolume(pts[chosen], ref)
+    # Greedy is (1 - 1/e)-optimal; check against the best single swap.
+    hv_all = compute_hypervolume(pts, ref)
+    assert hv_greedy >= (1 - 1 / np.e) * hv_all * 0.999
+
+
+def test_non_domination_rank_no_sentinel_leak():
+    from optuna_tpu.study._multi_objective import _fast_non_domination_rank
+
+    vals = np.array([[float(i), float(i)] for i in range(1, 7)])
+    ranks = _fast_non_domination_rank(vals, n_below=2)
+    # Unranked trials must be WORSE than ranked ones, never the -1 sentinel.
+    assert ranks[0] == 0
+    assert np.all(ranks >= 0)
+    assert np.all(ranks[2:] > ranks[1])

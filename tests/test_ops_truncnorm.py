@@ -1,0 +1,53 @@
+"""JAX truncnorm kernels vs SciPy ground truth (mirrors reference
+tests/samplers_tests/tpe_tests/test_truncnorm.py)."""
+
+import numpy as np
+import pytest
+import scipy.stats as ss
+
+import jax.numpy as jnp
+
+from optuna_tpu.ops import truncnorm
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [(-2.0, 2.0), (-5.0, -1.0), (1.0, 5.0), (0.0, 3.0), (-3.0, 0.0), (-0.5, 0.5)],
+)
+def test_ppf_matches_scipy(a, b):
+    q = np.linspace(0.01, 0.99, 31)
+    expected = ss.truncnorm.ppf(q, a, b)
+    got = np.asarray(truncnorm.ppf(jnp.asarray(q), a, b))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("a,b", [(-2.0, 2.0), (-6.0, -2.0), (2.0, 6.0), (-1.0, 3.0)])
+def test_logpdf_matches_scipy(a, b):
+    x = np.linspace(a, b, 21)
+    expected = ss.truncnorm.logpdf(x, a, b)
+    got = np.asarray(truncnorm.logpdf(jnp.asarray(x), a, b))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_logpdf_outside_support():
+    out = np.asarray(truncnorm.logpdf(jnp.asarray([-3.0, 3.0]), -2.0, 2.0))
+    assert np.all(np.isneginf(out))
+
+
+def test_log_mass_stability_far_tail():
+    # Far tails must not produce NaN in f32.
+    lm = np.asarray(truncnorm.log_mass(jnp.asarray([8.0]), jnp.asarray([12.0])))
+    assert np.isfinite(lm).all()
+    lm2 = np.asarray(truncnorm.log_mass(jnp.asarray([-12.0]), jnp.asarray([-8.0])))
+    assert np.isfinite(lm2).all()
+    np.testing.assert_allclose(lm, lm2, rtol=1e-3)
+
+
+def test_rvs_within_bounds():
+    import jax
+
+    key = jax.random.PRNGKey(0)
+    s = np.asarray(truncnorm.rvs(key, -1.0, 1.5, shape=(1000,)))
+    assert s.min() >= -1.0 and s.max() <= 1.5
+    # Mean should be near scipy's
+    np.testing.assert_allclose(s.mean(), ss.truncnorm.mean(-1.0, 1.5), atol=0.1)

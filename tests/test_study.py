@@ -1,0 +1,266 @@
+"""Core study/trial runtime tests (modeled on reference tests/study_tests/)."""
+
+import math
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import TrialState, create_study
+from optuna_tpu.samplers import RandomSampler
+
+
+def objective(trial):
+    x = trial.suggest_float("x", -10, 10)
+    y = trial.suggest_int("y", 0, 10)
+    c = trial.suggest_categorical("c", ["a", "b"])
+    return x**2 + y + (0 if c == "a" else 1)
+
+
+def test_optimize_end_to_end():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(objective, n_trials=20)
+    assert len(study.trials) == 20
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert study.best_value <= min(t.value for t in study.trials)
+    assert set(study.best_params) == {"x", "y", "c"}
+    assert -10 <= study.best_params["x"] <= 10
+
+
+def test_optimize_with_failure_and_catch():
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def fail_objective(trial):
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        study.optimize(fail_objective, n_trials=1)
+    study.optimize(fail_objective, n_trials=3, catch=(ValueError,))
+    assert all(t.state == TrialState.FAIL for t in study.trials)
+
+
+def test_optimize_prune():
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def prune_objective(trial):
+        trial.report(1.0, step=0)
+        raise optuna_tpu.TrialPruned()
+
+    study.optimize(prune_objective, n_trials=2)
+    assert all(t.state == TrialState.PRUNED for t in study.trials)
+    # Last intermediate value is promoted to the trial value.
+    assert all(t.value == 1.0 for t in study.trials)
+
+
+def test_ask_tell():
+    study = create_study(sampler=RandomSampler(seed=1))
+    trial = study.ask()
+    x = trial.suggest_float("x", 0, 1)
+    study.tell(trial, x)
+    assert len(study.trials) == 1
+    assert study.trials[0].value == x
+    # tell by number
+    trial2 = study.ask()
+    y = trial2.suggest_float("x", 0, 1)
+    study.tell(trial2.number, y)
+    assert study.trials[1].value == y
+
+
+def test_tell_invalid():
+    study = create_study(sampler=RandomSampler(seed=1))
+    trial = study.ask()
+    with pytest.raises(ValueError):
+        study.tell(trial, state=TrialState.COMPLETE)  # no values
+    study.tell(trial, 1.0)
+    with pytest.raises(ValueError):
+        study.tell(-1, 1.0)
+
+
+def test_objective_returns_none_fails():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: None, n_trials=1, catch=())
+    assert study.trials[0].state == TrialState.FAIL
+    assert "fail_reason" in study.trials[0].system_attrs
+
+
+def test_objective_nan_fails():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: math.nan, n_trials=1)
+    assert study.trials[0].state == TrialState.FAIL
+
+
+def test_enqueue_trial():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.enqueue_trial({"x": 5.0, "y": 3, "c": "b"})
+    study.optimize(objective, n_trials=1)
+    t = study.trials[0]
+    assert t.params["x"] == 5.0
+    assert t.params["y"] == 3
+    assert t.params["c"] == "b"
+    assert t.value == 25.0 + 3 + 1
+
+
+def test_enqueue_skip_if_exists():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.enqueue_trial({"x": 5.0}, skip_if_exists=True)
+    study.enqueue_trial({"x": 5.0}, skip_if_exists=True)
+    assert len(study.get_trials(states=(TrialState.WAITING,))) == 1
+
+
+def test_multi_objective_study():
+    study = create_study(directions=["minimize", "maximize"], sampler=RandomSampler(seed=0))
+
+    def mo_objective(trial):
+        x = trial.suggest_float("x", 0, 1)
+        return x, 1 - x
+
+    study.optimize(mo_objective, n_trials=10)
+    assert len(study.trials) == 10
+    with pytest.raises(RuntimeError):
+        study.best_trial
+    best = study.best_trials
+    assert len(best) >= 1
+    for t in best:
+        assert t.state == TrialState.COMPLETE
+
+
+def test_study_user_attrs():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.set_user_attr("dataset", "mnist")
+    assert study.user_attrs == {"dataset": "mnist"}
+
+
+def test_trial_user_attrs():
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def obj(trial):
+        trial.set_user_attr("mean", 0.5)
+        return trial.suggest_float("x", 0, 1)
+
+    study.optimize(obj, n_trials=1)
+    assert study.trials[0].user_attrs == {"mean": 0.5}
+
+
+def test_stop_callback():
+    from optuna_tpu._callbacks import MaxTrialsCallback
+
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(
+        lambda t: t.suggest_float("x", 0, 1),
+        n_trials=100,
+        callbacks=[MaxTrialsCallback(5)],
+    )
+    assert len(study.trials) == 5
+
+
+def test_n_jobs_threads():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(objective, n_trials=20, n_jobs=4)
+    assert len([t for t in study.trials if t.state == TrialState.COMPLETE]) == 20
+
+
+def test_load_and_delete_study():
+    storage = optuna_tpu.storages.InMemoryStorage()
+    study = create_study(study_name="s1", storage=storage)
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=2)
+    loaded = optuna_tpu.load_study(study_name="s1", storage=storage)
+    assert len(loaded.trials) == 2
+    optuna_tpu.delete_study(study_name="s1", storage=storage)
+    with pytest.raises(KeyError):
+        optuna_tpu.load_study(study_name="s1", storage=storage)
+
+
+def test_copy_study():
+    src_storage = optuna_tpu.storages.InMemoryStorage()
+    dst_storage = optuna_tpu.storages.InMemoryStorage()
+    study = create_study(study_name="src", storage=src_storage, sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=3)
+    optuna_tpu.copy_study(
+        from_study_name="src", from_storage=src_storage, to_storage=dst_storage
+    )
+    copied = optuna_tpu.load_study(study_name="src", storage=dst_storage)
+    assert len(copied.trials) == 3
+
+
+def test_create_study_duplicated():
+    storage = optuna_tpu.storages.InMemoryStorage()
+    create_study(study_name="dup", storage=storage)
+    with pytest.raises(optuna_tpu.exceptions.DuplicatedStudyError):
+        create_study(study_name="dup", storage=storage)
+    study = create_study(study_name="dup", storage=storage, load_if_exists=True)
+    assert study.study_name == "dup"
+
+
+def test_get_all_study_summaries():
+    storage = optuna_tpu.storages.InMemoryStorage()
+    study = create_study(study_name="summ", storage=storage, sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=3)
+    summaries = optuna_tpu.get_all_study_summaries(storage)
+    assert len(summaries) == 1
+    assert summaries[0].n_trials == 3
+    assert summaries[0].best_trial is not None
+
+
+def test_trials_dataframe():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(objective, n_trials=3)
+    df = study.trials_dataframe()
+    assert len(df) == 3
+    assert "value" in df.columns
+    assert "params_x" in df.columns
+
+
+def test_dynamic_search_space():
+    # Define-by-run: the space can change from trial to trial.
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def dynamic(trial):
+        if trial.number % 2 == 0:
+            return trial.suggest_float("a", 0, 1)
+        return trial.suggest_float("b", 10, 11)
+
+    study.optimize(dynamic, n_trials=4)
+    assert len(study.trials) == 4
+
+
+def test_suggest_repeated_name_same_distribution():
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def obj(trial):
+        x1 = trial.suggest_float("x", 0, 1)
+        x2 = trial.suggest_float("x", 0, 1)
+        assert x1 == x2
+        return x1
+
+    study.optimize(obj, n_trials=1)
+
+
+def test_suggest_single_point():
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def obj(trial):
+        x = trial.suggest_float("x", 3.0, 3.0)
+        assert x == 3.0
+        return x
+
+    study.optimize(obj, n_trials=1)
+
+
+def test_default_multiobjective_sampler_constructible():
+    # Default sampler for multi-objective studies must not crash at creation.
+    study = create_study(directions=["minimize", "minimize"])
+    study.optimize(lambda t: (t.suggest_float("x", 0, 1), t.suggest_float("y", 0, 1)), n_trials=2)
+    assert len(study.trials) == 2
+
+
+def test_trial_ids_survive_delete_study():
+    storage = optuna_tpu.storages.InMemoryStorage()
+    a = create_study(study_name="a", storage=storage, sampler=RandomSampler(seed=0))
+    a.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=3)
+    b = create_study(study_name="b", storage=storage, sampler=RandomSampler(seed=0))
+    b.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=1)
+    first_b_value = b.trials[0].value
+    optuna_tpu.delete_study(study_name="a", storage=storage)
+    b.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=3)
+    # The pre-delete trial must remain reachable and unchanged.
+    assert b.trials[0].value == first_b_value
+    assert [t.number for t in b.trials] == [0, 1, 2, 3]

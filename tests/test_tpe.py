@@ -1,0 +1,204 @@
+"""TPE sampler tests (mirrors reference tests/samplers_tests/tpe_tests/)."""
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import create_study
+from optuna_tpu.samplers import TPESampler
+from optuna_tpu.samplers._tpe.parzen_estimator import (
+    _ParzenEstimator,
+    _ParzenEstimatorParameters,
+)
+from optuna_tpu.samplers._tpe.sampler import default_gamma, default_weights
+from optuna_tpu.distributions import (
+    CategoricalDistribution,
+    FloatDistribution,
+    IntDistribution,
+)
+
+
+def test_default_gamma():
+    assert default_gamma(10) == 1
+    assert default_gamma(100) == 10
+    assert default_gamma(1000) == 25
+
+
+def test_default_weights():
+    assert len(default_weights(0)) == 0
+    assert np.all(default_weights(10) == 1.0)
+    w = default_weights(100)
+    assert len(w) == 100
+    assert np.all(w[-25:] == 1.0)
+    assert w[0] < w[-26]
+
+
+def _params(multivariate=False):
+    return _ParzenEstimatorParameters(
+        consider_prior=True,
+        prior_weight=1.0,
+        consider_magic_clip=True,
+        consider_endpoints=False,
+        weights=default_weights,
+        multivariate=multivariate,
+        categorical_distance_func={},
+    )
+
+
+def test_parzen_estimator_shapes():
+    space = {
+        "x": FloatDistribution(-5.0, 5.0),
+        "i": IntDistribution(0, 10),
+        "c": CategoricalDistribution(["a", "b", "c"]),
+    }
+    obs = {
+        "x": np.array([0.0, 1.0, -2.0]),
+        "i": np.array([1.0, 5.0, 9.0]),
+        "c": np.array([0.0, 1.0, 2.0]),
+    }
+    pe = _ParzenEstimator(obs, space, _params())
+    pack = pe.pack()
+    assert pack["mus"].shape[1] == 2  # x and i
+    assert pack["cat_log_probs"].shape[1] == 1
+    assert np.isfinite(pack["log_weights"]).sum() == 4  # 3 obs + prior
+
+
+def test_parzen_estimator_empty_observations():
+    space = {"x": FloatDistribution(-1.0, 1.0)}
+    pe = _ParzenEstimator({"x": np.array([])}, space, _params())
+    assert np.isfinite(pe.pack()["log_weights"]).sum() == 1  # prior only
+
+
+def test_parzen_log_domain():
+    space = {"x": FloatDistribution(1e-3, 1e3, log=True)}
+    pe = _ParzenEstimator({"x": np.array([1.0, 10.0])}, space, _params())
+    # mus live in log space
+    assert np.allclose(pe.pack()["mus"][:2, 0], [np.log(1.0), np.log(10.0)])
+
+
+def test_tpe_optimize_quadratic():
+    sampler = TPESampler(seed=42, n_startup_trials=5)
+    study = create_study(sampler=sampler)
+    study.optimize(lambda t: (t.suggest_float("x", -10, 10) - 2) ** 2, n_trials=40)
+    assert study.best_value < 2.0  # converges near x=2
+
+
+def test_tpe_beats_random_on_sphere():
+    def sphere(t):
+        x = t.suggest_float("x", -5, 5)
+        y = t.suggest_float("y", -5, 5)
+        return x * x + y * y
+
+    tpe_study = create_study(sampler=TPESampler(seed=1, n_startup_trials=10))
+    tpe_study.optimize(sphere, n_trials=60)
+    assert tpe_study.best_value < 1.0
+
+
+def test_tpe_multivariate():
+    sampler = TPESampler(seed=7, multivariate=True, n_startup_trials=5)
+    study = create_study(sampler=sampler)
+
+    def obj(t):
+        x = t.suggest_float("x", -5, 5)
+        y = t.suggest_float("y", -5, 5)
+        return (x - 1) ** 2 + (y + 1) ** 2
+
+    study.optimize(obj, n_trials=40)
+    assert study.best_value < 3.0
+
+
+def test_tpe_group():
+    sampler = TPESampler(seed=7, multivariate=True, group=True, n_startup_trials=5)
+    study = create_study(sampler=sampler)
+
+    def obj(t):
+        x = t.suggest_float("x", -5, 5)
+        if t.number % 2 == 0:
+            y = t.suggest_float("y", -5, 5)
+            return x * x + y * y
+        return x * x
+
+    study.optimize(obj, n_trials=25)
+    assert len(study.trials) == 25
+
+
+def test_tpe_mixed_space():
+    sampler = TPESampler(seed=3, n_startup_trials=5)
+    study = create_study(sampler=sampler)
+
+    def obj(t):
+        x = t.suggest_float("x", -5, 5)
+        i = t.suggest_int("i", 0, 10)
+        c = t.suggest_categorical("c", ["a", "b"])
+        lg = t.suggest_float("lg", 1e-3, 1e3, log=True)
+        st = t.suggest_float("st", 0.0, 1.0, step=0.25)
+        li = t.suggest_int("li", 1, 100, log=True)
+        return x * x + i + (0 if c == "a" else 5) + abs(np.log10(lg)) + st + li / 100
+
+    study.optimize(obj, n_trials=30)
+    for t in study.trials:
+        assert 0.0 <= t.params["st"] <= 1.0
+        assert t.params["st"] in [0.0, 0.25, 0.5, 0.75, 1.0]
+        assert 1 <= t.params["li"] <= 100
+        assert isinstance(t.params["i"], int)
+
+
+def test_tpe_constant_liar():
+    sampler = TPESampler(seed=5, constant_liar=True, n_startup_trials=3)
+    study = create_study(sampler=sampler)
+    study.optimize(lambda t: t.suggest_float("x", -5, 5) ** 2, n_trials=15)
+    assert len(study.trials) == 15
+
+
+def test_tpe_with_constraints():
+    def constraints(trial):
+        return (trial.params["x"] - 2,)  # feasible iff x <= 2
+
+    sampler = TPESampler(seed=11, n_startup_trials=5, constraints_func=constraints)
+    study = create_study(sampler=sampler)
+    study.optimize(lambda t: -t.suggest_float("x", 0, 10), n_trials=30)
+    # Feasible best should respect the constraint.
+    best = study.best_trial
+    assert best.params["x"] <= 2.0 + 1e-6
+
+
+def test_tpe_multiobjective_split():
+    sampler = TPESampler(seed=9, n_startup_trials=5)
+    study = create_study(directions=["minimize", "minimize"], sampler=sampler)
+
+    def obj(t):
+        x = t.suggest_float("x", 0, 1)
+        return x, 1 - x
+
+    study.optimize(obj, n_trials=25)
+    assert len(study.best_trials) >= 1
+
+
+def test_tpe_pruned_trials_used():
+    sampler = TPESampler(seed=13, n_startup_trials=3)
+    study = create_study(sampler=sampler)
+
+    def obj(t):
+        x = t.suggest_float("x", -5, 5)
+        t.report(x * x, 0)
+        if t.number % 3 == 0:
+            raise optuna_tpu.TrialPruned()
+        return x * x
+
+    study.optimize(obj, n_trials=20)
+    assert len(study.trials) == 20
+
+
+def test_tpe_reproducible():
+    def obj(t):
+        return t.suggest_float("x", -5, 5) ** 2 + t.suggest_int("i", 0, 3)
+
+    vals1 = []
+    study = create_study(sampler=TPESampler(seed=123, n_startup_trials=4))
+    study.optimize(obj, n_trials=12)
+    vals1 = [t.params["x"] for t in study.trials]
+
+    study2 = create_study(sampler=TPESampler(seed=123, n_startup_trials=4))
+    study2.optimize(obj, n_trials=12)
+    vals2 = [t.params["x"] for t in study2.trials]
+    np.testing.assert_allclose(vals1, vals2)
