@@ -1,0 +1,14 @@
+"""TPU-native Gaussian-process Bayesian optimization core.
+
+Parity target: ``optuna/_gp/`` (gp.py, acqf.py, optim_mixed.py, prior.py,
+search_space.py, qmc.py, batched_lbfgsb.py). The reference runs PyTorch
+float64 on CPU with SciPy's Fortran L-BFGS-B; here the full pipeline —
+Matern-5/2 kernel, Cholesky MLL fitting, acquisition evaluation and
+multi-start optimization — is jit-compiled XLA running f32 on device, with
+trial counts padded to power-of-two buckets so re-compiles are rare.
+"""
+
+from optuna_tpu.gp.gp import GPParams, GPState, fit_gp, posterior
+from optuna_tpu.gp.search_space import ScaleType, SearchSpace
+
+__all__ = ["GPParams", "GPState", "ScaleType", "SearchSpace", "fit_gp", "posterior"]

@@ -1,0 +1,185 @@
+"""Acquisition functions as pure jit-able (data, x) -> value functions.
+
+Parity target: ``optuna/_gp/acqf.py`` — stable LogEI (``:55-106``), qLogEI
+over QMC fantasies for running trials (``:154``), LogPI (``:191``), UCB/LCB
+(``:233/249``), ConstrainedLogEI (``:265``), LogEHVI (``:304``) and
+constrained variant (``:382``).
+
+Design: each acquisition is a ``NamedTuple`` *data* pytree plus a pure
+``<name>_value(data, x)`` function. The optimizer receives the function
+statically and the data as a traced argument, so one XLA graph per
+(acqf kind, shape bucket) serves every trial.
+
+Objective convention: single-objective GPs fit **maximization**-standardized
+targets (EI improves upward); multi-objective EHVI works in
+**minimization**-normalized space (matching the hypervolume kernels).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import log_ndtr
+
+from optuna_tpu.gp.gp import GPState, matern52, posterior
+from optuna_tpu.ops.special import log_h
+
+
+# ----------------------------------------------------------------------- LogEI
+
+
+class LogEIData(NamedTuple):
+    state: GPState
+    cat_mask: jnp.ndarray
+    best: jnp.ndarray  # () incumbent (max over observed, incl. liar values)
+    stabilizing_noise: jnp.ndarray
+
+
+def logei_value(data: LogEIData, x: jnp.ndarray) -> jnp.ndarray:
+    """log E[(f(x) - best)+] for query batch x (m, d)."""
+    mean, var = posterior(data.state, x, data.cat_mask)
+    sigma = jnp.sqrt(var + data.stabilizing_noise)
+    z = (mean - data.best) / sigma
+    return jnp.log(sigma) + log_h(z)
+
+
+# ---------------------------------------------------------------------- qLogEI
+
+
+class QLogEIData(NamedTuple):
+    """Fantasy-conditioned LogEI: the GP is extended with running trials'
+    params and F QMC-sampled fantasy outcomes (reference ``acqf.py:154``,
+    ``gp.py:372-449``). X/L are shared across fantasies; only alpha varies."""
+
+    state: GPState  # X includes the running trials' rows
+    cat_mask: jnp.ndarray
+    alphas: jnp.ndarray  # (F, N) per-fantasy K^{-1} y_f
+    best: jnp.ndarray  # (F,) per-fantasy incumbent
+    stabilizing_noise: jnp.ndarray
+
+
+def qlogei_value(data: QLogEIData, x: jnp.ndarray) -> jnp.ndarray:
+    k_star = matern52(x, data.state.X, data.state.params, data.cat_mask)  # (m, N)
+    means = k_star @ data.alphas.T  # (m, F)
+    v = jax.scipy.linalg.solve_triangular(data.state.L, k_star.T, lower=True)
+    var = jnp.maximum(data.state.params.scale - jnp.sum(v * v, axis=0), 1e-10)
+    sigma = jnp.sqrt(var + data.stabilizing_noise)[:, None]  # (m, 1)
+    z = (means - data.best[None, :]) / sigma
+    log_ei_f = jnp.log(sigma) + log_h(z)  # (m, F)
+    F = data.alphas.shape[0]
+    return jax.scipy.special.logsumexp(log_ei_f, axis=1) - jnp.log(float(F))
+
+
+# ----------------------------------------------------------------------- LogPI
+
+
+class LogPIData(NamedTuple):
+    state: GPState
+    cat_mask: jnp.ndarray
+    best: jnp.ndarray
+    stabilizing_noise: jnp.ndarray
+
+
+def logpi_value(data: LogPIData, x: jnp.ndarray) -> jnp.ndarray:
+    """log P(f(x) > best) (reference ``acqf.py:191``)."""
+    mean, var = posterior(data.state, x, data.cat_mask)
+    sigma = jnp.sqrt(var + data.stabilizing_noise)
+    return log_ndtr((mean - data.best) / sigma)
+
+
+# --------------------------------------------------------------------- UCB/LCB
+
+
+class UCBData(NamedTuple):
+    state: GPState
+    cat_mask: jnp.ndarray
+    beta: jnp.ndarray
+
+
+def ucb_value(data: UCBData, x: jnp.ndarray) -> jnp.ndarray:
+    mean, var = posterior(data.state, x, data.cat_mask)
+    return mean + jnp.sqrt(data.beta * var)
+
+
+def lcb_value(data: UCBData, x: jnp.ndarray) -> jnp.ndarray:
+    mean, var = posterior(data.state, x, data.cat_mask)
+    return mean - jnp.sqrt(data.beta * var)
+
+
+# -------------------------------------------------------------------- LogEHVI
+
+
+class LogEHVIData(NamedTuple):
+    """QMC-sample EHVI over a disjoint box decomposition of the
+    non-dominated region (reference ``acqf.py:304``, ``logehvi:35``).
+    Minimization convention throughout."""
+
+    states: GPState  # stacked over objectives: leading axis M
+    cat_mask: jnp.ndarray
+    box_lowers: jnp.ndarray  # (K, M)
+    box_uppers: jnp.ndarray  # (K, M)
+    qmc_z: jnp.ndarray  # (S, M) standard-normal QMC draws
+    stabilizing_noise: jnp.ndarray
+
+
+def logehvi_value(data: LogEHVIData, x: jnp.ndarray) -> jnp.ndarray:
+    def per_objective(state: GPState) -> tuple[jnp.ndarray, jnp.ndarray]:
+        return posterior(state, x, data.cat_mask)
+
+    means, variances = jax.vmap(per_objective)(data.states)  # (M, m)
+    sigmas = jnp.sqrt(variances + data.stabilizing_noise)
+    # Posterior QMC samples: (S, M, m)
+    y = means[None, :, :] + data.qmc_z[:, :, None] * sigmas[None, :, :]
+    # Box clipping: contribution of sample y to box k:
+    #   prod_j ( u_kj - max(y_j, l_kj) )+
+    yk = jnp.maximum(y[:, None, :, :], data.box_lowers[None, :, :, None])  # (S, K, M, m)
+    edge = jnp.clip(data.box_uppers[None, :, :, None] - yk, 0.0, None)
+    hvi = jnp.sum(jnp.prod(edge, axis=2), axis=1)  # (S, m)
+    ehvi = jnp.mean(hvi, axis=0)  # (m,)
+    return jnp.log(ehvi + 1e-37)
+
+
+# ---------------------------------------------------------------- constrained
+
+
+class ConstrainedData(NamedTuple):
+    """Any base acquisition + sum of constraint log-feasibility
+    (reference ``acqf.py:265,382``): base(x) + sum_c log P(c(x) <= thr_c).
+    One wrapper serves logei/qlogei/logehvi — the base data rides along."""
+
+    base: object  # the wrapped acqf's data pytree
+    constraint_states: GPState  # stacked via tree: leading axis C
+    constraint_cat_mask: jnp.ndarray
+    constraint_thresholds: jnp.ndarray  # (C,) in each constraint's standardized space
+    stabilizing_noise: jnp.ndarray
+
+
+def _log_feasibility(data: ConstrainedData, x: jnp.ndarray) -> jnp.ndarray:
+    def one_constraint(state: GPState, threshold: jnp.ndarray) -> jnp.ndarray:
+        mean, var = posterior(state, x, data.constraint_cat_mask)
+        sigma = jnp.sqrt(var + data.stabilizing_noise)
+        return log_ndtr((threshold - mean) / sigma)  # log P(c <= thr)
+
+    log_feas = jax.vmap(one_constraint)(data.constraint_states, data.constraint_thresholds)
+    return jnp.sum(log_feas, axis=0)
+
+
+def _make_constrained(base_fn):
+    def value(data: ConstrainedData, x: jnp.ndarray) -> jnp.ndarray:
+        return base_fn(data.base, x) + _log_feasibility(data, x)
+
+    return value
+
+
+ACQF_VALUE_FNS = {
+    "logei": logei_value,
+    "qlogei": qlogei_value,
+    "logpi": logpi_value,
+    "ucb": ucb_value,
+    "lcb": lcb_value,
+    "logehvi": logehvi_value,
+}
+for _base in ("logei", "qlogei", "logehvi"):
+    ACQF_VALUE_FNS[f"constrained_{_base}"] = _make_constrained(ACQF_VALUE_FNS[_base])

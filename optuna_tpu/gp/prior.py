@@ -1,0 +1,26 @@
+"""Log-priors over GP kernel hyperparameters.
+
+Parity target: ``optuna/_gp/prior.py:16-33`` — gamma priors on kernel scale
+and noise plus a hand-crafted lengthscale prior concentrating inverse squared
+lengthscales away from degenerate extremes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+DEFAULT_MINIMUM_NOISE_VAR = 1e-5  # f32 floor (reference uses 1e-6 in f64)
+
+
+def log_prior(inv_sq_lengthscales: jnp.ndarray, scale: jnp.ndarray, noise: jnp.ndarray) -> jnp.ndarray:
+    """Sum of log-prior densities (up to constants).
+
+    * inverse squared lengthscales: concentration ~ Gamma-like bump keeping
+      them O(1) in normalized space;
+    * kernel scale: Gamma(2, 1);
+    * noise variance: Gamma(1.1, 30) pushing toward small noise.
+    """
+    lp_ls = jnp.sum(-(0.1 / inv_sq_lengthscales) - 0.1 * inv_sq_lengthscales + 0.0)
+    lp_scale = jnp.log(scale) - scale  # Gamma(2, 1) up to const
+    lp_noise = 0.1 * jnp.log(noise) - 30.0 * noise  # Gamma(1.1, 30) up to const
+    return lp_ls + lp_scale + lp_noise

@@ -1,0 +1,47 @@
+"""Quasi-Monte-Carlo sequences for acquisition optimization and fantasies.
+
+The reference uses SciPy's compiled Sobol (``optuna/_gp/search_space.py:184``,
+``samplers/_qmc.py:303``) and torch's SobolEngine + erfinv for normal QMC
+(``optuna/_gp/qmc.py:18``). Candidate generation is a once-per-trial, host-side
+operation with dynamic n, so we keep SciPy's scrambled Sobol on host and ship
+the points to the device as one array; the *transformations* (normal inverse
+CDF etc.) run on device.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+_sobol_lock = threading.Lock()  # SciPy Sobol engines are not thread-safe
+
+
+def sobol_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
+    """n scrambled-Sobol points in [0, 1)^dim (n need not be a power of two)."""
+    from scipy.stats import qmc
+
+    with _sobol_lock:
+        engine = qmc.Sobol(d=dim, scramble=True, seed=seed)
+        # Sobol balance prefers powers of two; round up then truncate.
+        m = int(np.ceil(np.log2(max(n, 1))))
+        pts = engine.random_base2(m=m) if n > 1 else engine.random(1)
+    return pts[:n]
+
+
+def halton_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
+    from scipy.stats import qmc
+
+    with _sobol_lock:
+        engine = qmc.Halton(d=dim, scramble=True, seed=seed)
+        return engine.random(n)
+
+
+def normal_qmc_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
+    """Standard-normal QMC draws via Sobol + inverse CDF (reference qmc.py:18)."""
+    from scipy.special import ndtri
+
+    u = sobol_sample(n, dim, seed)
+    # Keep strictly inside (0, 1) so ndtri stays finite.
+    eps = np.finfo(np.float64).eps
+    return ndtri(np.clip(u, eps, 1 - eps))

@@ -1,0 +1,3 @@
+from optuna_tpu.samplers._gp.sampler import GPSampler
+
+__all__ = ["GPSampler"]

@@ -1,0 +1,363 @@
+"""Gaussian-process Bayesian-optimization sampler (the north-star hot path).
+
+Parity target: ``optuna/samplers/_gp/sampler.py:65`` (``GPSampler``), pipeline
+``_sample_relative_impl:397``: normalize -> standardize -> fit GPs (one per
+objective + one per constraint) -> build acquisition (LogEI / qLogEI with QMC
+fantasies over running trials / LogEHVI / constrained variants) -> mixed-space
+optimization -> unnormalize.
+
+Everything numeric runs as jit-compiled XLA on device (f32, padded buckets);
+the host only encodes/decodes params and sequences the pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+import numpy as np
+
+from optuna_tpu.distributions import BaseDistribution
+from optuna_tpu.logging import get_logger
+from optuna_tpu.samplers._base import (
+    BaseSampler,
+    _CONSTRAINTS_KEY,
+    _process_constraints_after_trial,
+)
+from optuna_tpu.samplers._lazy_random_state import LazyRandomState
+from optuna_tpu.samplers._random import RandomSampler
+from optuna_tpu.search_space import IntersectionSearchSpace
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+_logger = get_logger(__name__)
+
+_N_FANTASIES = 128
+_STABILIZING_NOISE = 1e-10
+
+
+class GPSampler(BaseSampler):
+    """GP-BO with Matern-5/2 ARD kernels, MAP-fitted by batched device L-BFGS."""
+
+    def __init__(
+        self,
+        *,
+        seed: int | None = None,
+        independent_sampler: BaseSampler | None = None,
+        n_startup_trials: int = 10,
+        deterministic_objective: bool = False,
+        constraints_func: Callable[[FrozenTrial], Sequence[float]] | None = None,
+        n_preliminary_samples: int = 2048,
+        n_local_search: int = 10,
+    ) -> None:
+        self._rng = LazyRandomState(seed)
+        self._independent_sampler = independent_sampler or RandomSampler(seed=seed)
+        self._n_startup_trials = n_startup_trials
+        self._deterministic = deterministic_objective
+        self._constraints_func = constraints_func
+        self._n_preliminary_samples = n_preliminary_samples
+        self._n_local_search = n_local_search
+        self._intersection_search_space = IntersectionSearchSpace()
+        # Warm-start cache: search-space signature -> raw log kernel params
+        # (reference gp/sampler.py:244 kernel-param cache).
+        self._kernel_params_cache: dict[tuple, list[np.ndarray]] = {}
+
+    def reseed_rng(self) -> None:
+        self._rng.seed()
+        self._independent_sampler.reseed_rng()
+
+    # ----------------------------------------------------------- search space
+
+    def infer_relative_search_space(
+        self, study: "Study", trial: FrozenTrial
+    ) -> dict[str, BaseDistribution]:
+        search_space = {}
+        for name, distribution in self._intersection_search_space.calculate(study).items():
+            if distribution.single():
+                continue
+            search_space[name] = distribution
+        return search_space
+
+    # --------------------------------------------------------------- sampling
+
+    def sample_relative(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        search_space: dict[str, BaseDistribution],
+    ) -> dict[str, Any]:
+        if search_space == {}:
+            return {}
+
+        states = (TrialState.COMPLETE,)
+        trials = study._get_trials(deepcopy=False, states=states, use_cache=True)
+        trials = [t for t in trials if all(p in t.params for p in search_space)]
+        if len(trials) < self._n_startup_trials:
+            return {}
+
+        return self._sample_relative_impl(study, trial, search_space, trials)
+
+    def _sample_relative_impl(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        search_space: dict[str, BaseDistribution],
+        trials: list[FrozenTrial],
+    ) -> dict[str, Any]:
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.acqf import LogEIData
+        from optuna_tpu.gp.gp import fit_gp
+        from optuna_tpu.gp.optim_mixed import optimize_acqf_mixed
+        from optuna_tpu.gp.search_space import SearchSpace
+
+        space = SearchSpace(search_space)
+        X = space.normalize([t.params for t in trials]).astype(np.float32)
+        is_cat = np.asarray(space.is_categorical)
+        cat_mask = jnp.asarray(is_cat)
+        rng = self._rng.rng
+        seed = int(rng.randint(0, 2**31 - 1))
+
+        n_objectives = len(study.directions)
+        sig = self._space_signature(search_space)
+        warm = self._kernel_params_cache.get(sig)
+
+        if n_objectives == 1:
+            # Internal convention: maximize standardized score.
+            raw_vals = np.asarray([t.value for t in trials], dtype=np.float64)
+            score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
+            y, _, _ = _standardize(score)
+            state, raw_params = fit_gp(
+                X,
+                y.astype(np.float32),
+                is_cat,
+                warm_start_raw=warm[0] if warm else None,
+                seed=seed,
+                minimum_noise=1e-7 if self._deterministic else 1e-5,
+            )
+            self._kernel_params_cache[sig] = [raw_params]
+            best = float(np.max(y))
+
+            running = self._running_trials_matrix(study, space, search_space, trial)
+            if running is not None and len(running) > 0:
+                acqf_name, data = self._build_qlogei(state, cat_mask, running, best, seed)
+            else:
+                acqf_name = "logei"
+                data = LogEIData(
+                    state=state,
+                    cat_mask=cat_mask,
+                    best=jnp.asarray(best, dtype=jnp.float32),
+                    stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
+                )
+        else:
+            acqf_name, data, raws = self._build_logehvi(study, trials, X, is_cat, cat_mask, warm, seed)
+            self._kernel_params_cache[sig] = raws
+
+        if self._constraints_func is not None:
+            acqf_name, data = self._wrap_constraints(
+                acqf_name, data, trials, X, is_cat, cat_mask, seed
+            )
+
+        extra = X[-min(len(X), 4):]  # warm-start local search at recent incumbents
+        x_best, _ = optimize_acqf_mixed(
+            acqf_name,
+            data,
+            space,
+            rng,
+            extra_candidates=extra,
+            n_preliminary=self._n_preliminary_samples,
+            n_local_search=self._n_local_search,
+        )
+        return space.unnormalize_one(x_best)
+
+    # ------------------------------------------------------------ acqf builds
+
+    def _build_qlogei(self, state, cat_mask, running_X: np.ndarray, best: float, seed: int):
+        """Fantasize running trials and average LogEI over fantasies
+        (reference gp/sampler.py:366-373 + gp.py:372-449)."""
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.acqf import QLogEIData
+        from optuna_tpu.gp.gp import GPState, _kernel_with_noise, matern52
+        from optuna_tpu.ops.qmc import normal_qmc_sample
+
+        X_obs = state.X  # (N, d) padded
+        mask = state.mask
+        R = running_X.shape[0]
+        Xr = jnp.asarray(running_X, dtype=jnp.float32)
+
+        # Joint posterior at running points.
+        k_or = matern52(X_obs, Xr, state.params, cat_mask)  # (N, R)
+        k_rr = matern52(Xr, Xr, state.params, cat_mask)  # (R, R)
+        v = jax.scipy.linalg.solve_triangular(state.L, k_or, lower=True)  # (N, R)
+        mean_r = k_or.T @ state.alpha
+        cov_r = k_rr - v.T @ v + jnp.eye(R) * 1e-5
+        L_r = jnp.linalg.cholesky(cov_r)
+        z = jnp.asarray(
+            normal_qmc_sample(_N_FANTASIES, R, seed=seed), dtype=jnp.float32
+        )  # (F, R)
+        y_f = mean_r[None, :] + z @ L_r.T  # (F, R)
+
+        # Extended GP over [X_obs; X_r] — one shared Cholesky, F alphas.
+        N = X_obs.shape[0]
+        X_ext = jnp.concatenate([X_obs, Xr], axis=0)
+        mask_ext = jnp.concatenate([mask, jnp.ones(R, dtype=mask.dtype)])
+        K_ext = _kernel_with_noise(X_ext, state.params, cat_mask, mask_ext)
+        L_ext = jnp.linalg.cholesky(K_ext)
+
+        y_ext = jnp.concatenate(
+            [jnp.broadcast_to(state.y, (_N_FANTASIES, N)), y_f], axis=1
+        )  # (F, N+R)
+        alphas = jax.vmap(lambda yy: jax.scipy.linalg.cho_solve((L_ext, True), yy))(y_ext)
+        best_f = jnp.maximum(jnp.asarray(best, dtype=jnp.float32), jnp.max(y_f, axis=1))
+
+        ext_state = GPState(
+            params=state.params,
+            X=X_ext,
+            y=jnp.zeros(N + R, dtype=jnp.float32),  # unused by qlogei_value
+            mask=mask_ext,
+            L=L_ext,
+            alpha=jnp.zeros(N + R, dtype=jnp.float32),  # unused
+        )
+        data = QLogEIData(
+            state=ext_state,
+            cat_mask=cat_mask,
+            alphas=alphas,
+            best=best_f,
+            stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
+        )
+        return "qlogei", data
+
+    def _build_logehvi(self, study, trials, X, is_cat, cat_mask, warm, seed):
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.acqf import LogEHVIData
+        from optuna_tpu.gp.box_decomposition import nondominated_box_decomposition
+        from optuna_tpu.gp.gp import fit_gp
+        from optuna_tpu.ops.qmc import normal_qmc_sample
+        from optuna_tpu.study._multi_objective import _normalize_values
+
+        # Minimization convention for the EHVI plane.
+        loss_vals = _normalize_values(
+            np.asarray([t.values for t in trials], dtype=np.float64), study.directions
+        )
+        M = loss_vals.shape[1]
+        states = []
+        raws = []
+        std_vals = np.empty_like(loss_vals, dtype=np.float32)
+        for k in range(M):
+            yk, _, _ = _standardize(loss_vals[:, k])
+            std_vals[:, k] = yk
+            st, raw = fit_gp(
+                X,
+                yk.astype(np.float32),
+                is_cat,
+                warm_start_raw=warm[k] if warm and len(warm) > k else None,
+                seed=seed + k,
+            )
+            states.append(st)
+            raws.append(raw)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+        worst = np.max(std_vals, axis=0)
+        ref_point = np.maximum(worst * 1.1, worst * 0.9) + 1e-6
+        lowers, uppers = nondominated_box_decomposition(std_vals.astype(np.float64), ref_point)
+        qmc_z = normal_qmc_sample(_N_FANTASIES, M, seed=seed)
+
+        data = LogEHVIData(
+            states=stacked,
+            cat_mask=cat_mask,
+            box_lowers=jnp.asarray(lowers, dtype=jnp.float32),
+            box_uppers=jnp.asarray(uppers, dtype=jnp.float32),
+            qmc_z=jnp.asarray(qmc_z, dtype=jnp.float32),
+            stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
+        )
+        return "logehvi", data, raws
+
+    def _wrap_constraints(self, acqf_name, data, trials, X, is_cat, cat_mask, seed):
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.acqf import ConstrainedData
+        from optuna_tpu.gp.gp import fit_gp
+
+        constraint_rows = [t.system_attrs.get(_CONSTRAINTS_KEY) for t in trials]
+        if any(c is None for c in constraint_rows):
+            return acqf_name, data
+        cons = np.asarray(constraint_rows, dtype=np.float64)  # (n, C)
+        states = []
+        thresholds = []
+        for k in range(cons.shape[1]):
+            yk, mu, sd = _standardize(cons[:, k])
+            st, _ = fit_gp(X, yk.astype(np.float32), is_cat, seed=seed + 101 + k)
+            states.append(st)
+            thresholds.append((0.0 - mu) / sd)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        return f"constrained_{acqf_name}", ConstrainedData(
+            base=data,
+            constraint_states=stacked,
+            constraint_cat_mask=cat_mask,
+            constraint_thresholds=jnp.asarray(np.asarray(thresholds), dtype=jnp.float32),
+            stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
+        )
+
+    # ----------------------------------------------------------------- helpers
+
+    def _running_trials_matrix(
+        self,
+        study: "Study",
+        space,
+        search_space: dict[str, BaseDistribution],
+        current: FrozenTrial,
+    ) -> np.ndarray | None:
+        running = [
+            t
+            for t in study._get_trials(deepcopy=False, states=(TrialState.RUNNING,), use_cache=True)
+            if t.number != current.number and all(p in t.params for p in search_space)
+        ]
+        if not running:
+            return None
+        running = running[-8:]  # cap fantasized trials to bound the graph
+        return space.normalize([t.params for t in running]).astype(np.float32)
+
+    @staticmethod
+    def _space_signature(search_space: dict[str, BaseDistribution]) -> tuple:
+        return tuple((name, repr(dist)) for name, dist in search_space.items())
+
+    def sample_independent(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        param_name: str,
+        param_distribution: BaseDistribution,
+    ) -> Any:
+        return self._independent_sampler.sample_independent(
+            study, trial, param_name, param_distribution
+        )
+
+    def before_trial(self, study: "Study", trial: FrozenTrial) -> None:
+        self._independent_sampler.before_trial(study, trial)
+
+    def after_trial(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        state: TrialState,
+        values: Sequence[float] | None,
+    ) -> None:
+        if self._constraints_func is not None:
+            _process_constraints_after_trial(self._constraints_func, study, trial, state)
+        self._independent_sampler.after_trial(study, trial, state, values)
+
+
+def _standardize(values: np.ndarray) -> tuple[np.ndarray, float, float]:
+    mu = float(np.mean(values))
+    sd = float(np.std(values))
+    if sd <= 1e-12 or not np.isfinite(sd):
+        sd = 1.0
+    return ((values - mu) / sd), mu, sd

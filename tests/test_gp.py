@@ -1,0 +1,258 @@
+"""GP stack tests (mirrors reference tests/gp_tests/): numeric kernels
+checked against SciPy/MC ground truth, sampler end-to-end."""
+
+import numpy as np
+import pytest
+import scipy.optimize
+import scipy.special
+import scipy.stats
+
+import jax
+import jax.numpy as jnp
+
+import optuna_tpu
+from optuna_tpu.gp.box_decomposition import nondominated_box_decomposition
+from optuna_tpu.gp.gp import GPParams, fit_gp, marginal_log_likelihood, matern52, posterior
+from optuna_tpu.ops.lbfgsb import lbfgsb
+from optuna_tpu.ops.special import erfcx, log_h
+from optuna_tpu.samplers import GPSampler
+
+
+# ----------------------------------------------------------------- special fns
+
+
+def test_erfcx_matches_scipy():
+    x = np.linspace(0.0, 12.0, 61)
+    got = np.asarray(erfcx(jnp.asarray(x)))
+    expected = scipy.special.erfcx(x)
+    np.testing.assert_allclose(got, expected, rtol=2e-4)
+
+
+def test_log_h_matches_naive():
+    # log(phi(z) + z Phi(z)) via mpmath-free f64 reference on moderate z
+    z = np.linspace(-8, 4, 49)
+    expected = np.log(scipy.stats.norm.pdf(z) + z * scipy.stats.norm.cdf(z))
+    got = np.asarray(log_h(jnp.asarray(z)))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_log_h_far_tail_finite():
+    z = jnp.asarray([-30.0, -100.0])
+    out = np.asarray(log_h(z))
+    assert np.all(np.isfinite(out))
+    assert np.all(out < -100)  # vanishing EI
+
+
+# --------------------------------------------------------------------- lbfgsb
+
+
+def test_lbfgsb_batched_quadratics_vs_scipy():
+    # B independent quadratics with different centers, box-constrained.
+    centers = np.array([[0.5, 0.5], [2.0, -1.0], [-3.0, 0.2], [0.9, 0.9]])
+    lower = np.array([0.0, 0.0])
+    upper = np.array([1.0, 1.0])
+
+    def vag(x):
+        c = jnp.asarray(centers, dtype=x.dtype)
+        diff = x - c
+        return jnp.sum(diff * diff, axis=-1), 2.0 * diff
+
+    x0 = jnp.zeros((4, 2)) + 0.3
+    xs, fs = lbfgsb(vag, x0, jnp.asarray(lower, dtype=jnp.float32), jnp.asarray(upper, dtype=jnp.float32))
+    for b in range(4):
+        ref = scipy.optimize.minimize(
+            lambda v: float(np.sum((v - centers[b]) ** 2)),
+            np.full(2, 0.3),
+            jac=lambda v: 2 * (v - centers[b]),
+            bounds=[(0, 1), (0, 1)],
+            method="L-BFGS-B",
+        )
+        np.testing.assert_allclose(np.asarray(xs)[b], ref.x, atol=1e-4)
+
+
+def test_lbfgsb_rosenbrock():
+    def vag(x):
+        def f(v):
+            return (1 - v[0]) ** 2 + 100.0 * (v[1] - v[0] ** 2) ** 2
+
+        vals, grads = jax.vmap(jax.value_and_grad(f))(x)
+        return vals, grads
+
+    x0 = jnp.asarray([[-1.0, 1.0], [0.0, 0.0]], dtype=jnp.float32)
+    lower = jnp.asarray([-2.0, -2.0], dtype=jnp.float32)
+    upper = jnp.asarray([2.0, 2.0], dtype=jnp.float32)
+    xs, fs = lbfgsb(vag, x0, lower, upper, max_iters=400)
+    assert float(np.min(np.asarray(fs))) < 1e-3
+
+
+# ------------------------------------------------------------------------- GP
+
+
+def test_gp_interpolates_noiseless_data():
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (20, 2)).astype(np.float32)
+    y = np.sin(4 * X[:, 0]) * np.cos(3 * X[:, 1])
+    y = ((y - y.mean()) / y.std()).astype(np.float32)
+    state, _ = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0, minimum_noise=1e-7)
+    mean, var = posterior(state, jnp.asarray(X), jnp.asarray([False, False]))
+    np.testing.assert_allclose(np.asarray(mean)[:20], y, atol=0.05)
+
+
+def test_gp_posterior_var_grows_away_from_data():
+    X = np.array([[0.5, 0.5]], dtype=np.float32)
+    y = np.array([0.0], dtype=np.float32)
+    state, _ = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0)
+    q = jnp.asarray([[0.5, 0.5], [0.0, 0.0]], dtype=jnp.float32)
+    _, var = posterior(state, q, jnp.asarray([False, False]))
+    assert float(var[1]) > float(var[0])
+
+
+def test_matern52_psd_and_symmetric():
+    rng = np.random.RandomState(1)
+    X = jnp.asarray(rng.uniform(0, 1, (15, 3)), dtype=jnp.float32)
+    params = GPParams(
+        inv_sq_lengthscales=jnp.ones(3), scale=jnp.asarray(1.0), noise=jnp.asarray(0.0)
+    )
+    K = np.asarray(matern52(X, X, params, jnp.zeros(3, dtype=bool)))
+    np.testing.assert_allclose(K, K.T, atol=1e-6)
+    w = np.linalg.eigvalsh(K + 1e-5 * np.eye(15))
+    assert np.all(w > 0)
+
+
+def test_gp_categorical_kernel_hamming():
+    # Two points differing only in a categorical dim must have distance
+    # independent of the index gap.
+    params = GPParams(
+        inv_sq_lengthscales=jnp.ones(1), scale=jnp.asarray(1.0), noise=jnp.asarray(0.0)
+    )
+    cat = jnp.asarray([True])
+    k01 = float(matern52(jnp.asarray([[0.0]]), jnp.asarray([[1.0]]), params, cat)[0, 0])
+    k05 = float(matern52(jnp.asarray([[0.0]]), jnp.asarray([[5.0]]), params, cat)[0, 0])
+    assert abs(k01 - k05) < 1e-6
+
+
+def test_padded_gp_matches_unpadded_mll():
+    # Padding must not change the (real-row) MLL by more than a constant.
+    rng = np.random.RandomState(3)
+    X = rng.uniform(0, 1, (10, 2)).astype(np.float32)
+    y = rng.normal(size=10).astype(np.float32)
+    params = GPParams(
+        inv_sq_lengthscales=jnp.ones(2), scale=jnp.asarray(1.0), noise=jnp.asarray(0.01)
+    )
+    cat = jnp.zeros(2, dtype=bool)
+    mll_exact = marginal_log_likelihood(
+        params, jnp.asarray(X), jnp.asarray(y), cat, jnp.ones(10)
+    )
+    Xp = np.zeros((16, 2), dtype=np.float32)
+    Xp[:10] = X
+    yp = np.zeros(16, dtype=np.float32)
+    yp[:10] = y
+    maskp = np.zeros(16, dtype=np.float32)
+    maskp[:10] = 1
+    mll_padded = marginal_log_likelihood(
+        params, jnp.asarray(Xp), jnp.asarray(yp), cat, jnp.asarray(maskp)
+    )
+    np.testing.assert_allclose(float(mll_exact), float(mll_padded), rtol=1e-3, atol=1e-2)
+
+
+# ------------------------------------------------------------- box decomposition
+
+
+def test_box_decomposition_2d_volume():
+    # Total box volume within [lb, ref] must equal ref-box volume minus HV.
+    from optuna_tpu.hypervolume import compute_hypervolume
+
+    pts = np.array([[0.2, 0.8], [0.5, 0.5], [0.8, 0.1]])
+    ref = np.array([1.0, 1.0])
+    lowers, uppers = nondominated_box_decomposition(pts, ref)
+    # Boxes are disjoint and cover the non-dominated region.
+    lb = pts.min(axis=0) - 0.0  # integrate over [min, ref] only
+    clipped_l = np.maximum(lowers, lb)
+    vol = np.sum(np.prod(np.maximum(uppers - clipped_l, 0), axis=1))
+    hv = compute_hypervolume(pts, ref)
+    region = np.prod(ref - lb)
+    np.testing.assert_allclose(vol, region - hv, rtol=1e-9)
+
+
+def test_box_decomposition_disjoint():
+    rng = np.random.RandomState(5)
+    pts = rng.uniform(0, 1, (6, 3))
+    ref = np.ones(3)
+    lowers, uppers = nondominated_box_decomposition(pts, ref)
+    # Pairwise disjoint: for each pair some dim separates them.
+    K = len(lowers)
+    for i in range(K):
+        for j in range(i + 1, K):
+            overlap = np.all(
+                (lowers[i] < uppers[j]) & (lowers[j] < uppers[i])
+            )
+            assert not overlap, (i, j)
+
+
+# -------------------------------------------------------------------- sampler
+
+
+def test_gp_sampler_beats_random_quadratic():
+    def obj(t):
+        x = t.suggest_float("x", -5, 5)
+        y = t.suggest_float("y", -5, 5)
+        return (x - 1.5) ** 2 + (y + 0.5) ** 2
+
+    study = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=8))
+    study.optimize(obj, n_trials=25)
+    assert study.best_value < 0.5
+
+
+def test_gp_sampler_mixed_space():
+    def obj(t):
+        x = t.suggest_float("x", -5, 5)
+        i = t.suggest_int("i", 0, 7)
+        c = t.suggest_categorical("c", ["a", "b", "c"])
+        return x * x + i + (0 if c == "b" else 2)
+
+    study = optuna_tpu.create_study(sampler=GPSampler(seed=1, n_startup_trials=6))
+    study.optimize(obj, n_trials=20)
+    assert study.best_value < 6.0
+    assert isinstance(study.best_params["i"], int)
+
+
+def test_gp_sampler_maximize():
+    study = optuna_tpu.create_study(
+        direction="maximize", sampler=GPSampler(seed=4, n_startup_trials=6)
+    )
+    study.optimize(lambda t: -((t.suggest_float("x", 0, 10) - 7) ** 2), n_trials=20)
+    assert abs(study.best_params["x"] - 7) < 1.5
+
+
+def test_gp_sampler_constraints():
+    def cons(trial):
+        return (trial.params["x"] - 1.0,)
+
+    study = optuna_tpu.create_study(
+        sampler=GPSampler(seed=2, n_startup_trials=6, constraints_func=cons)
+    )
+    study.optimize(lambda t: -t.suggest_float("x", 0, 10), n_trials=20)
+    assert study.best_trial.params["x"] <= 1.0 + 1e-6
+
+
+def test_gp_sampler_multi_objective_ehvi():
+    def mo(t):
+        x = t.suggest_float("x", 0, 1)
+        y = t.suggest_float("y", 0, 1)
+        return x, (1 + y) * (1 - x**0.5)
+
+    study = optuna_tpu.create_study(
+        directions=["minimize", "minimize"], sampler=GPSampler(seed=3, n_startup_trials=6)
+    )
+    study.optimize(mo, n_trials=18)
+    assert len(study.best_trials) >= 3
+
+
+def test_gp_sampler_parallel_fantasies():
+    # n_jobs>1 puts RUNNING trials in history -> qLogEI fantasy path.
+    study = optuna_tpu.create_study(sampler=GPSampler(seed=5, n_startup_trials=4))
+    study.optimize(
+        lambda t: t.suggest_float("x", -3, 3) ** 2, n_trials=14, n_jobs=2
+    )
+    assert len(study.trials) == 14
+    assert study.best_value < 2.0
