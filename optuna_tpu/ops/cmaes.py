@@ -1,0 +1,214 @@
+"""CMA-ES core as pure functional JAX: ask/tell on device.
+
+Replaces the reference's external ``cmaes`` NumPy package (SURVEY.md §2.7
+item 7): covariance adaptation, eigendecomposition (``jnp.linalg.eigh`` on
+device), and population sampling are jitted; the state is a flat pytree that
+serializes into storage attrs so any worker can resume it (the reference
+pickles its optimizer object the same way, ``optuna/samplers/_cmaes.py:442``).
+
+Implements standard (mu/mu_w, lambda)-CMA-ES with rank-one + rank-mu updates
+and step-size control (CSA), plus the separable variant (diagonal covariance)
+for high dimensions. Bounds are [0, 1]^d (the sampler normalizes), handled by
+resample-free clipping.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CmaState(NamedTuple):
+    mean: jnp.ndarray  # (d,)
+    sigma: jnp.ndarray  # ()
+    C: jnp.ndarray  # (d, d) covariance (diagonal held in the same matrix for sep)
+    p_sigma: jnp.ndarray  # (d,)
+    p_c: jnp.ndarray  # (d,)
+    generation: jnp.ndarray  # () int32
+    # Static-ish scalars kept in-state so the pytree is self-contained:
+    weights: jnp.ndarray  # (popsize,) recombination weights (zeros beyond mu)
+    mu_eff: jnp.ndarray
+    c_sigma: jnp.ndarray
+    d_sigma: jnp.ndarray
+    c_c: jnp.ndarray
+    c_1: jnp.ndarray
+    c_mu: jnp.ndarray
+    chi_n: jnp.ndarray
+    sep: jnp.ndarray  # () bool — separable (diagonal) update
+
+
+def default_popsize(dim: int) -> int:
+    return 4 + int(3 * math.log(dim)) if dim > 1 else 6
+
+
+def cma_init(
+    mean0: np.ndarray,
+    sigma0: float,
+    popsize: int | None = None,
+    sep: bool = False,
+) -> CmaState:
+    d = len(mean0)
+    lam = popsize or default_popsize(d)
+    mu = lam // 2
+    raw = np.log((lam + 1) / 2) - np.log(np.arange(1, lam + 1))
+    w = np.clip(raw, 0, None)
+    w[:mu] = raw[:mu] / raw[:mu].sum()
+    w[mu:] = 0.0
+    mu_eff = 1.0 / np.sum(w[:mu] ** 2)
+
+    c_sigma = (mu_eff + 2) / (d + mu_eff + 5)
+    d_sigma = 1 + 2 * max(0.0, math.sqrt((mu_eff - 1) / (d + 1)) - 1) + c_sigma
+    c_c = (4 + mu_eff / d) / (d + 4 + 2 * mu_eff / d)
+    c_1 = 2 / ((d + 1.3) ** 2 + mu_eff)
+    c_mu = min(1 - c_1, 2 * (mu_eff - 2 + 1 / mu_eff) / ((d + 2) ** 2 + mu_eff))
+    if sep:
+        # Larger learning rate is admissible for the diagonal model.
+        c_1 = c_1 * (d + 1.5) / 3
+        c_mu = min(1 - c_1, c_mu * (d + 1.5) / 3)
+    chi_n = math.sqrt(d) * (1 - 1 / (4 * d) + 1 / (21 * d * d))
+
+    return CmaState(
+        mean=jnp.asarray(mean0, dtype=jnp.float32),
+        sigma=jnp.asarray(sigma0, dtype=jnp.float32),
+        C=jnp.eye(d, dtype=jnp.float32),
+        p_sigma=jnp.zeros(d, dtype=jnp.float32),
+        p_c=jnp.zeros(d, dtype=jnp.float32),
+        generation=jnp.asarray(0, dtype=jnp.int32),
+        weights=jnp.asarray(w, dtype=jnp.float32),
+        mu_eff=jnp.asarray(mu_eff, dtype=jnp.float32),
+        c_sigma=jnp.asarray(c_sigma, dtype=jnp.float32),
+        d_sigma=jnp.asarray(d_sigma, dtype=jnp.float32),
+        c_c=jnp.asarray(c_c, dtype=jnp.float32),
+        c_1=jnp.asarray(c_1, dtype=jnp.float32),
+        c_mu=jnp.asarray(c_mu, dtype=jnp.float32),
+        chi_n=jnp.asarray(chi_n, dtype=jnp.float32),
+        sep=jnp.asarray(sep),
+    )
+
+
+def _eig_decomp(state: CmaState) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, D_diag_sqrt): eigenbasis and sqrt eigenvalues, diagonal-aware."""
+    d = state.C.shape[0]
+
+    def full_eig(C):
+        w, B = jnp.linalg.eigh(C)
+        return B, jnp.sqrt(jnp.clip(w, 1e-20, None))
+
+    def diag_eig(C):
+        return jnp.eye(d, dtype=C.dtype), jnp.sqrt(jnp.clip(jnp.diagonal(C), 1e-20, None))
+
+    return jax.lax.cond(state.sep, diag_eig, full_eig, state.C)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def cma_ask(state: CmaState, key: jax.Array, n: int) -> jnp.ndarray:
+    """Sample n candidates in [0, 1]^d (clipped)."""
+    d = state.mean.shape[0]
+    B, D = _eig_decomp(state)
+    z = jax.random.normal(key, (n, d), dtype=jnp.float32)
+    y = (z * D[None, :]) @ B.T  # (n, d) ~ N(0, C)
+    x = state.mean[None, :] + state.sigma * y
+    return jnp.clip(x, 0.0, 1.0)
+
+
+@jax.jit
+def cma_tell(state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray) -> CmaState:
+    """One generation update from evaluated population (X (lam,d), minimize)."""
+    d = state.mean.shape[0]
+    lam = X.shape[0]
+    order = jnp.argsort(fitness)
+    X_sorted = X[order]
+    w = state.weights
+
+    y_k = (X_sorted - state.mean[None, :]) / state.sigma  # (lam, d)
+    y_w = jnp.sum(w[:, None] * y_k, axis=0)  # weighted mean step
+    mean_new = state.mean + state.sigma * y_w
+
+    B, D = _eig_decomp(state)
+    # C^{-1/2} y_w
+    c_inv_sqrt_yw = B @ ((B.T @ y_w) / D)
+    p_sigma = (1 - state.c_sigma) * state.p_sigma + jnp.sqrt(
+        state.c_sigma * (2 - state.c_sigma) * state.mu_eff
+    ) * c_inv_sqrt_yw
+
+    norm_p_sigma = jnp.linalg.norm(p_sigma)
+    sigma_new = state.sigma * jnp.exp(
+        (state.c_sigma / state.d_sigma) * (norm_p_sigma / state.chi_n - 1)
+    )
+    sigma_new = jnp.clip(sigma_new, 1e-10, 1e3)
+
+    h_sigma_cond = norm_p_sigma / jnp.sqrt(
+        1 - (1 - state.c_sigma) ** (2 * (state.generation + 1))
+    ) < (1.4 + 2 / (d + 1)) * state.chi_n
+    h_sigma = h_sigma_cond.astype(jnp.float32)
+
+    p_c = (1 - state.c_c) * state.p_c + h_sigma * jnp.sqrt(
+        state.c_c * (2 - state.c_c) * state.mu_eff
+    ) * y_w
+
+    delta_h = (1 - h_sigma) * state.c_c * (2 - state.c_c)
+    rank_one = jnp.outer(p_c, p_c)
+    rank_mu = jnp.einsum("k,ki,kj->ij", w, y_k, y_k)
+    C_new = (
+        (1 + state.c_1 * delta_h - state.c_1 - state.c_mu * jnp.sum(w)) * state.C
+        + state.c_1 * rank_one
+        + state.c_mu * rank_mu
+    )
+    # Separable variant keeps only the diagonal.
+    C_new = jax.lax.cond(
+        state.sep,
+        lambda C: jnp.diag(jnp.diagonal(C)),
+        lambda C: 0.5 * (C + C.T),
+        C_new,
+    )
+
+    return state._replace(
+        mean=mean_new,
+        sigma=sigma_new,
+        C=C_new,
+        p_sigma=p_sigma,
+        p_c=p_c,
+        generation=state.generation + 1,
+    )
+
+
+@partial(jax.jit, static_argnames=("n",))
+def cma_tell_and_ask(
+    state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray, key: jax.Array, n: int
+) -> tuple[CmaState, jnp.ndarray]:
+    """Fused generation update + next-population sampling.
+
+    One device dispatch per *generation* instead of one per trial — on a
+    tunneled TPU each dispatch costs ~100ms of latency, so the whole ask/tell
+    cycle is a single XLA program and the per-trial path is pure host work.
+    """
+    new_state = cma_tell(state, X, fitness)
+    return new_state, cma_ask(new_state, key, n)
+
+
+# ------------------------------------------------------------- serialization
+
+
+def state_to_bytes(state: CmaState, extra: dict[str, np.ndarray] | None = None) -> bytes:
+    import io
+
+    arrays = {f"f{i}": np.asarray(leaf) for i, leaf in enumerate(state)}
+    for k, v in (extra or {}).items():
+        arrays[f"x_{k}"] = np.asarray(v)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def state_from_bytes(data: bytes) -> tuple[CmaState, dict[str, np.ndarray]]:
+    import io
+
+    with np.load(io.BytesIO(data)) as z:
+        leaves = [z[f"f{i}"] for i in range(len(CmaState._fields))]
+        extra = {k[2:]: z[k] for k in z.files if k.startswith("x_")}
+    return CmaState(*[jnp.asarray(a) for a in leaves]), extra

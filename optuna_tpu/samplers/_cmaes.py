@@ -1,0 +1,284 @@
+"""CMA-ES sampler with storage-externalized state.
+
+Parity target: ``optuna/samplers/_cmaes.py:50`` (``CmaEsSampler``): optimizer
+state serialized into system attrs in <=2045-char hex chunks and restored
+every trial, so the sampler is stateless across processes; solutions are
+generation-tagged; each completed generation triggers a ``tell``.
+
+The optimizer itself is :mod:`optuna_tpu.ops.cmaes` — jitted ask/tell with
+``eigh`` on device — instead of the reference's external NumPy ``cmaes``
+package. Supports full-covariance and separable (``use_separable_cma``)
+modes plus ``x0``/``sigma0`` warm starts.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import TYPE_CHECKING, Any, Sequence
+
+import numpy as np
+
+from optuna_tpu.distributions import BaseDistribution, CategoricalDistribution
+from optuna_tpu.logging import get_logger
+from optuna_tpu.samplers._base import BaseSampler
+from optuna_tpu.samplers._lazy_random_state import LazyRandomState
+from optuna_tpu.samplers._random import RandomSampler
+from optuna_tpu.search_space import IntersectionSearchSpace
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.transform import SearchSpaceTransform
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+_logger = get_logger(__name__)
+
+_GENERATION_KEY = "cma:generation"
+_X_KEY = "cma:x"
+_STATE_KEY_PREFIX = "cma:state"
+_MAX_CHUNK = 2045  # mirrors the reference's RDB varchar-safe chunking
+
+
+class CmaEsSampler(BaseSampler):
+    def __init__(
+        self,
+        x0: dict[str, Any] | None = None,
+        sigma0: float | None = None,
+        n_startup_trials: int = 1,
+        independent_sampler: BaseSampler | None = None,
+        warn_independent_sampling: bool = True,
+        seed: int | None = None,
+        *,
+        consider_pruned_trials: bool = False,
+        restart_strategy: str | None = None,
+        popsize: int | None = None,
+        inc_popsize: int = 2,
+        use_separable_cma: bool = False,
+        with_margin: bool = False,
+        lr_adapt: bool = False,
+    ) -> None:
+        self._x0 = x0
+        self._sigma0 = sigma0
+        self._n_startup_trials = n_startup_trials
+        self._independent_sampler = independent_sampler or RandomSampler(seed=seed)
+        self._warn_independent_sampling = warn_independent_sampling
+        self._rng = LazyRandomState(seed)
+        self._search_space = IntersectionSearchSpace()
+        self._consider_pruned_trials = consider_pruned_trials
+        self._restart_strategy = restart_strategy
+        self._popsize = popsize
+        self._inc_popsize = inc_popsize
+        self._use_separable_cma = use_separable_cma
+        self._with_margin = with_margin
+        self._lr_adapt = lr_adapt
+        if restart_strategy is not None and restart_strategy not in ("ipop", "bipop"):
+            raise ValueError("restart_strategy must be one of 'ipop', 'bipop' or None.")
+        for flag, name in ((with_margin, "with_margin"),
+                           (restart_strategy is not None, "restart_strategy"),
+                           (lr_adapt, "lr_adapt")):
+            if flag:
+                _logger.warning(
+                    f"`{name}` is accepted for API compatibility but not yet active "
+                    "in this version; the option currently has no effect."
+                )
+
+    def reseed_rng(self) -> None:
+        self._rng.seed()
+        self._independent_sampler.reseed_rng()
+
+    def _seed_value(self) -> int:
+        if not hasattr(self, "_derived_seed"):
+            self._derived_seed = int(self._rng.rng.randint(0, 2**31 - 1))
+        return self._derived_seed
+
+    # ----------------------------------------------------------- search space
+
+    def infer_relative_search_space(
+        self, study: "Study", trial: FrozenTrial
+    ) -> dict[str, BaseDistribution]:
+        search_space: dict[str, BaseDistribution] = {}
+        for name, distribution in self._search_space.calculate(study).items():
+            if distribution.single():
+                continue
+            if isinstance(distribution, CategoricalDistribution):
+                # CMA-ES is a continuous optimizer (reference skips these too).
+                continue
+            search_space[name] = distribution
+        return search_space
+
+    # --------------------------------------------------------------- sampling
+
+    def sample_relative(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        search_space: dict[str, BaseDistribution],
+    ) -> dict[str, Any]:
+        self._raise_error_if_multi_objective(study)
+        if len(search_space) == 0:
+            return {}
+        if len(search_space) == 1:
+            _logger.info(
+                "CMA-ES does not support one-dimensional spaces; falling back "
+                "to the independent sampler."
+            )
+            return {}
+
+        import jax
+
+        from optuna_tpu.ops import cmaes as cma_ops
+
+        completed = self._completed_trials(study)
+        if len(completed) < self._n_startup_trials:
+            return {}
+
+        trans = SearchSpaceTransform(search_space, transform_0_1=True)
+        dim = len(trans.bounds)
+        popsize = self._popsize or cma_ops.default_popsize(dim)
+
+        restored = self._restore_state(study)
+        if restored is not None and (
+            restored[0].mean.shape[0] != dim or restored[1].shape[1] != dim
+        ):
+            # Dynamic define-by-run space changed dimensionality: the stored
+            # optimizer no longer matches (reference _cmaes.py:414 guard).
+            _logger.warning(
+                "The CMA-ES optimizer dimension no longer matches the search "
+                "space; restarting the optimizer."
+            )
+            restored = None
+        if restored is None:
+            mean0 = self._initial_mean(trans, search_space)
+            sigma0 = self._sigma0 or 0.3  # [0,1]-normalized space
+            state = cma_ops.cma_init(
+                mean0, sigma0, popsize=popsize, sep=self._use_separable_cma
+            )
+            key = jax.random.fold_in(jax.random.PRNGKey(self._seed_value()), 0)
+            queue = np.asarray(cma_ops.cma_ask(state, key, popsize), dtype=np.float64)
+            self._store_state(study, state, queue)
+        else:
+            state, queue = restored
+
+        # Tell when the current generation has a full set of completed
+        # solutions; fused tell+ask = ONE device dispatch per generation (the
+        # per-trial path below is pure host work).
+        gen = int(np.asarray(state.generation))
+        gen_trials = [
+            t
+            for t in completed
+            if t.system_attrs.get(_GENERATION_KEY) == gen
+            and _X_KEY in t.system_attrs
+            and t.values is not None  # pruned trials without reports carry no value
+        ]
+        if len(gen_trials) >= popsize:
+            gen_trials = gen_trials[:popsize]
+            X = np.asarray([t.system_attrs[_X_KEY] for t in gen_trials], dtype=np.float32)
+            sign = 1.0 if study.direction == StudyDirection.MINIMIZE else -1.0
+            fitness = np.asarray([sign * t.value for t in gen_trials], dtype=np.float32)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed_value()), gen + 1
+            )
+            state, queue_j = cma_ops.cma_tell_and_ask(
+                state, X, fitness, key, popsize
+            )
+            queue = np.asarray(queue_j, dtype=np.float64)
+            self._store_state(study, state, queue)
+            gen = int(np.asarray(state.generation))
+
+        # Pop the next queued solution: index = how many trials this
+        # generation already claimed (completed or running).
+        all_trials = study._get_trials(deepcopy=False, use_cache=True)
+        n_claimed = sum(
+            1 for t in all_trials if t.system_attrs.get(_GENERATION_KEY) == gen
+        )
+        x = queue[n_claimed % popsize]
+
+        study._storage.set_trial_system_attr(trial._trial_id, _GENERATION_KEY, gen)
+        study._storage.set_trial_system_attr(trial._trial_id, _X_KEY, x.tolist())
+        return trans.untransform(x)
+
+    def _initial_mean(
+        self, trans: SearchSpaceTransform, search_space: dict[str, BaseDistribution]
+    ) -> np.ndarray:
+        if self._x0 is None:
+            return np.full(len(trans.bounds), 0.5)
+        return trans.transform({**{k: v for k, v in self._x0.items()}})
+
+    def _completed_trials(self, study: "Study") -> list[FrozenTrial]:
+        states = [TrialState.COMPLETE]
+        if self._consider_pruned_trials:
+            states.append(TrialState.PRUNED)
+        return study._get_trials(deepcopy=False, states=tuple(states), use_cache=True)
+
+    # ----------------------------------------------------------- state attrs
+
+    def _attr_key(self) -> str:
+        variant = "sep" if self._use_separable_cma else "full"
+        return f"{_STATE_KEY_PREFIX}:{variant}"
+
+    def _store_state(self, study: "Study", state, queue: np.ndarray) -> None:
+        from optuna_tpu.ops.cmaes import state_to_bytes
+
+        payload = state_to_bytes(state, extra={"queue": queue})
+        hexstr = payload.hex()
+        chunks = [hexstr[i : i + _MAX_CHUNK] for i in range(0, len(hexstr), _MAX_CHUNK)]
+        key = self._attr_key()
+        study._storage.set_study_system_attr(study._study_id, f"{key}:n", len(chunks))
+        for i, chunk in enumerate(chunks):
+            study._storage.set_study_system_attr(study._study_id, f"{key}:{i}", chunk)
+        self._state_cache = (hexstr, (state, queue))
+
+    def _restore_state(self, study: "Study"):
+        from optuna_tpu.ops.cmaes import state_from_bytes
+
+        attrs = study._storage.get_study_system_attrs(study._study_id)
+        key = self._attr_key()
+        n = attrs.get(f"{key}:n")
+        if n is None:
+            return None
+        try:
+            hexstr = "".join(attrs[f"{key}:{i}"] for i in range(n))
+            cached = getattr(self, "_state_cache", None)
+            if cached is not None and cached[0] == hexstr:
+                return cached[1]
+            state, extra = state_from_bytes(bytes.fromhex(hexstr))
+            result = (state, np.asarray(extra["queue"]))
+            self._state_cache = (hexstr, result)
+            return result
+        except (KeyError, ValueError):
+            _logger.warning("Broken CMA-ES state attrs; restarting the optimizer.")
+            return None
+
+    # ------------------------------------------------------------ independent
+
+    def sample_independent(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        param_name: str,
+        param_distribution: BaseDistribution,
+    ) -> Any:
+        completed = self._completed_trials(study)
+        if len(completed) >= self._n_startup_trials and self._warn_independent_sampling:
+            _logger.warning(
+                f"The parameter '{param_name}' in trial#{trial.number} is sampled "
+                "independently by using `{}` instead of `CmaEsSampler`.".format(
+                    self._independent_sampler.__class__.__name__
+                )
+            )
+        return self._independent_sampler.sample_independent(
+            study, trial, param_name, param_distribution
+        )
+
+    def before_trial(self, study: "Study", trial: FrozenTrial) -> None:
+        self._independent_sampler.before_trial(study, trial)
+
+    def after_trial(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        state: TrialState,
+        values: Sequence[float] | None,
+    ) -> None:
+        self._independent_sampler.after_trial(study, trial, state, values)

@@ -1,0 +1,3 @@
+from optuna_tpu.samplers._ga._base import BaseGASampler
+
+__all__ = ["BaseGASampler"]

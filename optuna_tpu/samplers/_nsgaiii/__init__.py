@@ -1,0 +1,3 @@
+from optuna_tpu.samplers._nsgaiii._sampler import NSGAIIISampler
+
+__all__ = ["NSGAIIISampler"]

@@ -1,0 +1,21 @@
+from optuna_tpu.samplers.nsgaii._crossovers import (
+    BLXAlphaCrossover,
+    BaseCrossover,
+    SBXCrossover,
+    SPXCrossover,
+    UNDXCrossover,
+    UniformCrossover,
+    VSBXCrossover,
+)
+from optuna_tpu.samplers.nsgaii._sampler import NSGAIISampler
+
+__all__ = [
+    "BLXAlphaCrossover",
+    "BaseCrossover",
+    "NSGAIISampler",
+    "SBXCrossover",
+    "SPXCrossover",
+    "UNDXCrossover",
+    "UniformCrossover",
+    "VSBXCrossover",
+]

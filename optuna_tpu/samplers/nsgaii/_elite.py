@@ -1,0 +1,76 @@
+"""Elite population selection for NSGA-II: nondominated sort + crowding.
+
+Parity target: ``optuna/samplers/nsgaii/_elite_population_selection_strategy.py``
+(rank selection ``:23``, crowding-distance truncation ``:66,120``) with
+constrained domination (``nsgaii/_constraints_evaluation.py:19``).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Sequence
+
+import numpy as np
+
+from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
+from optuna_tpu.study._multi_objective import _fast_non_domination_rank, _normalize_values
+from optuna_tpu.trial._frozen import FrozenTrial
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+
+def _constraint_penalty(trials: Sequence[FrozenTrial]) -> np.ndarray | None:
+    """Total violation per trial, or None when no trial carries constraints."""
+    if not any(_CONSTRAINTS_KEY in t.system_attrs for t in trials):
+        return None
+    penalty = np.empty(len(trials))
+    for i, t in enumerate(trials):
+        constraints = t.system_attrs.get(_CONSTRAINTS_KEY)
+        if constraints is None:
+            penalty[i] = np.nan  # missing constraints rank behind infeasible
+        else:
+            penalty[i] = sum(max(c, 0.0) for c in constraints)
+    return penalty
+
+
+def crowding_distance(values: np.ndarray) -> np.ndarray:
+    """Crowding distance per point (inf at objective extremes)."""
+    n, m = values.shape
+    if n <= 2:
+        return np.full(n, np.inf)
+    dist = np.zeros(n)
+    for j in range(m):
+        order = np.argsort(values[:, j], kind="stable")
+        vmin, vmax = values[order[0], j], values[order[-1], j]
+        dist[order[0]] = dist[order[-1]] = np.inf
+        if vmax > vmin:
+            gaps = (values[order[2:], j] - values[order[:-2], j]) / (vmax - vmin)
+            dist[order[1:-1]] += gaps
+    return dist
+
+
+def select_elite_population(
+    study: "Study", trials: list[FrozenTrial], population_size: int
+) -> list[FrozenTrial]:
+    if len(trials) <= population_size:
+        return list(trials)
+    values = _normalize_values(
+        np.asarray([t.values for t in trials], dtype=np.float64), study.directions
+    )
+    penalty = _constraint_penalty(trials)
+    ranks = _fast_non_domination_rank(values, penalty=penalty, n_below=population_size)
+
+    elite_idx: list[int] = []
+    for r in np.unique(ranks):
+        members = np.flatnonzero(ranks == r)
+        if len(elite_idx) + len(members) <= population_size:
+            elite_idx.extend(members.tolist())
+            continue
+        k = population_size - len(elite_idx)
+        if k > 0:
+            # Boundary rank: keep the k most spread-out members.
+            dist = crowding_distance(values[members])
+            keep = members[np.argsort(-dist, kind="stable")[:k]]
+            elite_idx.extend(keep.tolist())
+        break
+    return [trials[i] for i in elite_idx]

@@ -224,6 +224,13 @@ class Study:
             trial._suggest(name, param)
 
         self.sampler.before_trial(self, trial._cached_frozen_trial)
+        # before_trial may have written trial system attrs through the storage
+        # (e.g. GridSampler's grid id); refresh the cached snapshot so
+        # subsequent suggest calls see them (the reference achieves the same
+        # with its _LazyTrialSystemAttrs, ``_trial.py:822``).
+        trial._cached_frozen_trial.system_attrs = self._storage.get_trial(
+            trial._trial_id
+        ).system_attrs
         return trial
 
     def tell(

@@ -1,18 +1,23 @@
 """Test harness config.
 
-Force an 8-device virtual CPU platform BEFORE jax initializes so every
-sharding/pmap test exercises a fake pod, mirroring how the reference tests
-multi-node behaviour without a cluster (SURVEY.md §4).
+Force an 8-device virtual CPU platform so every sharding/pmap test exercises
+a fake pod, mirroring how the reference tests multi-node behaviour without a
+cluster (SURVEY.md §4).
+
+NOTE: in the axon environment, a sitecustomize imports jax at interpreter
+startup and pins JAX_PLATFORMS=axon (remote TPU with ~100ms per-dispatch
+tunnel latency) — so setting env vars here is too late. ``jax.config.update``
+works post-import as long as no backend has been initialized yet, which is
+guaranteed at conftest time.
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# Keep compile times sane in tests.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-# Persistent compile cache: sampler kernels re-jit per shape bucket; caching
-# them across test runs cuts suite time dramatically.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/optuna_tpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,106 @@
+"""CMA-ES tests (mirrors reference tests/samplers_tests/test_cmaes.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import optuna_tpu
+from optuna_tpu.ops import cmaes as cma_ops
+from optuna_tpu.samplers import CmaEsSampler
+
+
+def test_cma_core_converges_on_sphere():
+    state = cma_ops.cma_init(np.full(4, 0.8), 0.3, popsize=12)
+    key = jax.random.PRNGKey(0)
+    target = np.array([0.3, 0.4, 0.5, 0.6])
+    for g in range(60):
+        key, sub = jax.random.split(key)
+        X = np.asarray(cma_ops.cma_ask(state, sub, 12))
+        fit = np.sum((X - target) ** 2, axis=1).astype(np.float32)
+        state = cma_ops.cma_tell(state, X, fit)
+    assert float(np.sum((np.asarray(state.mean) - target) ** 2)) < 1e-3
+
+
+def test_cma_sep_mode_diagonal():
+    state = cma_ops.cma_init(np.full(3, 0.5), 0.3, popsize=8, sep=True)
+    key = jax.random.PRNGKey(1)
+    for g in range(10):
+        key, sub = jax.random.split(key)
+        X = np.asarray(cma_ops.cma_ask(state, sub, 8))
+        fit = np.sum(X**2, axis=1).astype(np.float32)
+        state = cma_ops.cma_tell(state, X, fit)
+    C = np.asarray(state.C)
+    off_diag = C - np.diag(np.diagonal(C))
+    assert np.allclose(off_diag, 0.0)
+
+
+def test_cma_state_roundtrip():
+    state = cma_ops.cma_init(np.full(3, 0.5), 0.3, popsize=8)
+    queue = np.random.RandomState(0).uniform(size=(8, 3))
+    blob = cma_ops.state_to_bytes(state, extra={"queue": queue})
+    state2, extra = cma_ops.state_from_bytes(blob)
+    np.testing.assert_allclose(np.asarray(state.C), np.asarray(state2.C))
+    np.testing.assert_allclose(extra["queue"], queue)
+
+
+def test_cmaes_sampler_optimizes():
+    def sphere(t):
+        return sum((t.suggest_float(f"x{i}", -5, 5) - 1.0) ** 2 for i in range(5))
+
+    study = optuna_tpu.create_study(sampler=CmaEsSampler(seed=1))
+    study.optimize(sphere, n_trials=250)
+    assert study.best_value < 0.1
+
+
+def test_cmaes_sampler_maximize():
+    study = optuna_tpu.create_study(
+        direction="maximize", sampler=CmaEsSampler(seed=2)
+    )
+    study.optimize(
+        lambda t: -sum((t.suggest_float(f"x{i}", -3, 3) - 0.5) ** 2 for i in range(3)),
+        n_trials=150,
+    )
+    assert study.best_value > -0.1
+
+
+def test_cmaes_sampler_resumes_from_storage():
+    # Two sampler instances against the same storage: the optimizer state
+    # lives in study system attrs, so worker #2 continues the run.
+    storage = optuna_tpu.storages.InMemoryStorage()
+
+    def sphere(t):
+        return sum((t.suggest_float(f"x{i}", -5, 5)) ** 2 for i in range(4))
+
+    s1 = optuna_tpu.create_study(study_name="cma", storage=storage, sampler=CmaEsSampler(seed=3))
+    s1.optimize(sphere, n_trials=60)
+    s2 = optuna_tpu.create_study(
+        study_name="cma", storage=storage, sampler=CmaEsSampler(seed=3), load_if_exists=True
+    )
+    s2.optimize(sphere, n_trials=60)
+    assert len(s2.trials) == 120
+    attrs = storage.get_study_system_attrs(s2._study_id)
+    assert any(k.startswith("cma:state") for k in attrs)
+
+
+def test_cmaes_sampler_int_and_single_fallback():
+    def obj(t):
+        x = t.suggest_float("x", -2, 2)
+        i = t.suggest_int("i", 0, 8)
+        c = t.suggest_categorical("c", ["a", "b"])  # independent fallback
+        return x * x + abs(i - 3) + (0 if c == "a" else 1)
+
+    study = optuna_tpu.create_study(
+        sampler=CmaEsSampler(seed=4, warn_independent_sampling=False)
+    )
+    study.optimize(obj, n_trials=120)
+    assert study.best_value < 2.5
+    assert isinstance(study.best_params["i"], int)
+
+
+def test_cmaes_multi_objective_rejected():
+    study = optuna_tpu.create_study(
+        directions=["minimize", "minimize"], sampler=CmaEsSampler(seed=5)
+    )
+    with pytest.raises(ValueError):
+        study.optimize(lambda t: (t.suggest_float("x", 0, 1), 0.0), n_trials=2)

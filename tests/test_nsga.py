@@ -1,0 +1,146 @@
+"""NSGA-II/III tests (mirrors reference tests/samplers_tests/test_nsgaii/iii)."""
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu.hypervolume import compute_hypervolume
+from optuna_tpu.samplers import NSGAIISampler, NSGAIIISampler
+from optuna_tpu.samplers.nsgaii import (
+    BLXAlphaCrossover,
+    SBXCrossover,
+    SPXCrossover,
+    UNDXCrossover,
+    UniformCrossover,
+    VSBXCrossover,
+)
+from optuna_tpu.samplers.nsgaii._elite import crowding_distance
+from optuna_tpu.samplers._nsgaiii._sampler import generate_default_reference_point
+
+
+def zdt1(trial):
+    n = 8
+    xs = [trial.suggest_float(f"x{i}", 0, 1) for i in range(n)]
+    f1 = xs[0]
+    g = 1 + 9 * sum(xs[1:]) / (n - 1)
+    f2 = g * (1 - (f1 / g) ** 0.5)
+    return f1, f2
+
+
+def test_nsgaii_improves_hypervolume_on_zdt1():
+    sampler = NSGAIISampler(population_size=20, seed=0)
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"], sampler=sampler)
+    study.optimize(zdt1, n_trials=200)
+
+    ref = np.array([1.1, 10.0])
+    all_vals = np.asarray([t.values for t in study.trials])
+    hv_final = compute_hypervolume(all_vals, ref)
+    hv_initial = compute_hypervolume(all_vals[:20], ref)
+    assert hv_final > hv_initial  # front advanced beyond random init
+    assert len(study.best_trials) >= 5
+
+
+def test_nsgaii_generation_tags():
+    sampler = NSGAIISampler(population_size=10, seed=1)
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"], sampler=sampler)
+    study.optimize(lambda t: (t.suggest_float("x", 0, 1), t.suggest_float("y", 0, 1)), n_trials=35)
+    gens = [t.system_attrs.get("NSGAIISampler:generation") for t in study.trials]
+    assert gens[:10] == [0] * 10
+    assert max(gens) >= 2
+
+
+@pytest.mark.parametrize(
+    "crossover",
+    [
+        UniformCrossover(),
+        BLXAlphaCrossover(),
+        SPXCrossover(),
+        SBXCrossover(),
+        VSBXCrossover(),
+        UNDXCrossover(),
+    ],
+)
+def test_nsgaii_crossovers_run(crossover):
+    sampler = NSGAIISampler(population_size=8, seed=2, crossover=crossover)
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"], sampler=sampler)
+    study.optimize(
+        lambda t: (t.suggest_float("x", 0, 1), 1 - t.suggest_float("x", 0, 1)),
+        n_trials=25,
+    )
+    assert len(study.trials) == 25
+
+
+def test_crossover_output_shapes():
+    rng = np.random.RandomState(0)
+    bounds = np.array([[0.0, 1.0]] * 4)
+    for cx in [UniformCrossover(), BLXAlphaCrossover(), SBXCrossover(), VSBXCrossover()]:
+        parents = rng.uniform(0, 1, (2, 4))
+        child = cx.crossover(parents, rng, bounds)
+        assert child.shape == (4,)
+    for cx in [SPXCrossover(), UNDXCrossover()]:
+        parents = rng.uniform(0, 1, (3, 4))
+        child = cx.crossover(parents, rng, bounds)
+        assert child.shape == (4,)
+
+
+def test_nsgaii_constraints():
+    def constraints(trial):
+        return (trial.params["x"] - 0.5,)  # feasible iff x <= 0.5
+
+    sampler = NSGAIISampler(population_size=10, seed=3, constraints_func=constraints)
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"], sampler=sampler)
+    study.optimize(
+        lambda t: (t.suggest_float("x", 0, 1), 1 - t.suggest_float("x", 0, 1)),
+        n_trials=50,
+    )
+    feasible_front = study.best_trials
+    for t in feasible_front:
+        assert t.params["x"] <= 0.5 + 1e-9
+
+
+def test_nsgaii_mixed_space():
+    def obj(t):
+        x = t.suggest_float("x", 0, 1)
+        c = t.suggest_categorical("c", ["a", "b"])
+        i = t.suggest_int("i", 0, 5)
+        return x + i / 5, (1 - x) + (0 if c == "a" else 0.2)
+
+    sampler = NSGAIISampler(population_size=10, seed=4)
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"], sampler=sampler)
+    study.optimize(obj, n_trials=40)
+    assert len(study.trials) == 40
+
+
+def test_crowding_distance_extremes_inf():
+    vals = np.array([[0.0, 1.0], [0.5, 0.5], [1.0, 0.0], [0.6, 0.6]])
+    d = crowding_distance(vals)
+    assert np.isinf(d[0]) and np.isinf(d[2])
+    assert np.isfinite(d[1]) or np.isinf(d[1])  # middle points finite-or-edge
+    assert d[3] <= d[1] + 1e-12 or np.isinf(d[1])
+
+
+def test_das_dennis_reference_points():
+    pts = generate_default_reference_point(3, 4)
+    # C(3+4-1-1, 3-1) = C(5, 2)... lattice count = C(m+p-1, p) = C(6,4)=15
+    assert pts.shape == (15, 3)
+    np.testing.assert_allclose(pts.sum(axis=1), 1.0)
+    assert np.all(pts >= 0)
+
+
+def test_nsgaiii_runs_three_objectives():
+    def dtlz(trial):
+        x = [trial.suggest_float(f"x{i}", 0, 1) for i in range(5)]
+        return x[0], x[1], 3 - x[0] - x[1] + sum(x[2:])
+
+    sampler = NSGAIIISampler(population_size=12, seed=5)
+    study = optuna_tpu.create_study(
+        directions=["minimize"] * 3, sampler=sampler
+    )
+    study.optimize(dtlz, n_trials=50)
+    assert len(study.trials) == 50
+    assert len(study.best_trials) >= 3
+
+
+def test_nsgaii_default_for_multiobjective():
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"])
+    assert type(study.sampler).__name__ == "NSGAIISampler"

@@ -1,0 +1,154 @@
+"""Sampler-agnostic contract suite (mirrors reference
+optuna/testing/pytest_samplers.py + tests/samplers_tests/test_samplers.py:
+suggest float/int/categorical, dynamic spaces, conditional params, nan
+objectives, relative sampling — run against every sampler)."""
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import TrialState, create_study
+from optuna_tpu.samplers import (
+    BruteForceSampler,
+    CmaEsSampler,
+    GPSampler,
+    GridSampler,
+    NSGAIISampler,
+    PartialFixedSampler,
+    QMCSampler,
+    RandomSampler,
+    TPESampler,
+)
+
+parametrize_sampler = pytest.mark.parametrize(
+    "sampler_factory",
+    [
+        lambda: RandomSampler(seed=0),
+        lambda: TPESampler(seed=0, n_startup_trials=2),
+        lambda: TPESampler(seed=0, n_startup_trials=2, multivariate=True),
+        lambda: GPSampler(seed=0, n_startup_trials=3),
+        lambda: CmaEsSampler(seed=0, warn_independent_sampling=False),
+        lambda: QMCSampler(seed=0, warn_independent_sampling=False),
+        lambda: PartialFixedSampler({"x": 0.5}, RandomSampler(seed=0)),
+    ],
+    ids=["random", "tpe", "tpe-mv", "gp", "cmaes", "qmc", "partial-fixed"],
+)
+
+
+@parametrize_sampler
+def test_sampler_suggest_all_types(sampler_factory):
+    def objective(trial):
+        x = trial.suggest_float("x", 0, 1)
+        lx = trial.suggest_float("lx", 1e-3, 1e3, log=True)
+        sx = trial.suggest_float("sx", 0, 1, step=0.25)
+        i = trial.suggest_int("i", 0, 10)
+        li = trial.suggest_int("li", 1, 64, log=True)
+        c = trial.suggest_categorical("c", ["a", "b", None])
+        assert 0 <= x <= 1
+        assert 1e-3 <= lx <= 1e3
+        assert sx in [0.0, 0.25, 0.5, 0.75, 1.0]
+        assert 0 <= i <= 10 and isinstance(i, int)
+        assert 1 <= li <= 64 and isinstance(li, int)
+        assert c in ("a", "b", None)
+        return x + i
+
+    study = create_study(sampler=sampler_factory())
+    study.optimize(objective, n_trials=12)
+    assert len(study.trials) == 12
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+
+@parametrize_sampler
+def test_sampler_conditional_params(sampler_factory):
+    def objective(trial):
+        category = trial.suggest_categorical("cat", ["linear", "tree"])
+        if category == "linear":
+            lr = trial.suggest_float("lr", 1e-4, 1e-1, log=True)
+            return lr
+        depth = trial.suggest_int("depth", 1, 10)
+        return depth / 10
+
+    study = create_study(sampler=sampler_factory())
+    study.optimize(objective, n_trials=12)
+    assert len(study.trials) == 12
+
+
+@parametrize_sampler
+def test_sampler_nan_objective(sampler_factory):
+    def objective(trial):
+        x = trial.suggest_float("x", 0, 1)
+        return float("nan") if trial.number % 3 == 0 else x
+
+    study = create_study(sampler=sampler_factory())
+    study.optimize(objective, n_trials=9, catch=())
+    states = [t.state for t in study.trials]
+    assert states.count(TrialState.FAIL) == 3
+    assert states.count(TrialState.COMPLETE) == 6
+
+
+def test_grid_sampler_exhausts():
+    sampler = GridSampler({"x": [0, 1, 2], "y": [10.0, 20.0]}, seed=0)
+    study = create_study(sampler=sampler)
+    study.optimize(lambda t: t.suggest_int("x", 0, 2) + t.suggest_float("y", 10, 20), n_trials=50)
+    # 6 combinations; the sampler stops the study when exhausted.
+    assert len(study.trials) == 6
+    seen = {(t.params["x"], t.params["y"]) for t in study.trials}
+    assert len(seen) == 6
+
+
+def test_grid_sampler_out_of_grid_param():
+    sampler = GridSampler({"x": [0, 1]}, seed=0)
+    study = create_study(sampler=sampler)
+    with pytest.raises(ValueError):
+        study.optimize(lambda t: t.suggest_float("z", 0, 1), n_trials=1)
+
+
+def test_brute_force_exhausts_space():
+    study = create_study(sampler=BruteForceSampler(seed=0))
+    study.optimize(
+        lambda t: t.suggest_int("i", 0, 2) + (0 if t.suggest_categorical("c", ["a", "b"]) == "a" else 10),
+        n_trials=100,
+    )
+    assert len(study.trials) == 6
+    seen = {(t.params["i"], t.params["c"]) for t in study.trials}
+    assert len(seen) == 6
+
+
+def test_brute_force_dynamic_space():
+    def objective(trial):
+        x = trial.suggest_int("x", 0, 1)
+        if x == 0:
+            return trial.suggest_int("y", 0, 1)
+        return trial.suggest_int("z", 0, 2) * 0.1
+
+    study = create_study(sampler=BruteForceSampler(seed=1))
+    study.optimize(objective, n_trials=100)
+    # x=0 -> 2 leaves; x=1 -> 3 leaves
+    assert len(study.trials) == 5
+
+
+def test_brute_force_float_requires_step():
+    study = create_study(sampler=BruteForceSampler(seed=0))
+    with pytest.raises(ValueError):
+        study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=1)
+
+
+def test_qmc_sampler_low_discrepancy():
+    # QMC points should cover [0,1]^2 more evenly than random: check every
+    # quadrant is hit within 16 trials.
+    sampler = QMCSampler(seed=7, warn_independent_sampling=False, warn_asynchronous_seeding=False)
+    study = create_study(sampler=sampler)
+    study.optimize(
+        lambda t: t.suggest_float("a", 0, 1) + t.suggest_float("b", 0, 1), n_trials=17
+    )
+    pts = np.asarray([[t.params["a"], t.params["b"]] for t in study.trials[1:]])
+    quadrants = set(zip((pts[:, 0] > 0.5).tolist(), (pts[:, 1] > 0.5).tolist()))
+    assert len(quadrants) == 4
+
+
+def test_partial_fixed_sampler_pins_param():
+    sampler = PartialFixedSampler({"x": 0.25}, RandomSampler(seed=0))
+    study = create_study(sampler=sampler)
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) + t.suggest_float("y", 0, 1), n_trials=5)
+    assert all(t.params["x"] == 0.25 for t in study.trials)
+    assert len({t.params["y"] for t in study.trials}) > 1
