@@ -224,9 +224,15 @@ class CmaEsSampler(BaseSampler):
         hexstr = payload.hex()
         chunks = [hexstr[i : i + _MAX_CHUNK] for i in range(0, len(hexstr), _MAX_CHUNK)]
         key = self._attr_key()
-        study._storage.set_study_system_attr(study._study_id, f"{key}:n", len(chunks))
+        # Version-stamped double buffer: chunks land under slot ver=gen%2 and
+        # only then does the head pointer flip, so a concurrent reader either
+        # sees the previous complete version or the new one — never a mix.
+        ver = int(np.asarray(state.generation)) % 2
         for i, chunk in enumerate(chunks):
-            study._storage.set_study_system_attr(study._study_id, f"{key}:{i}", chunk)
+            study._storage.set_study_system_attr(study._study_id, f"{key}:{ver}:{i}", chunk)
+        study._storage.set_study_system_attr(
+            study._study_id, f"{key}:head", {"ver": ver, "n": len(chunks)}
+        )
         self._state_cache = (hexstr, (state, queue))
 
     def _restore_state(self, study: "Study"):
@@ -234,11 +240,11 @@ class CmaEsSampler(BaseSampler):
 
         attrs = study._storage.get_study_system_attrs(study._study_id)
         key = self._attr_key()
-        n = attrs.get(f"{key}:n")
-        if n is None:
+        head = attrs.get(f"{key}:head")
+        if head is None:
             return None
         try:
-            hexstr = "".join(attrs[f"{key}:{i}"] for i in range(n))
+            hexstr = "".join(attrs[f"{key}:{head['ver']}:{i}"] for i in range(head["n"]))
             cached = getattr(self, "_state_cache", None)
             if cached is not None and cached[0] == hexstr:
                 return cached[1]
@@ -246,7 +252,7 @@ class CmaEsSampler(BaseSampler):
             result = (state, np.asarray(extra["queue"]))
             self._state_cache = (hexstr, result)
             return result
-        except (KeyError, ValueError):
+        except Exception:  # corrupt/racing attrs of any flavor -> clean restart
             _logger.warning("Broken CMA-ES state attrs; restarting the optimizer.")
             return None
 

@@ -36,7 +36,6 @@ class GridSampler(BaseSampler):
                 self._check_value(param_name, value)
         self._search_space = {k: list(v) for k, v in search_space.items()}
         self._all_grids = list(itertools.product(*self._search_space.values()))
-        self._param_names = sorted(self._search_space.keys())
         self._n_min_trials = len(self._all_grids)
         self._rng = LazyRandomState(seed)
 

@@ -191,8 +191,8 @@ class NSGAIISampler(BaseGASampler):
             if isinstance(dist, CategoricalDistribution):
                 donors = [p for p in parents if name in p.params]
                 if donors:
-                    pick = donors[0 if rng.rand() >= self._swapping_prob or len(donors) == 1 else 1]
-                    child[name] = pick.params[name]
+                    # Uniform per-gene parent choice (all parents eligible).
+                    child[name] = donors[rng.randint(len(donors))].params[name]
         return child
 
     def sample_independent(

@@ -1,0 +1,4 @@
+from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+from optuna_tpu.storages._grpc.server import run_grpc_proxy_server
+
+__all__ = ["GrpcStorageProxy", "run_grpc_proxy_server"]

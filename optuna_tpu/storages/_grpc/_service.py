@@ -1,0 +1,58 @@
+"""Shared wire definition for the gRPC storage proxy.
+
+Parity target: ``optuna/storages/_grpc/`` (proto service + servicer +
+client). The reference generates protobuf stubs with protoc; this
+environment has the gRPC C-core runtime but no Python codegen plugin, so the
+service is defined through grpc's *generic handler* API with a
+pickle-based serializer — same HTTP/2 transport and fan-out properties,
+no generated code.
+
+Every storage method is one unary-unary RPC: request = (method_name,
+args tuple), response = (ok, payload-or-exception).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+SERVICE_NAME = "optuna_tpu.StorageProxy"
+
+# The BaseStorage surface exposed over the wire.
+METHODS = (
+    "create_new_study",
+    "delete_study",
+    "set_study_user_attr",
+    "set_study_system_attr",
+    "get_study_id_from_name",
+    "get_study_name_from_id",
+    "get_study_directions",
+    "get_study_user_attrs",
+    "get_study_system_attrs",
+    "get_all_studies",
+    "create_new_trial",
+    "set_trial_param",
+    "get_trial_id_from_study_id_trial_number",
+    "get_trial_number_from_id",
+    "get_trial_param",
+    "set_trial_state_values",
+    "set_trial_intermediate_value",
+    "set_trial_user_attr",
+    "set_trial_system_attr",
+    "get_trial",
+    "get_all_trials",
+    "get_n_trials",
+    "get_best_trial",
+    "record_heartbeat",
+    "_get_stale_trial_ids",
+    "get_heartbeat_interval",
+    "get_failed_trial_callback",
+)
+
+
+def serialize(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,151 @@
+"""gRPC storage proxy client implementing BaseStorage over a channel.
+
+Parity target: ``optuna/storages/_grpc/client.py:46`` — every storage call
+becomes one RPC; server-side exceptions are re-raised locally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Container, Sequence
+
+from optuna_tpu.distributions import BaseDistribution
+from optuna_tpu.storages._base import BaseStorage
+from optuna_tpu.storages._grpc._service import SERVICE_NAME, deserialize, serialize
+from optuna_tpu.storages._heartbeat import BaseHeartbeat
+from optuna_tpu.study._frozen import FrozenStudy
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+
+class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
+    def __init__(self, *, host: str = "localhost", port: int = 13000) -> None:
+        self._host = host
+        self._port = port
+        self._channel = None
+        self._setup()
+
+    def _setup(self) -> None:
+        import grpc
+
+        self._channel = grpc.insecure_channel(f"{self._host}:{self._port}")
+
+    def __getstate__(self) -> dict[str, Any]:
+        state = self.__dict__.copy()
+        state["_channel"] = None
+        return state
+
+    def __setstate__(self, state: dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._setup()
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        assert self._channel is not None
+        rpc = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/{method}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        ok, payload = deserialize(rpc(serialize((method, args, kwargs))))
+        if not ok:
+            raise payload
+        return payload
+
+    def remove_session(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    # ------------------------------------------------------------------ study
+
+    def create_new_study(
+        self, directions: Sequence[StudyDirection], study_name: str | None = None
+    ) -> int:
+        return self._call("create_new_study", list(directions), study_name)
+
+    def delete_study(self, study_id: int) -> None:
+        self._call("delete_study", study_id)
+
+    def set_study_user_attr(self, study_id: int, key: str, value: Any) -> None:
+        self._call("set_study_user_attr", study_id, key, value)
+
+    def set_study_system_attr(self, study_id: int, key: str, value: Any) -> None:
+        self._call("set_study_system_attr", study_id, key, value)
+
+    def get_study_id_from_name(self, study_name: str) -> int:
+        return self._call("get_study_id_from_name", study_name)
+
+    def get_study_name_from_id(self, study_id: int) -> str:
+        return self._call("get_study_name_from_id", study_id)
+
+    def get_study_directions(self, study_id: int) -> list[StudyDirection]:
+        return self._call("get_study_directions", study_id)
+
+    def get_study_user_attrs(self, study_id: int) -> dict[str, Any]:
+        return self._call("get_study_user_attrs", study_id)
+
+    def get_study_system_attrs(self, study_id: int) -> dict[str, Any]:
+        return self._call("get_study_system_attrs", study_id)
+
+    def get_all_studies(self) -> list[FrozenStudy]:
+        return self._call("get_all_studies")
+
+    # ------------------------------------------------------------------ trial
+
+    def create_new_trial(self, study_id: int, template_trial: FrozenTrial | None = None) -> int:
+        return self._call("create_new_trial", study_id, template_trial)
+
+    def set_trial_param(
+        self,
+        trial_id: int,
+        param_name: str,
+        param_value_internal: float,
+        distribution: BaseDistribution,
+    ) -> None:
+        self._call("set_trial_param", trial_id, param_name, param_value_internal, distribution)
+
+    def get_trial_id_from_study_id_trial_number(self, study_id: int, trial_number: int) -> int:
+        return self._call("get_trial_id_from_study_id_trial_number", study_id, trial_number)
+
+    def set_trial_state_values(
+        self, trial_id: int, state: TrialState, values: Sequence[float] | None = None
+    ) -> bool:
+        return self._call("set_trial_state_values", trial_id, state, values)
+
+    def set_trial_intermediate_value(
+        self, trial_id: int, step: int, intermediate_value: float
+    ) -> None:
+        self._call("set_trial_intermediate_value", trial_id, step, intermediate_value)
+
+    def set_trial_user_attr(self, trial_id: int, key: str, value: Any) -> None:
+        self._call("set_trial_user_attr", trial_id, key, value)
+
+    def set_trial_system_attr(self, trial_id: int, key: str, value: Any) -> None:
+        self._call("set_trial_system_attr", trial_id, key, value)
+
+    def get_trial(self, trial_id: int) -> FrozenTrial:
+        return self._call("get_trial", trial_id)
+
+    def get_all_trials(
+        self,
+        study_id: int,
+        deepcopy: bool = True,
+        states: Container[TrialState] | None = None,
+    ) -> list[FrozenTrial]:
+        return self._call("get_all_trials", study_id, deepcopy, states)
+
+    # -------------------------------------------------------------- heartbeat
+
+    def record_heartbeat(self, trial_id: int) -> None:
+        self._call("record_heartbeat", trial_id)
+
+    def _get_stale_trial_ids(self, study_id: int) -> list[int]:
+        return self._call("_get_stale_trial_ids", study_id)
+
+    def get_heartbeat_interval(self) -> int | None:
+        return self._call("get_heartbeat_interval")
+
+    def get_failed_trial_callback(self) -> Callable | None:
+        # Callables don't cross the wire; retry callbacks run server-side or
+        # must be configured locally by the caller.
+        return None

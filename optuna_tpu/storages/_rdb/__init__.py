@@ -1,0 +1,3 @@
+from optuna_tpu.storages._rdb.storage import RDBStorage
+
+__all__ = ["RDBStorage"]

@@ -227,10 +227,14 @@ class Study:
         # before_trial may have written trial system attrs through the storage
         # (e.g. GridSampler's grid id); refresh the cached snapshot so
         # subsequent suggest calls see them (the reference achieves the same
-        # with its _LazyTrialSystemAttrs, ``_trial.py:822``).
-        trial._cached_frozen_trial.system_attrs = self._storage.get_trial(
-            trial._trial_id
-        ).system_attrs
+        # with its _LazyTrialSystemAttrs, ``_trial.py:822``). Skipped for
+        # samplers that don't override the hook — no write can have happened.
+        from optuna_tpu.samplers._base import BaseSampler as _Base
+
+        if type(self.sampler).before_trial is not _Base.before_trial:
+            trial._cached_frozen_trial.system_attrs = self._storage.get_trial(
+                trial._trial_id
+            ).system_attrs
         return trial
 
     def tell(

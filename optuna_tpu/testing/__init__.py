@@ -1,0 +1,3 @@
+"""Shipped reusable test library (reference ``optuna/testing/``, 2541 LoC):
+storage-mode matrix, deterministic samplers/pruners, trial factories,
+objective helpers — public-ish fixtures downstream projects reuse."""

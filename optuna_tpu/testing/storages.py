@@ -1,0 +1,107 @@
+"""Storage-mode matrix for behavioral tests.
+
+Parity target: ``optuna/testing/storages.py:34-197`` — ``STORAGE_MODES`` and
+a ``StorageSupplier`` context manager that materializes each backend:
+tempfile SQLite, journal files, and a real in-process gRPC server on a free
+port. (Redis modes are included only when a redis client is importable.)
+"""
+
+from __future__ import annotations
+
+import socket
+import tempfile
+from types import TracebackType
+from typing import Any
+
+from optuna_tpu.storages import BaseStorage, InMemoryStorage
+
+STORAGE_MODES: list[str] = [
+    "inmemory",
+    "sqlite",
+    "cached_sqlite",
+    "journal",
+    "grpc_rdb",
+    "grpc_journal_file",
+]
+
+STORAGE_MODES_HEARTBEAT = ["sqlite", "cached_sqlite"]
+
+
+def _find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+class StorageSupplier:
+    def __init__(self, storage_specifier: str, **kwargs: Any) -> None:
+        self.storage_specifier = storage_specifier
+        self.extra_args = kwargs
+        self.tempfile: Any = None
+        self.server: Any = None
+        self.proxy: Any = None
+
+    def __enter__(self) -> BaseStorage:
+        if self.storage_specifier == "inmemory":
+            if len(self.extra_args) > 0:
+                raise ValueError("InMemoryStorage does not accept any arguments!")
+            return InMemoryStorage()
+        if "sqlite" in self.storage_specifier:
+            from optuna_tpu.storages._cached_storage import _CachedStorage
+            from optuna_tpu.storages._rdb.storage import RDBStorage
+
+            self.tempfile = tempfile.NamedTemporaryFile(suffix=".db")
+            url = f"sqlite:///{self.tempfile.name}"
+            rdb = RDBStorage(url, **self.extra_args)
+            return (
+                _CachedStorage(rdb)
+                if self.storage_specifier == "cached_sqlite"
+                else rdb
+            )
+        if self.storage_specifier == "journal":
+            from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+
+            self.tempfile = tempfile.NamedTemporaryFile(suffix=".journal")
+            return JournalStorage(JournalFileBackend(self.tempfile.name), **self.extra_args)
+        if self.storage_specifier == "journal_redis":
+            from optuna_tpu.storages.journal import JournalRedisBackend, JournalStorage
+
+            backend = JournalRedisBackend("redis://localhost", **self.extra_args)
+            return JournalStorage(backend)
+        if self.storage_specifier.startswith("grpc_"):
+            from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+            from optuna_tpu.storages._grpc.server import make_grpc_server
+
+            inner_mode = self.storage_specifier[len("grpc_"):]
+            if inner_mode == "rdb":
+                from optuna_tpu.storages._rdb.storage import RDBStorage
+
+                self.tempfile = tempfile.NamedTemporaryFile(suffix=".db")
+                backing: BaseStorage = RDBStorage(f"sqlite:///{self.tempfile.name}")
+            else:
+                from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+
+                self.tempfile = tempfile.NamedTemporaryFile(suffix=".journal")
+                backing = JournalStorage(JournalFileBackend(self.tempfile.name))
+            port = _find_free_port()
+            self.server = make_grpc_server(backing, "localhost", port)
+            self.server.start()
+            self.proxy = GrpcStorageProxy(host="localhost", port=port)
+            return self.proxy
+        raise ValueError(f"Unknown storage specifier {self.storage_specifier}")
+
+    def __exit__(
+        self,
+        exc_type: type[BaseException] | None,
+        exc_val: BaseException | None,
+        exc_tb: TracebackType | None,
+    ) -> None:
+        if self.proxy is not None:
+            self.proxy.remove_session()
+            self.proxy = None
+        if self.server is not None:
+            self.server.stop(grace=None)
+            self.server = None
+        if self.tempfile is not None:
+            self.tempfile.close()
+            self.tempfile = None
