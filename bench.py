@@ -1,0 +1,243 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.json): GPSampler trials/sec on 20D Hartmann. ``ours`` runs
+on whatever accelerator jax resolves (the TPU chip under the driver);
+``baseline`` is the reference Optuna's PyTorch/SciPy GPSampler imported from
+/root/reference and run on CPU in this same process image.
+
+Usage: python bench.py [--config gp|tpe|cmaes|nsga2] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _setup_jax_cache() -> None:
+    # Persistent compile cache: sampler kernels re-jit as history buckets
+    # grow; caching across runs removes most compile latency. config.update
+    # works even though the axon sitecustomize already imported jax.
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/optuna_tpu_jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
+
+def _silence() -> None:
+    import optuna_tpu
+
+    optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# --------------------------------------------------------------------- ours
+
+
+def run_ours_gp(n_warmup: int, n_timed: int) -> tuple[float, float]:
+    import optuna_tpu
+    from optuna_tpu.models.benchmarks import hartmann20
+    from optuna_tpu.samplers import GPSampler
+
+    _silence()
+    study = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=10))
+    study.optimize(hartmann20, n_trials=n_warmup)
+    t0 = time.time()
+    study.optimize(hartmann20, n_trials=n_timed)
+    dt = time.time() - t0
+    return n_timed / dt, study.best_value
+
+
+def run_ours_tpe(n_warmup: int, n_timed: int) -> tuple[float, float]:
+    import optuna_tpu
+    from optuna_tpu.models.benchmarks import branin
+    from optuna_tpu.samplers import TPESampler
+
+    _silence()
+    study = optuna_tpu.create_study(sampler=TPESampler(seed=0))
+    study.optimize(branin, n_trials=n_warmup)
+    t0 = time.time()
+    study.optimize(branin, n_trials=n_timed)
+    dt = time.time() - t0
+    return n_timed / dt, study.best_value
+
+
+def run_ours_cmaes(n_warmup: int, n_timed: int) -> tuple[float, float]:
+    import optuna_tpu
+    from optuna_tpu.models.benchmarks import rastrigin
+    from optuna_tpu.samplers import CmaEsSampler
+
+    _silence()
+    study = optuna_tpu.create_study(sampler=CmaEsSampler(seed=0, popsize=40))
+    study.optimize(lambda t: rastrigin(t, dim=50), n_trials=n_warmup)
+    t0 = time.time()
+    study.optimize(lambda t: rastrigin(t, dim=50), n_trials=n_timed)
+    dt = time.time() - t0
+    return n_timed / dt, study.best_value
+
+
+def run_ours_nsga2(n_warmup: int, n_timed: int) -> tuple[float, float]:
+    import optuna_tpu
+    from optuna_tpu.hypervolume import compute_hypervolume
+    from optuna_tpu.models.benchmarks import zdt1
+    from optuna_tpu.samplers import NSGAIISampler
+
+    _silence()
+    study = optuna_tpu.create_study(
+        directions=["minimize", "minimize"], sampler=NSGAIISampler(seed=0, population_size=50)
+    )
+    study.optimize(zdt1, n_trials=n_warmup)
+    t0 = time.time()
+    study.optimize(zdt1, n_trials=n_timed)
+    dt = time.time() - t0
+    vals = np.asarray([t.values for t in study.trials])
+    hv = compute_hypervolume(vals, np.array([1.1, 10.0]))
+    return n_timed / dt, hv
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def _import_reference():
+    shim_dir = tempfile.mkdtemp(prefix="refshim_")
+    with open(os.path.join(shim_dir, "colorlog.py"), "w") as f:
+        f.write(
+            "import logging\n"
+            "class ColoredFormatter(logging.Formatter):\n"
+            "    def __init__(self, fmt=None, *a, log_colors=None, **k):\n"
+            "        if fmt is not None:\n"
+            "            fmt = fmt.replace('%(log_color)s', '').replace('%(reset)s', '')\n"
+            "        super().__init__(fmt)\n"
+            "class TTYColoredFormatter(ColoredFormatter):\n"
+            "    def __init__(self, *a, stream=None, **k):\n"
+            "        super().__init__(*a, **k)\n"
+            "class StreamHandler(logging.StreamHandler):\n"
+            "    pass\n"
+        )
+    sys.path.insert(0, shim_dir)
+    sys.path.insert(0, "/root/reference")
+    import optuna
+
+    optuna.logging.set_verbosity(optuna.logging.ERROR)
+    return optuna
+
+
+def run_baseline_gp(n_timed: int) -> tuple[float, float] | None:
+    try:
+        optuna = _import_reference()
+        from optuna_tpu.models.benchmarks import hartmann20
+
+        study = optuna.create_study(sampler=optuna.samplers.GPSampler(seed=0))
+        study.optimize(hartmann20, n_trials=10)  # startup phase
+        t0 = time.time()
+        study.optimize(hartmann20, n_trials=n_timed)
+        dt = time.time() - t0
+        return n_timed / dt, study.best_value
+    except Exception as e:  # pragma: no cover - depends on image contents
+        _log(f"baseline failed: {e!r}")
+        return None
+
+
+def run_baseline_tpe(n_timed: int) -> tuple[float, float] | None:
+    try:
+        optuna = _import_reference()
+        from optuna_tpu.models.benchmarks import branin
+
+        study = optuna.create_study(sampler=optuna.samplers.TPESampler(seed=0))
+        study.optimize(branin, n_trials=10)
+        t0 = time.time()
+        study.optimize(branin, n_trials=n_timed)
+        dt = time.time() - t0
+        return n_timed / dt, study.best_value
+    except Exception as e:  # pragma: no cover
+        _log(f"baseline failed: {e!r}")
+        return None
+
+
+def run_baseline_nsga2(n_timed: int) -> tuple[float, float] | None:
+    try:
+        optuna = _import_reference()
+        from optuna_tpu.models.benchmarks import zdt1
+
+        study = optuna.create_study(
+            directions=["minimize", "minimize"],
+            sampler=optuna.samplers.NSGAIISampler(seed=0, population_size=50),
+        )
+        study.optimize(zdt1, n_trials=10)
+        t0 = time.time()
+        study.optimize(zdt1, n_trials=n_timed)
+        dt = time.time() - t0
+        return n_timed / dt, 0.0
+    except Exception as e:  # pragma: no cover
+        _log(f"baseline failed: {e!r}")
+        return None
+
+
+def main() -> None:
+    _setup_jax_cache()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="gp", choices=["gp", "tpe", "cmaes", "nsga2"])
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    if args.config == "gp":
+        n_warm, n_timed = (12, 20) if args.quick else (20, 40)
+        _log("running ours (GPSampler / 20D Hartmann)...")
+        ours_rate, ours_best = run_ours_gp(n_warm, n_timed)
+        _log(f"ours: {ours_rate:.3f} trials/s (best {ours_best:.4f}); running baseline...")
+        base = run_baseline_gp(n_timed)
+        metric = "gp_sampler_trials_per_sec_hartmann20d"
+    elif args.config == "tpe":
+        n_warm, n_timed = (30, 100) if args.quick else (50, 300)
+        _log("running ours (TPESampler / Branin)...")
+        ours_rate, ours_best = run_ours_tpe(n_warm, n_timed)
+        _log(f"ours: {ours_rate:.3f} trials/s; running baseline...")
+        base = run_baseline_tpe(n_timed)
+        metric = "tpe_sampler_trials_per_sec_branin"
+    elif args.config == "cmaes":
+        n_warm, n_timed = (100, 400) if args.quick else (500, 2000)
+        ours_rate, ours_best = run_ours_cmaes(n_warm, n_timed)
+        base = None
+        metric = "cmaes_trials_per_sec_rastrigin50d"
+    else:
+        n_warm, n_timed = (60, 100) if args.quick else (100, 300)
+        ours_rate, ours_best = run_ours_nsga2(n_warm, n_timed)
+        base = run_baseline_nsga2(n_timed)
+        metric = "nsga2_trials_per_sec_zdt1"
+
+    if base is not None:
+        base_rate, base_best = base
+        _log(f"baseline: {base_rate:.3f} trials/s (best {base_best:.4f})")
+        vs = ours_rate / base_rate
+    else:
+        vs = None
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(ours_rate, 3),
+                "unit": "trials/s",
+                "vs_baseline": round(vs, 3) if vs is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""The whole GP suggestion as ONE XLA program.
+
+Per-trial pipeline (reference runs it as dozens of Python/torch/SciPy steps,
+``optuna/samplers/_gp/sampler.py:397``): MAP-fit kernel params (multi-start
+batched L-BFGS) -> Cholesky/alpha finalize -> LogEI over the QMC candidate
+pool -> Gumbel-top-k roulette start selection -> box-constrained L-BFGS
+ascent interleaved with dense discrete sweeps -> argmax.
+
+Fusing it means exactly one device dispatch + one small result fetch per
+trial. On a tunneled TPU (~100ms/dispatch) this is the difference between
+~0.5 and ~15 dispatches of latency; on direct-attached hardware it lets XLA
+overlap everything and keeps the MXU fed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu.gp.acqf import LogEIData
+from optuna_tpu.gp.gp import GPParams, GPState, _kernel_with_noise, _loss
+from optuna_tpu.ops.lbfgsb import lbfgsb
+
+
+def _fit_and_state(starts, X, y, cat_mask, mask, minimum_noise):
+    loss_one = lambda r: _loss(r, X, y, cat_mask, mask, minimum_noise)
+
+    def value_and_grad(batch_raw):
+        vals, grads = jax.vmap(jax.value_and_grad(loss_one))(batch_raw)
+        return vals, jnp.where(jnp.isfinite(grads), grads, 0.0)
+
+    value_only = jax.vmap(loss_one)
+
+    D = starts.shape[1]
+    lower = jnp.full((D,), -15.0, starts.dtype)
+    upper = jnp.full((D,), 15.0, starts.dtype)
+    xs, fs = lbfgsb(
+        value_and_grad, starts, lower, upper, max_iters=60, max_ls=12, value_fn=value_only
+    )
+    raw = xs[jnp.argmin(fs)]
+
+    d = X.shape[-1]
+    params = GPParams(
+        inv_sq_lengthscales=jnp.exp(raw[:d]),
+        scale=jnp.exp(raw[d]),
+        noise=jnp.exp(raw[d + 1]) + minimum_noise,
+    )
+    K = _kernel_with_noise(X, params, cat_mask, mask)
+    L = jnp.linalg.cholesky(K)
+    alpha = jax.scipy.linalg.cho_solve((L, True), y)
+    return raw, GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_local_search", "n_cycles", "lbfgs_iters", "has_sweep"),
+)
+def gp_suggest_fused(
+    starts: jnp.ndarray,  # (S, d+2) kernel-param starts
+    X: jnp.ndarray,  # (N, d) padded observations
+    y: jnp.ndarray,  # (N,)
+    cat_mask: jnp.ndarray,  # (d,)
+    mask: jnp.ndarray,  # (N,)
+    candidates: jnp.ndarray,  # (C, d) QMC preliminary pool (+ incumbents)
+    key: jax.Array,
+    minimum_noise: float,
+    cont_mask: jnp.ndarray,  # (d,)
+    lower: jnp.ndarray,  # (d,)
+    upper: jnp.ndarray,  # (d,)
+    dim_onehot: jnp.ndarray,  # (Dd, d) sweep tables (dummy (0,d) when unused)
+    choice_grid: jnp.ndarray,  # (Dd, Cmax)
+    choice_valid: jnp.ndarray,  # (Dd, Cmax)
+    stabilizing_noise: float = 1e-10,
+    n_local_search: int = 10,
+    n_cycles: int = 2,
+    lbfgs_iters: int = 40,
+    has_sweep: bool = False,
+):
+    from optuna_tpu.gp.acqf import logei_value
+
+    raw, state = _fit_and_state(starts, X, y, cat_mask, mask, minimum_noise)
+    best = jnp.max(jnp.where(mask > 0, y, -jnp.inf))
+    data = LogEIData(
+        state=state,
+        cat_mask=cat_mask,
+        best=best,
+        stabilizing_noise=jnp.asarray(stabilizing_noise, dtype=X.dtype),
+    )
+
+    vals = logei_value(data, candidates)
+    vals = jnp.where(jnp.isfinite(vals), vals, -jnp.inf)
+    # Start selection: argmax + Gumbel-top-k == softmax sampling w/o
+    # replacement (the reference's roulette, optim_mixed.py:309-326).
+    gumbel = jax.random.gumbel(key, vals.shape, dtype=vals.dtype)
+    perturbed = vals + gumbel
+    _, noisy_idx = jax.lax.top_k(perturbed, n_local_search)
+    idx = noisy_idx.at[0].set(jnp.argmax(vals))
+    x = candidates[idx]
+    cur = vals[idx]
+
+    def neg_batch(xb):
+        def neg(xx):
+            return -logei_value(data, xx[None])[0]
+
+        v, g = jax.vmap(jax.value_and_grad(neg))(xb)
+        g = jnp.where(cont_mask[None, :] > 0, g, 0.0)
+        return v, jnp.where(jnp.isfinite(g), g, 0.0)
+
+    def neg_values(xb):
+        return -logei_value(data, xb)
+
+    def sweep(x, cur):
+        B, d = x.shape
+        Dd, Cmax = choice_grid.shape
+        base = x[:, None, None, :] * (1.0 - dim_onehot[None, :, None, :])
+        repl = choice_grid[None, :, :, None] * dim_onehot[None, :, None, :]
+        cand = base + repl
+        v = logei_value(data, cand.reshape(-1, d)).reshape(B, Dd, Cmax)
+        v = jnp.where(choice_valid[None], v, -jnp.inf)
+        flat = v.reshape(B, Dd * Cmax)
+        bi = jnp.argmax(flat, axis=1)
+        bv = jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0]
+        bc = cand.reshape(B, Dd * Cmax, d)[jnp.arange(B), bi]
+        improve = bv > cur
+        return jnp.where(improve[:, None], bc, x), jnp.maximum(bv, cur)
+
+    for _ in range(n_cycles):
+        x_new, neg_new = lbfgsb(
+            neg_batch, x, lower, upper, max_iters=lbfgs_iters, max_ls=10, value_fn=neg_values
+        )
+        v_new = -neg_new
+        better = v_new > cur
+        x = jnp.where(better[:, None], x_new, x)
+        cur = jnp.maximum(v_new, cur)
+        if has_sweep:
+            x, cur = sweep(x, cur)
+
+    winner = jnp.argmax(cur)
+    return x[winner], cur[winner], raw

@@ -112,6 +112,24 @@ def _discrete_sweep(
     return new_x, new_val, jnp.any(improve)
 
 
+def continuous_bounds(space: SearchSpace) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(cont_mask, lower, upper) in the normalized mixed space — shared by the
+    fused and multi-dispatch optimizers so the two paths cannot drift."""
+    cont_mask = (~np.asarray(space.is_categorical)).astype(np.float64)
+    lower = np.zeros(space.dim)
+    upper = np.where(space.is_categorical, space.n_choices.astype(np.float64) - 1.0, 1.0)
+    return cont_mask, lower, upper
+
+
+def snap_steps(space: SearchSpace, x: np.ndarray) -> np.ndarray:
+    """Snap stepped numerical dims of one normalized point onto grid centers."""
+    x = np.array(x, dtype=np.float64)
+    for i in range(space.dim):
+        if space.scale_types[i] != ScaleType.CATEGORICAL and space.steps[i] > 0:
+            x[i] = float(_round_to_step_grid(np.asarray([x[i]]), space.steps[i])[0])
+    return x
+
+
 def _sweep_tables(space: SearchSpace) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
     """Build (dim_onehot, choice_grid, choice_valid) for enumerable dims."""
     dims: list[int] = []
@@ -179,14 +197,11 @@ def optimize_acqf_mixed(
     x = jnp.asarray(cand[np.asarray(chosen)], dtype=jnp.float32)
     cur = eval_acqf(acqf_name, data, x)
 
-    cont_mask_np = (np.asarray(space.is_categorical) == False).astype(np.float64)  # noqa: E712
+    cont_mask_np, lower_np, upper_np = continuous_bounds(space)
     has_continuous = bool(cont_mask_np.sum() > 0)
     cont_mask = jnp.asarray(cont_mask_np, dtype=jnp.float32)
-    lower = jnp.zeros(d, dtype=jnp.float32)
-    upper = jnp.asarray(
-        np.where(space.is_categorical, space.n_choices.astype(np.float64) - 1.0, 1.0),
-        dtype=jnp.float32,
-    )
+    lower = jnp.asarray(lower_np, dtype=jnp.float32)
+    upper = jnp.asarray(upper_np, dtype=jnp.float32)
     tables = _sweep_tables(space)
 
     for _ in range(n_cycles):
@@ -216,11 +231,7 @@ def optimize_acqf_mixed(
 
     cur_np = np.asarray(cur)
     best = int(np.argmax(cur_np))
-    x_best = np.asarray(x)[best].astype(np.float64)
-    # Snap non-enumerated stepped dims back onto their grid.
-    for i in range(d):
-        if space.scale_types[i] != ScaleType.CATEGORICAL and space.steps[i] > 0:
-            x_best[i] = float(_round_to_step_grid(np.asarray([x_best[i]]), space.steps[i])[0])
+    x_best = snap_steps(space, np.asarray(x)[best])
     return x_best, float(cur_np[best])
 
 

@@ -1,0 +1,25 @@
+"""Benchmark objective functions and example models (BASELINE.md configs)."""
+
+from optuna_tpu.models.benchmarks import (
+    branin,
+    branin_jax,
+    hartmann6,
+    hartmann6_jax,
+    rastrigin,
+    rastrigin_jax,
+    zdt1,
+    zdt2,
+    zdt3,
+)
+
+__all__ = [
+    "branin",
+    "branin_jax",
+    "hartmann6",
+    "hartmann6_jax",
+    "rastrigin",
+    "rastrigin_jax",
+    "zdt1",
+    "zdt2",
+    "zdt3",
+]

@@ -1,0 +1,134 @@
+"""Standard HPO benchmark functions (BASELINE.md configs 1-4).
+
+Each exists twice: a define-by-run objective taking a Trial, and a batched
+jax version (``*_jax``) for the vectorized/sharded path.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+# ---------------------------------------------------------------- Branin (2D)
+
+_BRANIN_A = 1.0
+_BRANIN_B = 5.1 / (4 * math.pi**2)
+_BRANIN_C = 5 / math.pi
+_BRANIN_R = 6.0
+_BRANIN_S = 10.0
+_BRANIN_T = 1 / (8 * math.pi)
+
+
+def branin(trial) -> float:
+    x1 = trial.suggest_float("x1", -5.0, 10.0)
+    x2 = trial.suggest_float("x2", 0.0, 15.0)
+    return (
+        _BRANIN_A * (x2 - _BRANIN_B * x1**2 + _BRANIN_C * x1 - _BRANIN_R) ** 2
+        + _BRANIN_S * (1 - _BRANIN_T) * math.cos(x1)
+        + _BRANIN_S
+    )
+
+
+def branin_jax(params):
+    import jax.numpy as jnp
+
+    x1, x2 = params["x1"], params["x2"]
+    return (
+        _BRANIN_A * (x2 - _BRANIN_B * x1**2 + _BRANIN_C * x1 - _BRANIN_R) ** 2
+        + _BRANIN_S * (1 - _BRANIN_T) * jnp.cos(x1)
+        + _BRANIN_S
+    )
+
+
+# ------------------------------------------------------------- Hartmann6 (6D)
+
+_H6_ALPHA = np.array([1.0, 1.2, 3.0, 3.2])
+_H6_A = np.array(
+    [
+        [10, 3, 17, 3.5, 1.7, 8],
+        [0.05, 10, 17, 0.1, 8, 14],
+        [3, 3.5, 1.7, 10, 17, 8],
+        [17, 8, 0.05, 10, 0.1, 14],
+    ]
+)
+_H6_P = 1e-4 * np.array(
+    [
+        [1312, 1696, 5569, 124, 8283, 5886],
+        [2329, 4135, 8307, 3736, 1004, 9991],
+        [2348, 1451, 3522, 2883, 3047, 6650],
+        [4047, 8828, 8732, 5743, 1091, 381],
+    ]
+)
+
+
+def hartmann6(trial) -> float:
+    x = np.array([trial.suggest_float(f"x{i}", 0.0, 1.0) for i in range(6)])
+    inner = np.sum(_H6_A * (x[None, :] - _H6_P) ** 2, axis=1)
+    return float(-np.sum(_H6_ALPHA * np.exp(-inner)))
+
+
+def hartmann6_jax(params):
+    import jax.numpy as jnp
+
+    x = jnp.stack([params[f"x{i}"] for i in range(6)], axis=-1)  # (B, 6)
+    inner = jnp.sum(
+        jnp.asarray(_H6_A)[None] * (x[:, None, :] - jnp.asarray(_H6_P)[None]) ** 2,
+        axis=-1,
+    )
+    return -jnp.sum(jnp.asarray(_H6_ALPHA)[None] * jnp.exp(-inner), axis=-1)
+
+
+def hartmann20(trial) -> float:
+    """20D embedding of Hartmann6 (extra dims are inert), the BASELINE #2
+    configuration's common construction."""
+    x = np.array([trial.suggest_float(f"x{i}", 0.0, 1.0) for i in range(20)])
+    x6 = x[:6]
+    inner = np.sum(_H6_A * (x6[None, :] - _H6_P) ** 2, axis=1)
+    return float(-np.sum(_H6_ALPHA * np.exp(-inner)))
+
+
+# ------------------------------------------------------------- Rastrigin (nD)
+
+
+def rastrigin(trial, dim: int = 50) -> float:
+    x = np.array([trial.suggest_float(f"x{i}", -5.12, 5.12) for i in range(dim)])
+    return float(10 * dim + np.sum(x**2 - 10 * np.cos(2 * np.pi * x)))
+
+
+def rastrigin_jax(params):
+    import jax.numpy as jnp
+
+    names = sorted(params.keys(), key=lambda s: int(s[1:]))
+    x = jnp.stack([params[n] for n in names], axis=-1)
+    d = x.shape[-1]
+    return 10.0 * d + jnp.sum(x**2 - 10.0 * jnp.cos(2 * jnp.pi * x), axis=-1)
+
+
+# ------------------------------------------------------------------ ZDT (2-obj)
+
+
+def _zdt_g(xs: np.ndarray) -> float:
+    return 1 + 9 * float(np.sum(xs[1:])) / (len(xs) - 1)
+
+
+def zdt1(trial, dim: int = 30):
+    xs = np.array([trial.suggest_float(f"x{i}", 0.0, 1.0) for i in range(dim)])
+    g = _zdt_g(xs)
+    f1 = float(xs[0])
+    return f1, g * (1 - math.sqrt(f1 / g))
+
+
+def zdt2(trial, dim: int = 30):
+    xs = np.array([trial.suggest_float(f"x{i}", 0.0, 1.0) for i in range(dim)])
+    g = _zdt_g(xs)
+    f1 = float(xs[0])
+    return f1, g * (1 - (f1 / g) ** 2)
+
+
+def zdt3(trial, dim: int = 30):
+    xs = np.array([trial.suggest_float(f"x{i}", 0.0, 1.0) for i in range(dim)])
+    g = _zdt_g(xs)
+    f1 = float(xs[0])
+    return f1, g * (1 - math.sqrt(f1 / g) - (f1 / g) * math.sin(10 * math.pi * f1))

@@ -1,0 +1,69 @@
+"""Tunable MLP classifier — the model behind BASELINE config #5
+(256 parallel MLP trials across a pod) and the graft entry's multichip
+dry-run. Pure jax (no flax dependency on the hot path) so the training step
+jits into one tight XLA program with tensor-parallel-friendly matmuls.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MLPParams(NamedTuple):
+    w1: jnp.ndarray  # (in, hidden)
+    b1: jnp.ndarray  # (hidden,)
+    w2: jnp.ndarray  # (hidden, out)
+    b2: jnp.ndarray  # (out,)
+
+
+def init_mlp(key: jax.Array, n_in: int, n_hidden: int, n_out: int) -> MLPParams:
+    k1, k2 = jax.random.split(key)
+    scale1 = (2.0 / n_in) ** 0.5
+    scale2 = (2.0 / n_hidden) ** 0.5
+    return MLPParams(
+        w1=jax.random.normal(k1, (n_in, n_hidden), jnp.float32) * scale1,
+        b1=jnp.zeros(n_hidden, jnp.float32),
+        w2=jax.random.normal(k2, (n_hidden, n_out), jnp.float32) * scale2,
+        b2=jnp.zeros(n_out, jnp.float32),
+    )
+
+
+def mlp_forward(params: MLPParams, x: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.maximum(x @ params.w1 + params.b1, 0.0)
+    return h @ params.w2 + params.b2
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def sgd_step(
+    params: MLPParams, x: jnp.ndarray, y: jnp.ndarray, lr: jnp.ndarray
+) -> tuple[MLPParams, jnp.ndarray]:
+    loss, grads = jax.value_and_grad(lambda p: cross_entropy(mlp_forward(p, x), y))(params)
+    new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return new, loss
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def train_mlp(
+    params: MLPParams,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    lr: jnp.ndarray,
+    n_steps: int = 20,
+) -> tuple[MLPParams, jnp.ndarray]:
+    """n_steps of full-batch SGD under one lax.scan — one dispatch per trial
+    batch, not per step."""
+
+    def body(p, _):
+        p, loss = sgd_step(p, x, y, lr)
+        return p, loss
+
+    params, losses = jax.lax.scan(body, params, None, length=n_steps)
+    return params, losses[-1]

@@ -21,6 +21,7 @@ from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class LbfgsbState(NamedTuple):
@@ -71,7 +72,10 @@ def _two_loop(state: LbfgsbState) -> jnp.ndarray:
     return -r
 
 
-@partial(jax.jit, static_argnames=("value_and_grad_fn", "max_iters", "history", "max_ls"))
+@partial(
+    jax.jit,
+    static_argnames=("value_and_grad_fn", "max_iters", "history", "max_ls", "value_fn"),
+)
 def lbfgsb(
     value_and_grad_fn: Callable[[jnp.ndarray], tuple[jnp.ndarray, jnp.ndarray]],
     x0: jnp.ndarray,
@@ -80,12 +84,17 @@ def lbfgsb(
     max_iters: int = 200,
     history: int = 10,
     tol: float = 1e-8,
-    max_ls: int = 20,
+    max_ls: int = 16,
+    value_fn: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Minimize ``B`` independent instances of a box-constrained problem.
 
     ``value_and_grad_fn`` maps (B, D) -> ((B,), (B, D)) and must be traceable;
-    returns (x_opt (B, D), f_opt (B,)).
+    returns (x_opt (B, D), f_opt (B,)). The Armijo backtracking evaluates all
+    ``max_ls`` step sizes in ONE batched call (``value_fn`` if given, else the
+    value part of ``value_and_grad_fn``) — sequential depth per iteration is
+    2 evaluations, not ``max_ls``, which is what latency-bound accelerators
+    care about.
     """
     B, D = x0.shape
     x0 = jnp.clip(x0, lower, upper)
@@ -113,31 +122,38 @@ def lbfgsb(
     def cond(state: LbfgsbState):
         return (state.n_iter < max_iters) & ~jnp.all(state.converged)
 
+    ls_alphas = jnp.asarray(0.5 ** np.arange(max_ls), x0.dtype)  # (L,)
+    eval_values = value_fn if value_fn is not None else (
+        lambda xb: value_and_grad_fn(xb)[0]
+    )
+
     def body(state: LbfgsbState) -> LbfgsbState:
         d = _two_loop(state)
         # Safeguard: fall back to steepest descent if not a descent direction.
         descent = jnp.sum(d * state.g, axis=-1) < 0
         d = jnp.where(descent[:, None], d, -state.g)
 
-        # Backtracking Armijo line search along the projected path.
-        def ls_body(carry, _):
-            alpha, best_x, best_f, done = carry
-            x_try = jnp.clip(state.x + alpha[:, None] * d, lower, upper)
-            f_try, _ = value_and_grad_fn(x_try)
-            # Armijo with the projected step (x_try - x).
-            decrease = f_try <= state.f + 1e-4 * jnp.sum(state.g * (x_try - state.x), axis=-1)
-            accept = decrease & ~done & jnp.isfinite(f_try)
-            best_x = jnp.where(accept[:, None], x_try, best_x)
-            best_f = jnp.where(accept, f_try, best_f)
-            done = done | accept
-            return (alpha * 0.5, best_x, best_f, done), None
-
-        (_, x_new, f_new, ls_ok), _ = jax.lax.scan(
-            ls_body,
-            (jnp.ones(B, x0.dtype), state.x, state.f, state.converged),
-            None,
-            length=max_ls,
+        # Batched Armijo: every candidate step evaluated at once — vmap over
+        # the step-size axis keeps the callee's (B, D) batch contract while
+        # collapsing the line search's sequential depth to one evaluation.
+        L = max_ls
+        x_trys = jnp.clip(
+            state.x[None, :, :] + ls_alphas[:, None, None] * d[None, :, :], lower, upper
+        )  # (L, B, D)
+        f_trys = jax.vmap(eval_values)(x_trys)  # (L, B)
+        armijo_rhs = state.f[None, :] + 1e-4 * jnp.sum(
+            state.g[None, :, :] * (x_trys - state.x[None, :, :]), axis=-1
         )
+        ok = (f_trys <= armijo_rhs) & jnp.isfinite(f_trys)
+        # First (largest-step) accepted alpha per instance.
+        first = jnp.argmax(ok, axis=0)  # (B,)
+        ls_ok = jnp.any(ok, axis=0) & ~state.converged
+        x_new = jnp.where(
+            ls_ok[:, None],
+            x_trys[first, jnp.arange(B)],
+            state.x,
+        )
+        f_new = jnp.where(ls_ok, f_trys[first, jnp.arange(B)], state.f)
 
         _, g_new = value_and_grad_fn(x_new)
         s = x_new - state.x

@@ -1,0 +1,18 @@
+"""Pod-scale trial parallelism.
+
+The reference's distributed model is trial-level fan-out over a shared
+storage bus (SURVEY.md §2.4): processes coordinate only through storage CAS.
+This package adds the TPU-native tier on top:
+
+* :mod:`vectorized` — batch ask -> shard_map objective evaluation over a
+  ``jax.sharding.Mesh`` -> batch tell: hundreds of trials advance per device
+  dispatch instead of one (BASELINE config #5);
+* :mod:`ici_journal` — a journal backend whose sync primitive is an XLA
+  allgather over the mesh (ICI) instead of a POSIX file, so intra-slice
+  trial synchronization never leaves the interconnect.
+"""
+
+from optuna_tpu.parallel.ici_journal import IciJournalBackend
+from optuna_tpu.parallel.vectorized import VectorizedObjective, optimize_vectorized
+
+__all__ = ["IciJournalBackend", "VectorizedObjective", "optimize_vectorized"]

@@ -1,0 +1,97 @@
+"""Journal backend synchronized by XLA collectives instead of a filesystem.
+
+The reference's distributed bus is SQL/NFS/gRPC (SURVEY.md §2.4); the
+TPU-native hot path replaces it with an **allgather journal**: every host
+process accumulates journal ops locally and exchange points allgather the
+byte-packed op buffers across the pod (ICI intra-slice, DCN across slices)
+via ``jax.experimental.multihost_utils``. Replay order is deterministic:
+(round, process_index, local sequence) — every host derives the identical
+global log with zero servers and zero filesystem round-trips.
+
+Constraint (by construction of collectives): all hosts must reach exchange
+points in lockstep, which is exactly the execution model of
+:func:`optuna_tpu.parallel.vectorized.optimize_vectorized`-style batch loops.
+Single-host it degrades to a plain in-memory journal whose exchange is a
+no-op gather, so the same study code runs from laptop to pod.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+from optuna_tpu.logging import get_logger
+from optuna_tpu.storages.journal._base import BaseJournalBackend
+
+_logger = get_logger(__name__)
+
+_HEADER = np.dtype(np.uint32).itemsize
+
+
+class IciJournalBackend(BaseJournalBackend):
+    def __init__(self, buffer_bytes: int = 1 << 20) -> None:
+        self._buffer_bytes = buffer_bytes
+        self._merged: list[dict[str, Any]] = []
+        self._pending: list[dict[str, Any]] = []
+        self._round = 0
+
+    # ------------------------------------------------------------ exchange
+
+    def _pack(self, logs: list[dict[str, Any]]) -> np.ndarray:
+        payload = b"".join(
+            json.dumps(log, separators=(",", ":")).encode() + b"\n" for log in logs
+        )
+        if len(payload) + _HEADER > self._buffer_bytes:
+            raise ValueError(
+                f"Journal exchange buffer overflow ({len(payload)} bytes); "
+                "raise buffer_bytes or exchange more often."
+            )
+        buf = np.zeros(self._buffer_bytes, dtype=np.uint8)
+        buf[:_HEADER] = np.frombuffer(
+            np.uint32(len(payload)).tobytes(), dtype=np.uint8
+        )
+        buf[_HEADER : _HEADER + len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        return buf
+
+    @staticmethod
+    def _unpack(buf: np.ndarray) -> list[dict[str, Any]]:
+        n = int(np.frombuffer(buf[:_HEADER].tobytes(), dtype=np.uint32)[0])
+        if n == 0:
+            return []
+        payload = buf[_HEADER : _HEADER + n].tobytes()
+        return [json.loads(line) for line in payload.splitlines() if line]
+
+    def exchange(self) -> None:
+        """Collective sync point: allgather every host's pending ops and merge
+        them in (round, process_index, local order)."""
+        import jax
+
+        if jax.process_count() == 1:
+            # Degenerate gather: local ops become globally visible directly.
+            self._merged.extend(self._pending)
+            self._pending = []
+            self._round += 1
+            return
+
+        from jax.experimental import multihost_utils
+
+        buf = self._pack(self._pending)
+        gathered = np.asarray(multihost_utils.process_allgather(buf))  # (P, buffer)
+        self._pending = []
+        for p in range(gathered.shape[0]):
+            self._merged.extend(self._unpack(gathered[p]))
+        self._round += 1
+
+    # ------------------------------------------------------------- backend
+
+    def append_logs(self, logs: list[dict[str, Any]]) -> None:
+        self._pending.extend(logs)
+        self.exchange()
+
+    def read_logs(self, log_number_from: int) -> list[dict[str, Any]]:
+        # Reads never run collectives (they are not lockstep-safe); they see
+        # everything merged up to the last exchange. append_logs drains the
+        # pending buffer synchronously, so there is nothing unmerged here.
+        return self._merged[log_number_from:]

@@ -125,6 +125,20 @@ class GPSampler(BaseSampler):
         sig = self._space_signature(search_space)
         warm = self._kernel_params_cache.get(sig)
 
+        running = (
+            self._running_trials_matrix(study, space, search_space, trial)
+            if n_objectives == 1
+            else None
+        )
+        if (
+            n_objectives == 1
+            and self._constraints_func is None
+            and (running is None or len(running) == 0)
+        ):
+            # Hot path: the entire fit->acqf->optimize pipeline as ONE
+            # device dispatch (gp/fused.py).
+            return self._sample_fused(study, space, search_space, X, is_cat, trials, warm, sig, seed)
+
         if n_objectives == 1:
             # Internal convention: maximize standardized score.
             raw_vals = np.asarray([t.value for t in trials], dtype=np.float64)
@@ -141,7 +155,6 @@ class GPSampler(BaseSampler):
             self._kernel_params_cache[sig] = [raw_params]
             best = float(np.max(y))
 
-            running = self._running_trials_matrix(study, space, search_space, trial)
             if running is not None and len(running) > 0:
                 acqf_name, data = self._build_qlogei(state, cat_mask, running, best, seed)
             else:
@@ -172,6 +185,76 @@ class GPSampler(BaseSampler):
             n_local_search=self._n_local_search,
         )
         return space.unnormalize_one(x_best)
+
+    def _sample_fused(self, study, space, search_space, X, is_cat, trials, warm, sig, seed):
+        """Single-objective unconstrained suggestion in one device dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.fused import gp_suggest_fused
+        from optuna_tpu.gp.gp import _bucket
+        from optuna_tpu.gp.optim_mixed import _sweep_tables, continuous_bounds, snap_steps
+
+        rng = self._rng.rng
+        n, d = X.shape
+        raw_vals = np.asarray([t.value for t in trials], dtype=np.float64)
+        score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
+        y, _, _ = _standardize(score)
+
+        N = _bucket(n)
+        Xp = np.zeros((N, d), dtype=np.float32)
+        Xp[:n] = X
+        yp = np.zeros(N, dtype=np.float32)
+        yp[:n] = y
+        maskp = np.zeros(N, dtype=np.float32)
+        maskp[:n] = 1.0
+
+        default = np.zeros(d + 2, dtype=np.float32)
+        default[d + 1] = np.log(1e-2)
+        starts = [default]
+        if warm is not None and len(warm):
+            starts.append(np.asarray(warm[0], dtype=np.float32))
+        while len(starts) < 4:
+            starts.append(
+                (default + rng.normal(0, 1.0, size=d + 2)).astype(np.float32)
+            )
+
+        cand = space.sample_normalized(
+            self._n_preliminary_samples, seed=int(rng.randint(0, 2**31 - 1))
+        ).astype(np.float32)
+        cand = np.concatenate([X[-min(n, 4):], cand], axis=0)
+
+        tables = _sweep_tables(space)
+        if tables is None:
+            onehot = np.zeros((1, d))
+            grid = np.zeros((1, 1))
+            valid = np.zeros((1, 1), dtype=bool)
+        else:
+            onehot, grid, valid = tables
+        cont_mask, lower, upper = continuous_bounds(space)
+
+        x_best, _, raw = gp_suggest_fused(
+            jnp.asarray(np.stack(starts)),
+            jnp.asarray(Xp),
+            jnp.asarray(yp),
+            jnp.asarray(is_cat.astype(bool)),
+            jnp.asarray(maskp),
+            jnp.asarray(cand),
+            jax.random.PRNGKey(seed),
+            1e-7 if self._deterministic else 1e-5,
+            jnp.asarray(cont_mask, dtype=jnp.float32),
+            jnp.asarray(lower, dtype=jnp.float32),
+            jnp.asarray(upper, dtype=jnp.float32),
+            jnp.asarray(onehot, dtype=jnp.float32),
+            jnp.asarray(grid, dtype=jnp.float32),
+            jnp.asarray(valid),
+            n_local_search=self._n_local_search,
+            has_sweep=tables is not None,
+        )
+        self._kernel_params_cache[sig] = [np.asarray(raw)]
+        # Snap stepped dims (the fused kernel treats them as continuous).
+        x_np = snap_steps(space, np.asarray(x_best, dtype=np.float64))
+        return space.unnormalize_one(x_np)
 
     # ------------------------------------------------------------ acqf builds
 

@@ -28,9 +28,3 @@ class LazyRandomState:
     def seed(self, seed: int | None = None) -> None:
         self._seed = seed
         self._rng = np.random.RandomState(seed)
-
-    def jax_key(self):
-        """Derive a fresh ``jax.random`` PRNG key from the host stream."""
-        import jax
-
-        return jax.random.PRNGKey(int(self.rng.randint(0, 2**31 - 1)))

@@ -103,7 +103,7 @@ def _sample_from(
 
 @partial(jax.jit, static_argnames=("n_samples",))
 def sample_and_score(
-    key: jax.Array,
+    seed: jnp.ndarray,
     below: dict[str, jnp.ndarray],
     above: dict[str, jnp.ndarray],
     n_samples: int,
@@ -111,13 +111,35 @@ def sample_and_score(
     """TPE acquisition: draw from l(x), return argmax of log l(x) - log g(x).
 
     EI is monotone in the density ratio (reference `_tpe/sampler.py:648-657`),
-    so the winner is the candidate maximizing ``log l - log g``.
+    so the winner is the candidate maximizing ``log l - log g``. ``seed`` is a
+    traced uint32 so the PRNG key materializes INSIDE the graph — no separate
+    host-side PRNGKey dispatch.
     """
+    key = jax.random.PRNGKey(seed)
     x_num, x_cat = _sample_from(key, below, n_samples)
     log_l = _component_log_pdf(x_num, x_cat, below)
     log_g = _component_log_pdf(x_num, x_cat, above)
     best = jnp.argmax(log_l - log_g)
     return x_num[best], x_cat[best], (log_l - log_g)[best]
+
+
+@partial(jax.jit, static_argnames=("n_samples", "k"))
+def sample_and_score_topk(
+    seed: jnp.ndarray,
+    below: dict[str, jnp.ndarray],
+    above: dict[str, jnp.ndarray],
+    n_samples: int,
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batch-ask: the k best-scoring candidates from one draw — one dispatch
+    proposes a whole batch of trials for the vectorized optimizer."""
+    key = jax.random.PRNGKey(seed)
+    x_num, x_cat = _sample_from(key, below, n_samples)
+    score = _component_log_pdf(x_num, x_cat, below) - _component_log_pdf(
+        x_num, x_cat, above
+    )
+    _, idx = jax.lax.top_k(score, k)
+    return x_num[idx], x_cat[idx]
 
 
 @jax.jit
@@ -126,3 +148,104 @@ def log_pdf(
 ) -> jnp.ndarray:
     """Mixture log-density of explicit samples (used by tests & MOTPE weights)."""
     return _component_log_pdf(x_num, x_cat, pack)
+
+
+@partial(jax.jit, static_argnames=("n_samples",))
+def sample_and_score_univariate_batch(
+    seed: jnp.ndarray,
+    below: dict[str, jnp.ndarray],
+    above: dict[str, jnp.ndarray],
+    n_samples: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Classic (univariate) TPE for EVERY dimension in one dispatch.
+
+    Each dim is its own independent 1-D TPE problem; the packs here carry a
+    leading dim axis (numeric dims: mus/sigmas (D, B); categorical dims:
+    cat_log_probs (D, B, C)) and the 1-D sample->score->argmax is vmapped
+    across it. Identical math to calling the 1-D kernel D times — but one
+    device round-trip per *trial* instead of per *parameter*, which is the
+    difference between 2 and 6+ dispatches of latency on every suggestion.
+
+    Returns (winning numeric values (Dn,), winning categorical indices (Dc,)).
+    """
+
+    def one_num_dim(key, b_logw, b_mu, b_sigma, a_logw, a_mu, a_sigma, low, high, step):
+        bpack = {
+            "log_weights": b_logw,
+            "mus": b_mu[:, None],
+            "sigmas": b_sigma[:, None],
+            "lows": low[None],
+            "highs": high[None],
+            "steps": step[None],
+            "cat_log_probs": jnp.zeros((b_logw.shape[0], 0, 1)),
+        }
+        apack = {
+            "log_weights": a_logw,
+            "mus": a_mu[:, None],
+            "sigmas": a_sigma[:, None],
+            "lows": low[None],
+            "highs": high[None],
+            "steps": step[None],
+            "cat_log_probs": jnp.zeros((a_logw.shape[0], 0, 1)),
+        }
+        x_num, x_cat = _sample_from(key, bpack, n_samples)
+        score = _component_log_pdf(x_num, x_cat, bpack) - _component_log_pdf(
+            x_num, x_cat, apack
+        )
+        return x_num[jnp.argmax(score), 0]
+
+    def one_cat_dim(key, b_logw, b_probs, a_logw, a_probs):
+        bpack = {
+            "log_weights": b_logw,
+            "mus": jnp.zeros((b_logw.shape[0], 0)),
+            "sigmas": jnp.ones((b_logw.shape[0], 0)),
+            "lows": jnp.zeros(0),
+            "highs": jnp.zeros(0),
+            "steps": jnp.zeros(0),
+            "cat_log_probs": b_probs[:, None, :],
+        }
+        apack = {
+            "log_weights": a_logw,
+            "mus": jnp.zeros((a_logw.shape[0], 0)),
+            "sigmas": jnp.ones((a_logw.shape[0], 0)),
+            "lows": jnp.zeros(0),
+            "highs": jnp.zeros(0),
+            "steps": jnp.zeros(0),
+            "cat_log_probs": a_probs[:, None, :],
+        }
+        x_num, x_cat = _sample_from(key, bpack, n_samples)
+        score = _component_log_pdf(x_num, x_cat, bpack) - _component_log_pdf(
+            x_num, x_cat, apack
+        )
+        return x_cat[jnp.argmax(score), 0]
+
+    key = jax.random.PRNGKey(seed)
+    Dn = below["mus"].shape[0] if below["mus"].ndim == 2 else 0
+    Dc = below["cat_log_probs"].shape[0] if below["cat_log_probs"].ndim == 3 else 0
+
+    num_out = jnp.zeros(0)
+    cat_out = jnp.zeros(0, dtype=jnp.int32)
+    if Dn > 0:
+        keys = jax.random.split(key, Dn)
+        num_out = jax.vmap(one_num_dim)(
+            keys,
+            below["num_log_weights"],
+            below["mus"],
+            below["sigmas"],
+            above["num_log_weights"],
+            above["mus"],
+            above["sigmas"],
+            below["lows"],
+            below["highs"],
+            below["steps"],
+        )
+    if Dc > 0:
+        keys = jax.random.split(jax.random.fold_in(key, 1), Dc)
+        cat_out = jax.vmap(one_cat_dim)(
+            keys,
+            below["cat_log_weights"],
+            below["cat_log_probs"],
+            above["cat_log_weights"],
+            above["cat_log_probs"],
+        )
+    return num_out, cat_out

@@ -127,7 +127,16 @@ class TPESampler(BaseSampler):
         self, study: "Study", trial: FrozenTrial
     ) -> dict[str, BaseDistribution]:
         if not self._multivariate:
-            return {}
+            # Univariate TPE still claims the intersection space so all dims
+            # can be suggested in ONE batched device dispatch (each dim keeps
+            # its own independent 1-D KDE — the classic algorithm, just not
+            # one round-trip per parameter). Params outside the intersection
+            # fall back to sample_independent as usual.
+            return {
+                name: dist
+                for name, dist in self._search_space.calculate(study).items()
+                if not dist.single()
+            }
         search_space: dict[str, BaseDistribution] = {}
         if self._group:
             assert self._group_decomposed_search_space is None or True
@@ -183,7 +192,113 @@ class TPESampler(BaseSampler):
         n = sum(t.state in states for t in trials)
         if n < self._n_startup_trials:
             return {}
+        if not self._multivariate:
+            return self._sample_univariate_batch(study, trial, search_space)
         return self._sample(study, trial, search_space)
+
+    def _sample_univariate_batch(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        search_space: dict[str, BaseDistribution],
+    ) -> dict[str, Any]:
+        """All per-dim independent TPE problems in one fused dispatch."""
+        import jax.numpy as jnp
+
+        from optuna_tpu.distributions import CategoricalDistribution
+
+        states: tuple[TrialState, ...]
+        if self._constant_liar:
+            states = (TrialState.COMPLETE, TrialState.PRUNED, TrialState.RUNNING)
+        else:
+            states = (TrialState.COMPLETE, TrialState.PRUNED)
+        trials = study._get_trials(deepcopy=False, states=states, use_cache=not self._constant_liar)
+        trials = [t for t in trials if all(p in t.params for p in search_space)]
+        n_finished = sum(t.state in (TrialState.COMPLETE, TrialState.PRUNED) for t in trials)
+        below_trials, above_trials = _split_trials(
+            study, trials, self._gamma(n_finished), self._constraints_func is not None
+        )
+
+        num_names = [n for n, d in search_space.items() if not isinstance(d, CategoricalDistribution)]
+        cat_names = [n for n, d in search_space.items() if isinstance(d, CategoricalDistribution)]
+
+        def build(trial_set: list[FrozenTrial], below: bool):
+            weights = None
+            if below and study._is_multi_objective():
+                # Loop-invariant: one HSSP-contribution computation per set.
+                weights = _calculate_weights_below_for_multi_objective(study, trial_set)
+            estimators = {}
+            for name in search_space:
+                obs = {
+                    name: np.asarray(
+                        [t.distributions[name].to_internal_repr(t.params[name]) for t in trial_set],
+                        dtype=np.float64,
+                    )
+                }
+                estimators[name] = _ParzenEstimator(
+                    obs, {name: search_space[name]}, self._parzen_estimator_parameters, weights
+                )
+            return estimators
+
+        below_est = build(below_trials, True)
+        above_est = build(above_trials, False)
+
+        def stack(estimators, names):
+            packs = [estimators[n].pack() for n in names]
+            out: dict[str, np.ndarray] = {}
+            num = [p for p in packs if p["mus"].shape[1] == 1]
+            cat = [p for p in packs if p["cat_log_probs"].shape[1] == 1]
+            if num:
+                out["num_log_weights"] = np.stack([p["log_weights"] for p in num])
+                out["mus"] = np.stack([p["mus"][:, 0] for p in num])
+                out["sigmas"] = np.stack([p["sigmas"][:, 0] for p in num])
+                out["lows"] = np.stack([p["lows"][0] for p in num])
+                out["highs"] = np.stack([p["highs"][0] for p in num])
+                out["steps"] = np.stack([p["steps"][0] for p in num])
+            else:
+                out["num_log_weights"] = np.zeros((0, 1))
+                out["mus"] = np.zeros((0, 1))
+                out["sigmas"] = np.ones((0, 1))
+                out["lows"] = np.zeros(0)
+                out["highs"] = np.ones(0)
+                out["steps"] = np.zeros(0)
+            if cat:
+                cmax = max(p["cat_log_probs"].shape[2] for p in cat)
+                probs = np.full((len(cat), cat[0]["cat_log_probs"].shape[0], cmax), -np.inf)
+                for i, p in enumerate(cat):
+                    c = p["cat_log_probs"].shape[2]
+                    probs[i, :, :c] = p["cat_log_probs"][:, 0, :]
+                out["cat_log_weights"] = np.stack([p["log_weights"] for p in cat])
+                out["cat_log_probs"] = probs
+            else:
+                out["cat_log_weights"] = np.zeros((0, 1))
+                out["cat_log_probs"] = np.zeros((0, 1, 1))
+            return out
+
+        import jax
+
+        ordered = num_names + cat_names
+        below_pack = stack(below_est, ordered)
+        above_pack = stack(above_est, ordered)
+        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
+        num_out, cat_out = _kernels.sample_and_score_univariate_batch(
+            seed,
+            {k: jnp.asarray(v) for k, v in below_pack.items()},
+            {k: jnp.asarray(v) for k, v in above_pack.items()},
+            self._n_ei_candidates,
+        )
+        num_out, cat_out = jax.device_get((num_out, cat_out))
+        num_out = np.asarray(num_out)
+        cat_out = np.asarray(cat_out)
+
+        params: dict[str, Any] = {}
+        for i, name in enumerate(num_names):
+            internal = below_est[name].decode(num_out[i : i + 1], np.zeros(0))[name]
+            params[name] = search_space[name].to_external_repr(internal)
+        for i, name in enumerate(cat_names):
+            internal = below_est[name].decode(np.zeros(0), cat_out[i : i + 1])[name]
+            params[name] = search_space[name].to_external_repr(internal)
+        return params
 
     def sample_independent(
         self,
@@ -235,15 +350,17 @@ class TPESampler(BaseSampler):
         below = self._build_parzen(below_trials, study, search_space, below=True)
         above = self._build_parzen(above_trials, study, search_space, below=False)
 
+        import jax
         import jax.numpy as jnp
 
-        key = self._rng.jax_key()
+        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
         x_num, x_cat, _ = _kernels.sample_and_score(
-            key,
+            seed,
             {k: jnp.asarray(v) for k, v in below.pack().items()},
             {k: jnp.asarray(v) for k, v in above.pack().items()},
             self._n_ei_candidates,
         )
+        x_num, x_cat = jax.device_get((x_num, x_cat))
         internal = below.decode(np.asarray(x_num), np.asarray(x_cat))
         return {
             name: search_space[name].to_external_repr(internal[name])
@@ -270,6 +387,54 @@ class TPESampler(BaseSampler):
         return _ParzenEstimator(
             observations, search_space, self._parzen_estimator_parameters, weights
         )
+
+    def sample_relative_batch(
+        self,
+        study: "Study",
+        search_space: dict[str, BaseDistribution],
+        n: int,
+    ) -> list[dict[str, Any]] | None:
+        """Propose n joint candidates in ONE device dispatch (used by
+        :func:`optuna_tpu.parallel.vectorized.optimize_vectorized`).
+
+        Requires a fittable history; returns None to request the per-trial
+        fallback (startup phase or empty space).
+        """
+        if not search_space:
+            return None
+        states = (TrialState.COMPLETE, TrialState.PRUNED)
+        trials = study._get_trials(deepcopy=False, states=states, use_cache=False)
+        trials = [t for t in trials if all(p in t.params for p in search_space)]
+        if len(trials) < self._n_startup_trials:
+            return None
+
+        import jax
+        import jax.numpy as jnp
+
+        below_trials, above_trials = _split_trials(
+            study, trials, self._gamma(len(trials)), self._constraints_func is not None
+        )
+        below = self._build_parzen(below_trials, study, search_space, below=True)
+        above = self._build_parzen(above_trials, study, search_space, below=False)
+        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
+        x_num, x_cat = _kernels.sample_and_score_topk(
+            seed,
+            {k: jnp.asarray(v) for k, v in below.pack().items()},
+            {k: jnp.asarray(v) for k, v in above.pack().items()},
+            max(self._n_ei_candidates, 4 * n),
+            n,
+        )
+        x_num, x_cat = jax.device_get((x_num, x_cat))
+        out = []
+        for i in range(n):
+            internal = below.decode(np.asarray(x_num[i]), np.asarray(x_cat[i]))
+            out.append(
+                {
+                    name: search_space[name].to_external_repr(internal[name])
+                    for name in search_space
+                }
+            )
+        return out
 
     def after_trial(
         self,
