@@ -1,0 +1,281 @@
+"""Command-line interface.
+
+Parity target: ``optuna/cli.py:814-977`` — 11 subcommands including shell
+level ``ask``/``tell`` for driving distributed loops from scripts, with
+json/table/yaml output formats (``:156-273``).
+
+Entry points: ``python -m optuna_tpu.cli ...`` or the ``optuna-tpu`` console
+script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+from typing import Any, Sequence
+
+from optuna_tpu.exceptions import CLIUsageError, OptunaTPUError
+
+
+def _storage(args: argparse.Namespace):
+    from optuna_tpu.storages import get_storage
+
+    if not args.storage:
+        raise CLIUsageError("--storage is required for this command.")
+    return get_storage(args.storage)
+
+
+def _format_output(rows: list[dict[str, Any]], fmt: str) -> str:
+    if fmt == "json":
+        return json.dumps(rows, default=str)
+    if fmt == "yaml":
+        out = []
+        for row in rows:
+            out.append("- " + "\n  ".join(f"{k}: {v}" for k, v in row.items()))
+        return "\n".join(out)
+    # table
+    if not rows:
+        return "(empty)"
+    cols = list(rows[0].keys())
+    widths = {c: max(len(str(c)), *(len(str(r.get(c, ""))) for r in rows)) for c in cols}
+    lines = [
+        " | ".join(str(c).ljust(widths[c]) for c in cols),
+        "-+-".join("-" * widths[c] for c in cols),
+    ]
+    for r in rows:
+        lines.append(" | ".join(str(r.get(c, "")).ljust(widths[c]) for c in cols))
+    return "\n".join(lines)
+
+
+def _trial_row(t) -> dict[str, Any]:
+    return {
+        "number": t.number,
+        "state": t.state.name,
+        "values": t.values,
+        "datetime_start": t.datetime_start,
+        "datetime_complete": t.datetime_complete,
+        "params": json.dumps(t.params, default=str),
+    }
+
+
+def _cmd_create_study(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    directions = None
+    if args.directions:
+        directions = args.directions
+    study = optuna_tpu.create_study(
+        storage=_storage(args),
+        study_name=args.study_name,
+        direction=None if directions else args.direction,
+        directions=directions,
+        load_if_exists=args.skip_if_exists,
+    )
+    print(study.study_name)
+
+
+def _cmd_delete_study(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    optuna_tpu.delete_study(study_name=args.study_name, storage=_storage(args))
+
+
+def _cmd_studies(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    summaries = optuna_tpu.get_all_study_summaries(_storage(args))
+    rows = [
+        {
+            "name": s.study_name,
+            "direction": ",".join(d.name for d in s.directions),
+            "n_trials": s.n_trials,
+            "datetime_start": s.datetime_start,
+        }
+        for s in summaries
+    ]
+    print(_format_output(rows, args.format))
+
+
+def _cmd_trials(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    study = optuna_tpu.load_study(study_name=args.study_name, storage=_storage(args))
+    print(_format_output([_trial_row(t) for t in study.trials], args.format))
+
+
+def _cmd_best_trial(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    study = optuna_tpu.load_study(study_name=args.study_name, storage=_storage(args))
+    print(_format_output([_trial_row(study.best_trial)], args.format))
+
+
+def _cmd_best_trials(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    study = optuna_tpu.load_study(study_name=args.study_name, storage=_storage(args))
+    print(_format_output([_trial_row(t) for t in study.best_trials], args.format))
+
+
+def _cmd_study_set_user_attr(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    study = optuna_tpu.load_study(study_name=args.study_name, storage=_storage(args))
+    study.set_user_attr(args.key, json.loads(args.value) if args.json_value else args.value)
+
+
+def _cmd_storage_upgrade(args: argparse.Namespace) -> None:
+    # Schema v1 is current; future migrations hook in here (reference keeps
+    # alembic migrations, we keep PRAGMA user_version steps).
+    from optuna_tpu.storages._rdb.storage import SCHEMA_VERSION, RDBStorage
+
+    RDBStorage(args.storage)  # creating it runs/validates the schema
+    print(f"Storage is up to date (schema version {SCHEMA_VERSION}).")
+
+
+def _parse_sampler(args: argparse.Namespace):
+    if not args.sampler:
+        return None
+    import optuna_tpu.samplers as samplers_mod
+
+    cls = getattr(samplers_mod, args.sampler, None)
+    if cls is None:
+        raise CLIUsageError(f"Unknown sampler: {args.sampler}")
+    kwargs = json.loads(args.sampler_kwargs) if args.sampler_kwargs else {}
+    return cls(**kwargs)
+
+
+def _cmd_ask(args: argparse.Namespace) -> None:
+    """Create (or load) the study, ask one trial, print its number + params
+    (reference ``cli.py:655``)."""
+    import optuna_tpu
+
+    directions = args.directions if args.directions else None
+    try:
+        study = optuna_tpu.load_study(
+            study_name=args.study_name, storage=_storage(args), sampler=_parse_sampler(args)
+        )
+    except KeyError:
+        study = optuna_tpu.create_study(
+            storage=_storage(args),
+            study_name=args.study_name,
+            direction=None if directions else args.direction,
+            directions=directions,
+            load_if_exists=True,
+            sampler=_parse_sampler(args),
+        )
+    search_space = (
+        {
+            name: optuna_tpu.distributions.json_to_distribution(json.dumps(d))
+            for name, d in json.loads(args.search_space).items()
+        }
+        if args.search_space
+        else None
+    )
+    trial = study.ask(fixed_distributions=search_space)
+    print(json.dumps({"number": trial.number, "params": trial.params}, default=str))
+
+
+def _cmd_tell(args: argparse.Namespace) -> None:
+    """Report a finished trial by number (reference ``cli.py:760``)."""
+    import optuna_tpu
+    from optuna_tpu.trial import TrialState
+
+    study = optuna_tpu.load_study(study_name=args.study_name, storage=_storage(args))
+    state = None
+    if args.state:
+        state = TrialState[args.state.upper()]
+    values = [float(v) for v in args.values] if args.values else None
+    study.tell(
+        args.trial_number,
+        values=values if values is None or len(values) > 1 else values[0],
+        state=state,
+        skip_if_finished=args.skip_if_finished,
+    )
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="optuna-tpu")
+    parser.add_argument("--storage", default=None, help="DB/journal/grpc URL")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def add(name: str, fn, **extra):
+        p = sub.add_parser(name)
+        p.set_defaults(func=fn)
+        # SUPPRESS so a subcommand-level --storage overrides but an absent one
+        # does NOT clobber the top-level `optuna-tpu --storage URL <cmd>` form.
+        p.add_argument("--storage", default=argparse.SUPPRESS)
+        return p
+
+    p = add("create-study", _cmd_create_study)
+    p.add_argument("--study-name", default=None)
+    p.add_argument("--direction", default="minimize")
+    p.add_argument("--directions", nargs="*", default=None)
+    p.add_argument("--skip-if-exists", action="store_true")
+
+    p = add("delete-study", _cmd_delete_study)
+    p.add_argument("--study-name", required=True)
+
+    p = add("studies", _cmd_studies)
+    p.add_argument("-f", "--format", default="table", choices=["table", "json", "yaml"])
+
+    p = add("trials", _cmd_trials)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("-f", "--format", default="table", choices=["table", "json", "yaml"])
+
+    p = add("best-trial", _cmd_best_trial)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("-f", "--format", default="table", choices=["table", "json", "yaml"])
+
+    p = add("best-trials", _cmd_best_trials)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("-f", "--format", default="table", choices=["table", "json", "yaml"])
+
+    p = add("study-set-user-attr", _cmd_study_set_user_attr)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("--key", required=True)
+    p.add_argument("--value", required=True)
+    p.add_argument("--json-value", action="store_true")
+
+    p = add("storage-upgrade", _cmd_storage_upgrade)
+
+    p = add("ask", _cmd_ask)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("--direction", default="minimize")
+    p.add_argument("--directions", nargs="*", default=None)
+    p.add_argument("--sampler", default=None)
+    p.add_argument("--sampler-kwargs", default=None)
+    p.add_argument("--search-space", default=None)
+
+    p = add("tell", _cmd_tell)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("--trial-number", type=int, required=True)
+    p.add_argument("--values", nargs="*", default=None)
+    p.add_argument("--state", default=None)
+    p.add_argument("--skip-if-finished", action="store_true")
+
+    return parser
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    import optuna_tpu
+
+    optuna_tpu.logging.set_verbosity(optuna_tpu.logging.WARNING)
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+    try:
+        args.func(args)
+    except CLIUsageError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+    except (KeyError, ValueError, OptunaTPUError) as e:
+        message = e.args[0] if e.args else str(e)
+        print(f"Error: {message}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""Mean-decrease-impurity importance (reference
+``optuna/importance/_mean_decrease_impurity.py``): the random forest's own
+``feature_importances_``, one-hot columns collapsed per parameter."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+import numpy as np
+
+from optuna_tpu.importance._evaluate import _get_filtered_trials, _target_values
+from optuna_tpu.transform import SearchSpaceTransform
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+
+class MeanDecreaseImpurityImportanceEvaluator:
+    def __init__(self, *, n_trees: int = 64, max_depth: int = 64, seed: int | None = None) -> None:
+        self._n_trees = n_trees
+        self._max_depth = max_depth
+        self._seed = seed
+
+    def evaluate(
+        self,
+        study: "Study",
+        params: list[str] | None = None,
+        *,
+        target: Callable | None = None,
+    ) -> dict[str, float]:
+        from sklearn.ensemble import RandomForestRegressor
+
+        trials, params = _get_filtered_trials(study, params, target)
+        space = {p: trials[0].distributions[p] for p in params}
+        trans = SearchSpaceTransform(space, transform_log=True, transform_step=True, transform_0_1=True)
+        X = trans.encode_many([t.params for t in trials])
+        y = _target_values(trials, target)
+
+        forest = RandomForestRegressor(
+            n_estimators=self._n_trees, max_depth=self._max_depth, random_state=self._seed
+        )
+        forest.fit(X, y)
+        feat = forest.feature_importances_
+
+        importances = {p: 0.0 for p in params}
+        for enc_col, col in enumerate(trans.encoded_column_to_column):
+            importances[params[int(col)]] += float(feat[enc_col])
+        return dict(sorted(importances.items(), key=lambda kv: kv[1], reverse=True))

@@ -1,0 +1,91 @@
+"""PED-ANOVA importance (reference ``optuna/importance/_ped_anova/evaluator.py``).
+
+Per-parameter Pearson divergence between the distribution of the top-gamma
+quantile trials and a baseline set (all trials), estimated with Scott-rule
+Gaussian KDEs on the [0,1]-transformed values — KDE evaluation is a dense
+vectorized computation, vmappable by construction.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+import numpy as np
+
+from optuna_tpu.distributions import CategoricalDistribution
+from optuna_tpu.importance._evaluate import _get_filtered_trials, _target_values
+from optuna_tpu.study._study_direction import StudyDirection
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+
+def _scott_bandwidth(x: np.ndarray) -> float:
+    n = len(x)
+    sd = float(np.std(x))
+    if sd <= 0:
+        sd = 1e-3
+    return max(1.06 * sd * n ** (-0.2), 1e-3)
+
+
+def _kde_on_grid(x: np.ndarray, grid: np.ndarray) -> np.ndarray:
+    h = _scott_bandwidth(x)
+    z = (grid[:, None] - x[None, :]) / h
+    dens = np.exp(-0.5 * z * z).sum(axis=1) / (len(x) * h * np.sqrt(2 * np.pi))
+    return np.maximum(dens, 1e-12)
+
+
+class PedAnovaImportanceEvaluator:
+    def __init__(self, *, baseline_quantile: float = 0.1, evaluate_on_local: bool = True) -> None:
+        if not 0 < baseline_quantile <= 1:
+            raise ValueError("baseline_quantile must be in (0, 1].")
+        self._gamma = baseline_quantile
+        self._evaluate_on_local = evaluate_on_local
+
+    def evaluate(
+        self,
+        study: "Study",
+        params: list[str] | None = None,
+        *,
+        target: Callable | None = None,
+    ) -> dict[str, float]:
+        trials, params = _get_filtered_trials(study, params, target)
+        values = _target_values(trials, target)
+        if target is None and study.direction == StudyDirection.MAXIMIZE:
+            values = -values
+        order = np.argsort(values)
+        n_top = max(2, int(np.ceil(self._gamma * len(trials))))
+        top_idx = set(order[:n_top].tolist())
+
+        importances: dict[str, float] = {}
+        grid = np.linspace(0.0, 1.0, 64)
+        for p in params:
+            dist = trials[0].distributions[p]
+            if isinstance(dist, CategoricalDistribution):
+                n_choices = len(dist.choices)
+                counts_all = np.ones(n_choices)  # +1 smoothing
+                counts_top = np.ones(n_choices)
+                for i, t in enumerate(trials):
+                    ci = int(dist.to_internal_repr(t.params[p]))
+                    counts_all[ci] += 1
+                    if i in top_idx:
+                        counts_top[ci] += 1
+                p_all = counts_all / counts_all.sum()
+                p_top = counts_top / counts_top.sum()
+                # Pearson divergence sum over choices.
+                importances[p] = float(np.sum(p_all * (p_top / p_all - 1.0) ** 2))
+            else:
+                raw = np.asarray(
+                    [dist.to_internal_repr(t.params[p]) for t in trials], dtype=np.float64
+                )
+                if getattr(dist, "log", False):
+                    raw = np.log(raw)
+                    lo, hi = np.log(dist.low), np.log(dist.high)
+                else:
+                    lo, hi = dist.low, dist.high
+                x = (raw - lo) / max(hi - lo, 1e-12)
+                x_top = np.asarray([x[i] for i in range(len(trials)) if i in top_idx])
+                d_all = _kde_on_grid(x, grid)
+                d_top = _kde_on_grid(x_top, grid)
+                importances[p] = float(np.mean(d_all * (d_top / d_all - 1.0) ** 2))
+        return dict(sorted(importances.items(), key=lambda kv: kv[1], reverse=True))

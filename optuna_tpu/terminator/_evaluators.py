@@ -1,0 +1,239 @@
+"""Improvement and error evaluators for the terminator."""
+
+from __future__ import annotations
+
+import abc
+import math
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from optuna_tpu.logging import get_logger
+from optuna_tpu.search_space import intersection_search_space
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from optuna_tpu.trial._trial import Trial
+
+_logger = get_logger(__name__)
+
+_CROSS_VALIDATION_SCORES_KEY = "terminator:cv_scores"
+DEFAULT_MIN_N_TRIALS = 20
+
+
+class BaseImprovementEvaluator(abc.ABC):
+    @abc.abstractmethod
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        raise NotImplementedError
+
+
+class BaseErrorEvaluator(abc.ABC):
+    @abc.abstractmethod
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        raise NotImplementedError
+
+
+def _complete_trials(trials: list[FrozenTrial]) -> list[FrozenTrial]:
+    return [t for t in trials if t.state == TrialState.COMPLETE and t.value is not None]
+
+
+class RegretBoundEvaluator(BaseImprovementEvaluator):
+    """GP-UCB simple-regret bound: max UCB - max LCB over observed points
+    (reference ``terminator/improvement/evaluator.py:97``), computed with the
+    framework's own JAX GP instead of a torch one."""
+
+    def __init__(self, min_n_trials: int = DEFAULT_MIN_N_TRIALS) -> None:
+        self._min_n_trials = min_n_trials
+
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.gp import fit_gp, posterior
+        from optuna_tpu.gp.search_space import SearchSpace
+
+        complete = _complete_trials(trials)
+        if len(complete) < self._min_n_trials:
+            return float("inf")
+        space_dict = intersection_search_space(complete)
+        space_dict = {k: v for k, v in space_dict.items() if not v.single()}
+        if not space_dict:
+            return float("inf")
+        space = SearchSpace(space_dict)
+        complete = [t for t in complete if all(p in t.params for p in space_dict)]
+        X = space.normalize([t.params for t in complete]).astype(np.float32)
+        values = np.asarray([t.value for t in complete], dtype=np.float64)
+        score = values if study_direction == StudyDirection.MAXIMIZE else -values
+        mu, sd = float(np.mean(score)), float(np.std(score))
+        sd = sd if sd > 1e-12 else 1.0
+        y = ((score - mu) / sd).astype(np.float32)
+
+        state, _ = fit_gp(X, y, np.asarray(space.is_categorical), seed=0)
+        # beta from the GP-UCB analysis (reference uses beta = 2 log(d n^2 ...)).
+        n, d = X.shape
+        beta = 2.0 * math.log(max(d * n * n, 2))
+        mean, var = posterior(state, jnp.asarray(X), jnp.asarray(space.is_categorical))
+        mean = np.asarray(mean)[: len(complete)]
+        sigma = np.sqrt(np.asarray(var)[: len(complete)])
+        ucb = float(np.max(mean + math.sqrt(beta) * sigma))
+        lcb = float(np.max(mean - math.sqrt(beta) * sigma))
+        return (ucb - lcb) * sd  # back to the objective's scale
+
+
+class BestValueStagnationEvaluator(BaseImprovementEvaluator):
+    """Steps since the best value last improved (reference ``evaluator.py:196``)."""
+
+    def __init__(self, max_stagnation_trials: int = 30) -> None:
+        if max_stagnation_trials < 0:
+            raise ValueError("max_stagnation_trials must be nonnegative.")
+        self._max_stagnation_trials = max_stagnation_trials
+
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        complete = _complete_trials(trials)
+        if not complete:
+            return float("inf")
+        maximize = study_direction == StudyDirection.MAXIMIZE
+        best_i = 0
+        best_v = complete[0].value
+        for i, t in enumerate(complete):
+            assert t.value is not None
+            if (maximize and t.value > best_v) or (not maximize and t.value < best_v):
+                best_i, best_v = i, t.value
+        stagnation = len(complete) - 1 - best_i
+        return float(self._max_stagnation_trials - stagnation)
+
+
+class EMMREvaluator(BaseImprovementEvaluator):
+    """Expected minimum model regret (reference ``improvement/emmr.py:43``):
+    MC estimate of E[min posterior] improvement between successive models —
+    approximated here by the posterior-sample minimum gap on observed points."""
+
+    def __init__(self, min_n_trials: int = DEFAULT_MIN_N_TRIALS, n_samples: int = 128, seed: int = 0) -> None:
+        self._min_n_trials = min_n_trials
+        self._n_samples = n_samples
+        self._seed = seed
+
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.gp import fit_gp, posterior
+        from optuna_tpu.gp.search_space import SearchSpace
+
+        complete = _complete_trials(trials)
+        if len(complete) < max(self._min_n_trials, 3):
+            return float("inf")
+        space_dict = {
+            k: v for k, v in intersection_search_space(complete).items() if not v.single()
+        }
+        if not space_dict:
+            return float("inf")
+        space = SearchSpace(space_dict)
+        complete = [t for t in complete if all(p in t.params for p in space_dict)]
+        X = space.normalize([t.params for t in complete]).astype(np.float32)
+        values = np.asarray([t.value for t in complete], dtype=np.float64)
+        score = values if study_direction == StudyDirection.MAXIMIZE else -values
+        mu, sd = float(np.mean(score)), float(np.std(score))
+        sd = sd if sd > 1e-12 else 1.0
+        y = ((score - mu) / sd).astype(np.float32)
+
+        cat = np.asarray(space.is_categorical)
+        state_now, _ = fit_gp(X, y, cat, seed=self._seed)
+        state_prev, _ = fit_gp(X[:-1], y[:-1], cat, seed=self._seed)
+
+        mean_n, var_n = posterior(state_now, jnp.asarray(X), jnp.asarray(cat))
+        mean_p, var_p = posterior(state_prev, jnp.asarray(X), jnp.asarray(cat))
+        key = jax.random.PRNGKey(self._seed)
+        z = jax.random.normal(key, (self._n_samples, len(complete)))
+        samp_n = np.asarray(mean_n)[None, : len(complete)] + np.asarray(z) * np.sqrt(
+            np.asarray(var_n)[None, : len(complete)]
+        )
+        samp_p = np.asarray(mean_p)[None, : len(complete)] + np.asarray(z) * np.sqrt(
+            np.asarray(var_p)[None, : len(complete)]
+        )
+        # Internal scores are maximized: regret gap of the model max.
+        gap = float(np.mean(np.abs(samp_n.max(axis=1) - samp_p.max(axis=1))))
+        return gap * sd
+
+
+class CrossValidationErrorEvaluator(BaseErrorEvaluator):
+    """Variance of reported CV scores scaled by (k+1)/k (reference
+    ``erroreval.py``); scores arrive via report_cross_validation_scores."""
+
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        maximize = study_direction == StudyDirection.MAXIMIZE
+        best = None
+        for t in _complete_trials(trials):
+            if best is None:
+                best = t
+            elif maximize and t.value > best.value:
+                best = t
+            elif not maximize and t.value < best.value:
+                best = t
+        if best is None:
+            return float("nan")
+        scores = best.system_attrs.get(_CROSS_VALIDATION_SCORES_KEY)
+        if scores is None:
+            raise ValueError(
+                "Cross-validation scores have not been reported. Use "
+                "report_cross_validation_scores(trial, scores) inside the objective."
+            )
+        k = len(scores)
+        if k <= 1:
+            raise ValueError("At least two cross-validation scores are required.")
+        var = float(np.var(scores, ddof=1))
+        return var * (k + 1) / k
+
+
+class StaticErrorEvaluator(BaseErrorEvaluator):
+    def __init__(self, constant: float) -> None:
+        self._constant = constant
+
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        return self._constant
+
+
+class MedianErrorEvaluator(BaseErrorEvaluator):
+    """Median of a paired improvement evaluator's history scaled by a factor
+    (reference ``median_erroreval.py``) — an error proxy when no CV scores exist."""
+
+    def __init__(
+        self,
+        paired_improvement_evaluator: BaseImprovementEvaluator | None = None,
+        warm_up_trials: int = 10,
+        n_min_trials: int = 20,
+        scale: float = 1.5,
+    ) -> None:
+        self._paired = paired_improvement_evaluator
+        self._warm_up_trials = warm_up_trials
+        self._n_min_trials = n_min_trials
+        self._scale = scale
+
+    def evaluate(self, trials: list[FrozenTrial], study_direction: StudyDirection) -> float:
+        complete = _complete_trials(trials)
+        if len(complete) < max(self._warm_up_trials + self._n_min_trials, 2):
+            return -float("inf")  # never terminates this early
+        trimmed = complete[self._warm_up_trials :]
+        if self._paired is not None:
+            improvements = [
+                self._paired.evaluate(trimmed[: i + 1], study_direction)
+                for i in range(self._n_min_trials - 1, len(trimmed))
+            ]
+            finite = [v for v in improvements if math.isfinite(v)]
+            if not finite:
+                return -float("inf")
+            return self._scale * float(np.median(finite))
+        deltas = np.abs(np.diff([t.value for t in trimmed]))
+        if len(deltas) == 0:
+            return -float("inf")
+        return self._scale * float(np.median(deltas))
+
+
+def report_cross_validation_scores(trial: "Trial", scores: list[float]) -> None:
+    """Record per-fold CV scores for CrossValidationErrorEvaluator."""
+    if len(scores) <= 1:
+        raise ValueError("The number of scores must be greater than one.")
+    trial.storage.set_trial_system_attr(
+        trial._trial_id, _CROSS_VALIDATION_SCORES_KEY, list(map(float, scores))
+    )

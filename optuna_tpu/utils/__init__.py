@@ -1,0 +1,21 @@
+"""Cross-cutting infra (reference ``optuna/_imports.py``, ``_experimental.py``,
+``_deprecated.py``, ``_convert_positional_args.py``)."""
+
+from optuna_tpu.utils._compat import (
+    convert_positional_args,
+    deprecated_class,
+    deprecated_func,
+    experimental_class,
+    experimental_func,
+)
+from optuna_tpu.utils._imports import _LazyImport, try_import
+
+__all__ = [
+    "_LazyImport",
+    "convert_positional_args",
+    "deprecated_class",
+    "deprecated_func",
+    "experimental_class",
+    "experimental_func",
+    "try_import",
+]

@@ -1,0 +1,60 @@
+"""Visualization (reference ``optuna/visualization/__init__.py:1-32``).
+
+The reference's primary backend is plotly with a matplotlib mirror. This
+image ships matplotlib but not plotly, so the matplotlib implementations in
+:mod:`optuna_tpu.visualization.matplotlib` are the working set; the top-level
+``plot_*`` names dispatch to plotly when it is importable and raise a
+pointed ImportError otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from optuna_tpu.visualization import matplotlib  # noqa: F401  (the working backend)
+
+_PLOT_NAMES = [
+    "plot_contour",
+    "plot_edf",
+    "plot_hypervolume_history",
+    "plot_intermediate_values",
+    "plot_optimization_history",
+    "plot_parallel_coordinate",
+    "plot_param_importances",
+    "plot_pareto_front",
+    "plot_rank",
+    "plot_slice",
+    "plot_terminator_improvement",
+    "plot_timeline",
+]
+
+__all__ = _PLOT_NAMES + ["is_available", "matplotlib"]
+
+
+def is_available() -> bool:
+    try:
+        import plotly  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _make_dispatch(name: str):
+    def plot(*args: Any, **kwargs: Any):
+        if not is_available():
+            raise ImportError(
+                f"`optuna_tpu.visualization.{name}` requires plotly, which is not "
+                f"installed. Use `optuna_tpu.visualization.matplotlib.{name}` instead."
+            )
+        raise NotImplementedError(
+            "The plotly backend is not implemented in this build; use "
+            f"`optuna_tpu.visualization.matplotlib.{name}`."
+        )
+
+    plot.__name__ = name
+    return plot
+
+
+for _name in _PLOT_NAMES:
+    globals()[_name] = _make_dispatch(_name)

@@ -1,0 +1,368 @@
+"""The 12 study plots, matplotlib edition.
+
+Parity targets: ``optuna/visualization/_*.py`` (plotly) and their matplotlib
+mirrors (~6.5k LoC in the reference). Each function returns the Axes so
+callers can style/save; figures are created with the non-interactive Agg
+backend in headless environments.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Sequence
+
+import numpy as np
+
+from optuna_tpu.logging import get_logger
+from optuna_tpu.study._multi_objective import _get_pareto_front_trials
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from matplotlib.axes import Axes
+
+    from optuna_tpu.study.study import Study
+
+_logger = get_logger(__name__)
+
+
+def _axes(ax=None) -> "Axes":
+    import matplotlib.pyplot as plt
+
+    if ax is not None:
+        return ax
+    _, ax = plt.subplots()
+    return ax
+
+
+def _complete_trials(study: "Study"):
+    return [t for t in study.get_trials(deepcopy=False) if t.state == TrialState.COMPLETE]
+
+
+def _target_or_value(trial, target: Callable | None):
+    return target(trial) if target is not None else trial.value
+
+
+# ------------------------------------------------------------------- history
+
+
+def plot_optimization_history(
+    study: "Study", *, target: Callable | None = None, target_name: str = "Objective Value", ax=None
+) -> "Axes":
+    ax = _axes(ax)
+    trials = _complete_trials(study)
+    xs = [t.number for t in trials]
+    ys = [_target_or_value(t, target) for t in trials]
+    ax.scatter(xs, ys, s=12, alpha=0.6, label=target_name)
+    if target is None and not study._is_multi_objective():
+        best = (
+            np.minimum.accumulate(ys)
+            if study.direction == StudyDirection.MINIMIZE
+            else np.maximum.accumulate(ys)
+        )
+        ax.plot(xs, best, color="crimson", label="Best Value")
+    ax.set_xlabel("Trial")
+    ax.set_ylabel(target_name)
+    ax.set_title("Optimization History Plot")
+    ax.legend()
+    return ax
+
+
+def plot_intermediate_values(study: "Study", *, ax=None) -> "Axes":
+    ax = _axes(ax)
+    for t in study.get_trials(deepcopy=False):
+        if t.intermediate_values:
+            steps, vals = zip(*sorted(t.intermediate_values.items()))
+            ax.plot(steps, vals, alpha=0.4, label=f"Trial{t.number}")
+    ax.set_xlabel("Step")
+    ax.set_ylabel("Intermediate Value")
+    ax.set_title("Intermediate Values Plot")
+    return ax
+
+
+def plot_edf(
+    study: "Study | Sequence[Study]", *, target: Callable | None = None,
+    target_name: str = "Objective Value", ax=None
+) -> "Axes":
+    from optuna_tpu.study.study import Study as _Study
+
+    ax = _axes(ax)
+    studies = [study] if isinstance(study, _Study) else list(study)
+    for s in studies:
+        values = np.sort([_target_or_value(t, target) for t in _complete_trials(s)])
+        if len(values) == 0:
+            continue
+        ecdf = np.arange(1, len(values) + 1) / len(values)
+        ax.plot(values, ecdf, drawstyle="steps-post", label=s.study_name)
+    ax.set_xlabel(target_name)
+    ax.set_ylabel("Cumulative Probability")
+    ax.set_title("Empirical Distribution Function Plot")
+    ax.legend()
+    return ax
+
+
+# --------------------------------------------------------------- param plots
+
+
+def _param_values(trials, param: str) -> tuple[list, bool]:
+    from optuna_tpu.distributions import CategoricalDistribution
+
+    dist = next(t.distributions[param] for t in trials if param in t.distributions)
+    is_cat = isinstance(dist, CategoricalDistribution)
+    is_log = bool(getattr(dist, "log", False))
+    vals = [t.params[param] for t in trials]
+    return vals, is_log
+
+
+def plot_slice(
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None,
+    target_name: str = "Objective Value",
+) -> "np.ndarray":
+    import matplotlib.pyplot as plt
+
+    trials = _complete_trials(study)
+    if params is None:
+        from optuna_tpu.search_space import intersection_search_space
+
+        params = [k for k, v in intersection_search_space(trials).items() if not v.single()]
+    fig, axes = plt.subplots(1, max(len(params), 1), figsize=(4 * max(len(params), 1), 4))
+    axes = np.atleast_1d(axes)
+    for ax, p in zip(axes, params):
+        sub = [t for t in trials if p in t.params]
+        xs, is_log = _param_values(sub, p)
+        ys = [_target_or_value(t, target) for t in sub]
+        ax.scatter(xs, ys, s=12, alpha=0.6, c=[t.number for t in sub], cmap="Blues")
+        if is_log:
+            ax.set_xscale("log")
+        ax.set_xlabel(p)
+        ax.set_ylabel(target_name)
+    fig.suptitle("Slice Plot")
+    return axes
+
+
+def plot_contour(
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None, ax=None
+) -> "Axes":
+    trials = _complete_trials(study)
+    if params is None:
+        from optuna_tpu.search_space import intersection_search_space
+
+        params = [k for k, v in intersection_search_space(trials).items() if not v.single()][:2]
+    if len(params) != 2:
+        raise ValueError("plot_contour needs exactly two params (got %r)." % (params,))
+    ax = _axes(ax)
+    px, py = params
+    sub = [t for t in trials if px in t.params and py in t.params]
+    xs = np.asarray([float(t.params[px]) for t in sub])
+    ys = np.asarray([float(t.params[py]) for t in sub])
+    zs = np.asarray([_target_or_value(t, target) for t in sub])
+    if len(sub) >= 4:
+        tri = ax.tricontourf(xs, ys, zs, levels=14, cmap="viridis", alpha=0.8)
+        import matplotlib.pyplot as plt
+
+        plt.colorbar(tri, ax=ax)
+    ax.scatter(xs, ys, c="black", s=10)
+    ax.set_xlabel(px)
+    ax.set_ylabel(py)
+    ax.set_title("Contour Plot")
+    return ax
+
+
+def plot_rank(
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None
+) -> "np.ndarray":
+    import matplotlib.pyplot as plt
+    from scipy.stats import rankdata
+
+    trials = _complete_trials(study)
+    if params is None:
+        from optuna_tpu.search_space import intersection_search_space
+
+        params = [k for k, v in intersection_search_space(trials).items() if not v.single()]
+    values = np.asarray([_target_or_value(t, target) for t in trials])
+    ranks = rankdata(values)
+    fig, axes = plt.subplots(1, max(len(params), 1), figsize=(4 * max(len(params), 1), 4))
+    axes = np.atleast_1d(axes)
+    for ax, p in zip(axes, params):
+        mask = [p in t.params for t in trials]
+        xs = [t.params[p] for t, m in zip(trials, mask) if m]
+        sc = ax.scatter(xs, ranks[mask], c=ranks[mask], cmap="coolwarm", s=14)
+        ax.set_xlabel(p)
+        ax.set_ylabel("Rank")
+    fig.suptitle("Rank Plot")
+    return axes
+
+
+def plot_parallel_coordinate(
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None, ax=None
+) -> "Axes":
+    ax = _axes(ax)
+    trials = _complete_trials(study)
+    if params is None:
+        from optuna_tpu.search_space import intersection_search_space
+
+        params = [k for k, v in intersection_search_space(trials).items() if not v.single()]
+    trials = [t for t in trials if all(p in t.params for p in params)]
+    if not trials:
+        return ax
+    values = np.asarray([_target_or_value(t, target) for t in trials], dtype=float)
+    vmin, vmax = values.min(), values.max()
+    span = vmax - vmin if vmax > vmin else 1.0
+    import matplotlib.cm as cm
+
+    # Column 0 = objective, then one column per param, all min-max scaled.
+    columns = [values]
+    for p in params:
+        col = np.asarray([float(_numeric(t, p)) for t in trials])
+        lo, hi = col.min(), col.max()
+        columns.append((col - lo) / (hi - lo if hi > lo else 1.0))
+    columns[0] = (values - vmin) / span
+    mat = np.stack(columns, axis=1)
+    for i in range(len(trials)):
+        ax.plot(range(mat.shape[1]), mat[i], color=cm.viridis(1 - mat[i, 0]), alpha=0.4)
+    ax.set_xticks(range(mat.shape[1]))
+    ax.set_xticklabels(["Objective"] + params, rotation=30)
+    ax.set_title("Parallel Coordinate Plot")
+    return ax
+
+
+def _numeric(trial, p: str) -> float:
+    v = trial.params[p]
+    if isinstance(v, (int, float)):
+        return float(v)
+    return float(trial.distributions[p].to_internal_repr(v))
+
+
+def plot_param_importances(
+    study: "Study", *, evaluator=None, params: list[str] | None = None,
+    target: Callable | None = None, ax=None
+) -> "Axes":
+    from optuna_tpu.importance import get_param_importances
+
+    ax = _axes(ax)
+    importances = get_param_importances(study, evaluator=evaluator, params=params, target=target)
+    names = list(importances.keys())[::-1]
+    vals = [importances[n] for n in names]
+    ax.barh(names, vals, color="steelblue")
+    ax.set_xlabel("Importance")
+    ax.set_title("Hyperparameter Importances")
+    return ax
+
+
+# ----------------------------------------------------------- multi-objective
+
+
+def plot_pareto_front(
+    study: "Study", *, target_names: list[str] | None = None, ax=None,
+    include_dominated_trials: bool = True,
+) -> "Axes":
+    ax = _axes(ax)
+    if len(study.directions) != 2:
+        raise ValueError("plot_pareto_front supports 2-objective studies in this backend.")
+    trials = _complete_trials(study)
+    front = set(t.number for t in _get_pareto_front_trials(study))
+    names = target_names or (study.metric_names or ["Objective 0", "Objective 1"])
+    if include_dominated_trials:
+        dom = [t for t in trials if t.number not in front]
+        ax.scatter(
+            [t.values[0] for t in dom], [t.values[1] for t in dom],
+            s=12, alpha=0.4, label="Trial", color="steelblue",
+        )
+    par = [t for t in trials if t.number in front]
+    ax.scatter(
+        [t.values[0] for t in par], [t.values[1] for t in par],
+        s=22, label="Best Trial", color="crimson",
+    )
+    ax.set_xlabel(names[0])
+    ax.set_ylabel(names[1])
+    ax.set_title("Pareto-front Plot")
+    ax.legend()
+    return ax
+
+
+def plot_hypervolume_history(
+    study: "Study", reference_point: Sequence[float], *, ax=None
+) -> "Axes":
+    from optuna_tpu.hypervolume import compute_hypervolume
+    from optuna_tpu.study._multi_objective import _normalize_values
+
+    ax = _axes(ax)
+    trials = _complete_trials(study)
+    ref = np.asarray(reference_point, dtype=np.float64)
+    values = _normalize_values(
+        np.asarray([t.values for t in trials], dtype=np.float64), study.directions
+    )
+    signs = np.asarray(
+        [-1.0 if d == StudyDirection.MAXIMIZE else 1.0 for d in study.directions]
+    )
+    ref_n = ref * signs
+    hv = [
+        compute_hypervolume(values[: i + 1], ref_n) for i in range(len(trials))
+    ]
+    ax.plot([t.number for t in trials], hv, marker="o", ms=3)
+    ax.set_xlabel("Trial")
+    ax.set_ylabel("Hypervolume")
+    ax.set_title("Hypervolume History Plot")
+    return ax
+
+
+# ------------------------------------------------------------ ops/diagnostics
+
+
+def plot_timeline(study: "Study", *, ax=None) -> "Axes":
+    import matplotlib.dates as mdates
+    import matplotlib.patches as mpatches
+
+    ax = _axes(ax)
+    colors = {
+        TrialState.COMPLETE: "tab:blue",
+        TrialState.PRUNED: "tab:orange",
+        TrialState.FAIL: "tab:red",
+        TrialState.RUNNING: "tab:green",
+        TrialState.WAITING: "tab:gray",
+    }
+    for t in study.get_trials(deepcopy=False):
+        if t.datetime_start is None:
+            continue
+        start = mdates.date2num(t.datetime_start)
+        end = mdates.date2num(t.datetime_complete) if t.datetime_complete else start
+        ax.barh(t.number, max(end - start, 1e-9), left=start, color=colors[t.state], height=0.8)
+    ax.xaxis_date()
+    ax.set_xlabel("Datetime")
+    ax.set_ylabel("Trial")
+    ax.set_title("Timeline Plot")
+    handles = [mpatches.Patch(color=c, label=s.name) for s, c in colors.items()]
+    ax.legend(handles=handles, fontsize=7)
+    return ax
+
+
+def plot_terminator_improvement(
+    study: "Study", *, improvement_evaluator=None, error_evaluator=None,
+    min_n_trials: int = 20, ax=None,
+) -> "Axes":
+    from optuna_tpu.terminator import (
+        CrossValidationErrorEvaluator,
+        MedianErrorEvaluator,
+        RegretBoundEvaluator,
+    )
+
+    ax = _axes(ax)
+    improvement_evaluator = improvement_evaluator or RegretBoundEvaluator()
+    error_evaluator = error_evaluator or MedianErrorEvaluator()
+    trials = _complete_trials(study)
+    xs, improvements, errors = [], [], []
+    for i in range(min_n_trials, len(trials) + 1):
+        sub = trials[:i]
+        xs.append(sub[-1].number)
+        improvements.append(improvement_evaluator.evaluate(sub, study.direction))
+        try:
+            errors.append(error_evaluator.evaluate(sub, study.direction))
+        except ValueError:
+            errors.append(float("nan"))
+    ax.plot(xs, improvements, label="Improvement", marker="o", ms=3)
+    ax.plot(xs, errors, label="Error", marker="x", ms=3)
+    ax.set_xlabel("Trial")
+    ax.set_ylabel("Improvement / Error")
+    ax.set_yscale("symlog")
+    ax.set_title("Terminator Improvement Plot")
+    ax.legend()
+    return ax

@@ -1,0 +1,285 @@
+"""Terminator, importance, visualization, artifacts, CLI tests."""
+
+import json
+import os
+import subprocess
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import create_study
+from optuna_tpu.samplers import RandomSampler
+
+
+@pytest.fixture(scope="module")
+def quadratic_study():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.optimize(
+        lambda t: (t.suggest_float("important", -5, 5)) ** 2
+        + 0.01 * t.suggest_float("noise", -5, 5)
+        + (0 if t.suggest_categorical("c", ["a", "b"]) == "a" else 0.1),
+        n_trials=60,
+    )
+    return study
+
+
+# ------------------------------------------------------------------ importance
+
+
+def test_fanova_ranks_important_param(quadratic_study):
+    from optuna_tpu.importance import FanovaImportanceEvaluator, get_param_importances
+
+    imp = get_param_importances(quadratic_study, evaluator=FanovaImportanceEvaluator(seed=0))
+    assert set(imp) == {"important", "noise", "c"}
+    assert imp["important"] > imp["noise"]
+    assert imp["important"] > imp["c"]
+    assert abs(sum(imp.values()) - 1.0) < 1e-6
+
+
+def test_pedanova_ranks_important_param(quadratic_study):
+    from optuna_tpu.importance import PedAnovaImportanceEvaluator, get_param_importances
+
+    imp = get_param_importances(
+        quadratic_study, evaluator=PedAnovaImportanceEvaluator(), normalize=True
+    )
+    assert imp["important"] > imp["noise"]
+
+
+def test_mdi_ranks_important_param(quadratic_study):
+    from optuna_tpu.importance import (
+        MeanDecreaseImpurityImportanceEvaluator,
+        get_param_importances,
+    )
+
+    imp = get_param_importances(
+        quadratic_study, evaluator=MeanDecreaseImpurityImportanceEvaluator(seed=0)
+    )
+    assert imp["important"] > imp["noise"]
+
+
+# ------------------------------------------------------------------ terminator
+
+
+def test_terminator_stagnation():
+    from optuna_tpu.terminator import BestValueStagnationEvaluator, StaticErrorEvaluator, Terminator
+
+    study = create_study(sampler=RandomSampler(seed=1))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=25)
+    terminator = Terminator(
+        improvement_evaluator=BestValueStagnationEvaluator(max_stagnation_trials=0),
+        error_evaluator=StaticErrorEvaluator(0.0),
+        min_n_trials=5,
+    )
+    # With max_stagnation_trials=0 any non-improving tail triggers termination.
+    assert isinstance(terminator.should_terminate(study), bool)
+
+
+def test_terminator_regret_bound_runs():
+    from optuna_tpu.terminator import RegretBoundEvaluator, StaticErrorEvaluator, Terminator
+
+    study = create_study(sampler=RandomSampler(seed=2))
+    study.optimize(
+        lambda t: (t.suggest_float("x", -3, 3) - 1) ** 2 + t.suggest_float("y", -3, 3) ** 2,
+        n_trials=25,
+    )
+    terminator = Terminator(
+        improvement_evaluator=RegretBoundEvaluator(min_n_trials=20),
+        error_evaluator=StaticErrorEvaluator(1e9),  # absurd error -> must terminate
+        min_n_trials=20,
+    )
+    assert terminator.should_terminate(study) is True
+
+
+def test_terminator_callback_stops_study():
+    from optuna_tpu.terminator import (
+        BestValueStagnationEvaluator,
+        StaticErrorEvaluator,
+        Terminator,
+        TerminatorCallback,
+    )
+
+    terminator = Terminator(
+        improvement_evaluator=BestValueStagnationEvaluator(max_stagnation_trials=3),
+        error_evaluator=StaticErrorEvaluator(0.0),
+        min_n_trials=5,
+    )
+    study = create_study(sampler=RandomSampler(seed=3))
+    study.optimize(
+        lambda t: 1.0 + 0 * t.suggest_float("x", 0, 1),  # constant: stagnates at once
+        n_trials=100,
+        callbacks=[TerminatorCallback(terminator)],
+    )
+    assert len(study.trials) < 100
+
+
+def test_report_cross_validation_scores():
+    from optuna_tpu.terminator import (
+        CrossValidationErrorEvaluator,
+        report_cross_validation_scores,
+    )
+
+    study = create_study(sampler=RandomSampler(seed=4))
+
+    def obj(trial):
+        x = trial.suggest_float("x", 0, 1)
+        report_cross_validation_scores(trial, [x, x + 0.1, x - 0.1])
+        return x
+
+    study.optimize(obj, n_trials=5)
+    err = CrossValidationErrorEvaluator().evaluate(study.trials, study.direction)
+    assert err > 0
+
+
+# ---------------------------------------------------------------- visualization
+
+
+def test_all_matplotlib_plots_render(quadratic_study):
+    import matplotlib.pyplot as plt
+
+    from optuna_tpu.visualization import matplotlib as vis
+
+    vis.plot_optimization_history(quadratic_study)
+    vis.plot_slice(quadratic_study, params=["important", "noise"])
+    vis.plot_contour(quadratic_study, params=["important", "noise"])
+    vis.plot_rank(quadratic_study, params=["important"])
+    vis.plot_parallel_coordinate(quadratic_study, params=["important", "noise"])
+    vis.plot_param_importances(quadratic_study)
+    vis.plot_edf(quadratic_study)
+    vis.plot_timeline(quadratic_study)
+    plt.close("all")
+
+
+def test_intermediate_and_pareto_plots():
+    import matplotlib.pyplot as plt
+
+    from optuna_tpu.visualization import matplotlib as vis
+
+    study = create_study(sampler=RandomSampler(seed=5))
+
+    def obj(trial):
+        x = trial.suggest_float("x", 0, 1)
+        for s in range(3):
+            trial.report(x + s, s)
+        return x
+
+    study.optimize(obj, n_trials=5)
+    vis.plot_intermediate_values(study)
+
+    mo = create_study(directions=["minimize", "minimize"], sampler=RandomSampler(seed=6))
+    mo.optimize(lambda t: (t.suggest_float("x", 0, 1), 1 - t.suggest_float("x", 0, 1)), n_trials=12)
+    vis.plot_pareto_front(mo)
+    vis.plot_hypervolume_history(mo, [1.1, 1.1])
+    plt.close("all")
+
+
+def test_plotly_gated():
+    import optuna_tpu.visualization as vis
+
+    if not vis.is_available():
+        with pytest.raises(ImportError):
+            vis.plot_optimization_history(None)
+
+
+# ------------------------------------------------------------------- artifacts
+
+
+def test_artifact_roundtrip(tmp_path):
+    from optuna_tpu.artifacts import (
+        Backoff,
+        FileSystemArtifactStore,
+        download_artifact,
+        get_all_artifact_meta,
+        upload_artifact,
+    )
+
+    store = Backoff(FileSystemArtifactStore(str(tmp_path / "store")))
+    src = tmp_path / "model.txt"
+    src.write_text("weights")
+
+    study = create_study(sampler=RandomSampler(seed=0))
+    collected = {}
+
+    def obj(trial):
+        aid = upload_artifact(
+            artifact_store=store, file_path=str(src), study_or_trial=trial
+        )
+        collected["aid"] = aid
+        return trial.suggest_float("x", 0, 1)
+
+    study.optimize(obj, n_trials=1)
+    metas = get_all_artifact_meta(study.trials[0])
+    assert len(metas) == 1
+    assert metas[0].filename == "model.txt"
+    dst = tmp_path / "restored.txt"
+    download_artifact(artifact_store=store, artifact_id=collected["aid"], file_path=str(dst))
+    assert dst.read_text() == "weights"
+
+
+def test_artifact_not_found(tmp_path):
+    from optuna_tpu.artifacts import ArtifactNotFound, FileSystemArtifactStore
+
+    store = FileSystemArtifactStore(str(tmp_path))
+    with pytest.raises(ArtifactNotFound):
+        store.open_reader("nope")
+
+
+# ------------------------------------------------------------------------- CLI
+
+
+def _cli(*args: str) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "optuna_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=180,
+    )
+
+
+def test_cli_end_to_end(tmp_path):
+    url = f"sqlite:///{tmp_path}/cli.db"
+    r = _cli("create-study", "--storage", url, "--study-name", "cli-study")
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "cli-study"
+
+    # ask -> tell loop from the shell
+    r = _cli(
+        "ask", "--storage", url, "--study-name", "cli-study",
+        "--search-space",
+        json.dumps({"x": {"name": "FloatDistribution", "attributes": {"low": 0.0, "high": 1.0, "log": False, "step": None}}}),
+    )
+    assert r.returncode == 0, r.stderr
+    asked = json.loads(r.stdout)
+    assert "x" in asked["params"]
+
+    r = _cli(
+        "tell", "--storage", url, "--study-name", "cli-study",
+        "--trial-number", str(asked["number"]), "--values", "0.5",
+    )
+    assert r.returncode == 0, r.stderr
+
+    r = _cli("trials", "--storage", url, "--study-name", "cli-study", "-f", "json")
+    assert r.returncode == 0, r.stderr
+    rows = json.loads(r.stdout)
+    assert len(rows) == 1 and rows[0]["state"] == "COMPLETE"
+
+    r = _cli("best-trial", "--storage", url, "--study-name", "cli-study", "-f", "json")
+    assert r.returncode == 0, r.stderr
+
+    r = _cli("studies", "--storage", url, "-f", "table")
+    assert "cli-study" in r.stdout
+
+    r = _cli("delete-study", "--storage", url, "--study-name", "cli-study")
+    assert r.returncode == 0, r.stderr
+    r = _cli("studies", "--storage", url, "-f", "json")
+    assert json.loads(r.stdout) == []

@@ -1,0 +1,165 @@
+"""Pruner tests (mirrors reference tests/pruners_tests/)."""
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import TrialState, create_study
+from optuna_tpu.pruners import (
+    HyperbandPruner,
+    MedianPruner,
+    NopPruner,
+    PatientPruner,
+    PercentilePruner,
+    SuccessiveHalvingPruner,
+    ThresholdPruner,
+    WilcoxonPruner,
+)
+from optuna_tpu.samplers import RandomSampler
+
+
+def _run_pruned_study(pruner, objective, n_trials=20, seed=0):
+    study = create_study(sampler=RandomSampler(seed=seed), pruner=pruner)
+    study.optimize(objective, n_trials=n_trials)
+    return study
+
+
+def _stepwise(trial, n_steps=10):
+    x = trial.suggest_float("x", 0, 1)
+    for step in range(n_steps):
+        trial.report(x + step * 0.01, step)
+        if trial.should_prune():
+            raise optuna_tpu.TrialPruned()
+    return x
+
+
+def test_median_pruner_prunes_bad_trials():
+    study = _run_pruned_study(MedianPruner(n_startup_trials=3, n_warmup_steps=1), _stepwise, 30)
+    states = [t.state for t in study.trials]
+    assert TrialState.PRUNED in states
+    assert TrialState.COMPLETE in states
+    # The best trial must survive.
+    assert study.best_trial.state == TrialState.COMPLETE
+
+
+def test_percentile_pruner_quantile():
+    pruner = PercentilePruner(25.0, n_startup_trials=3, n_warmup_steps=1)
+    study = _run_pruned_study(pruner, _stepwise, 30, seed=1)
+    pruned = sum(t.state == TrialState.PRUNED for t in study.trials)
+    assert pruned > 0
+
+
+def test_nop_pruner_never_prunes():
+    study = _run_pruned_study(NopPruner(), _stepwise, 10)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+
+def test_threshold_pruner_bounds():
+    def objective(trial):
+        v = trial.suggest_float("x", 0, 2)
+        trial.report(v, 0)
+        if trial.should_prune():
+            raise optuna_tpu.TrialPruned()
+        return v
+
+    study = _run_pruned_study(ThresholdPruner(upper=1.0), objective, 20)
+    for t in study.trials:
+        if t.state == TrialState.COMPLETE:
+            assert t.value <= 1.0
+        else:
+            assert t.state == TrialState.PRUNED
+
+
+def test_threshold_pruner_nan():
+    def objective(trial):
+        trial.suggest_float("x", 0, 1)
+        trial.report(float("nan"), 0)
+        if trial.should_prune():
+            raise optuna_tpu.TrialPruned()
+        return 0.0
+
+    study = _run_pruned_study(ThresholdPruner(lower=0.0), objective, 3)
+    assert all(t.state == TrialState.PRUNED for t in study.trials)
+
+
+def test_patient_pruner_waits():
+    class AlwaysPrune(optuna_tpu.pruners.BasePruner):
+        def prune(self, study, trial):
+            return True
+
+    def improving(trial):
+        trial.suggest_float("x", 0, 1)
+        for step in range(10):
+            trial.report(1.0 - step * 0.1, step)  # keeps improving
+            if trial.should_prune():
+                raise optuna_tpu.TrialPruned()
+        return 0.0
+
+    def degrading(trial):
+        trial.suggest_float("x", 0, 1)
+        for step in range(10):
+            trial.report(1.0 + step * 0.1, step)  # keeps getting worse
+            if trial.should_prune():
+                raise optuna_tpu.TrialPruned()
+        return 2.0
+
+    def plateau_at_best(trial):
+        trial.suggest_float("x", 0, 1)
+        for step in range(10):
+            trial.report(0.5, step)  # flat at its best value
+            if trial.should_prune():
+                raise optuna_tpu.TrialPruned()
+        return 0.5
+
+    # Improving trials and best-value plateaus must survive; degrading trials
+    # are handed to the wrapped pruner once patience is exhausted.
+    study = create_study(pruner=PatientPruner(AlwaysPrune(), patience=3))
+    study.optimize(improving, n_trials=1)
+    study.optimize(plateau_at_best, n_trials=1)
+    study.optimize(degrading, n_trials=1)
+    states = [t.state for t in study.trials]
+    assert states[0] == TrialState.COMPLETE
+    assert states[1] == TrialState.COMPLETE
+    assert states[2] == TrialState.PRUNED
+
+
+def test_successive_halving_rungs():
+    pruner = SuccessiveHalvingPruner(min_resource=1, reduction_factor=2)
+    study = _run_pruned_study(pruner, lambda t: _stepwise(t, 16), 30, seed=3)
+    pruned = sum(t.state == TrialState.PRUNED for t in study.trials)
+    complete = sum(t.state == TrialState.COMPLETE for t in study.trials)
+    assert pruned > 0 and complete > 0
+    # Rung attrs recorded
+    assert any("completed_rung_0" in t.system_attrs for t in study.trials)
+
+
+def test_hyperband_brackets():
+    pruner = HyperbandPruner(min_resource=1, max_resource=16, reduction_factor=4)
+    study = _run_pruned_study(pruner, lambda t: _stepwise(t, 16), 40, seed=4)
+    assert len(study.trials) == 40
+    assert pruner._n_brackets >= 2
+    states = {t.state for t in study.trials}
+    assert TrialState.COMPLETE in states
+
+
+def test_wilcoxon_pruner():
+    rng = np.random.RandomState(0)
+    instance_noise = rng.normal(0, 0.1, size=20)
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0, 1)
+        total = 0.0
+        for step in range(20):
+            v = x + instance_noise[step]
+            trial.report(v, step)
+            total += v
+            if trial.should_prune():
+                raise optuna_tpu.TrialPruned()
+        return total / 20
+
+    study = create_study(
+        sampler=RandomSampler(seed=5), pruner=WilcoxonPruner(p_threshold=0.2)
+    )
+    study.optimize(objective, n_trials=25)
+    assert sum(t.state == TrialState.PRUNED for t in study.trials) > 0
+    assert study.best_trial.state == TrialState.COMPLETE
