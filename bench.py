@@ -92,6 +92,57 @@ def run_ours_cmaes(n_warmup: int, n_timed: int) -> tuple[float, float]:
     return n_timed / dt, study.best_value
 
 
+def run_ours_mlp_vectorized(n_warmup: int, n_timed: int, batch_size: int = 32) -> tuple[float, float]:
+    """BASELINE config #5: parallel MLP trials, batch-asked and evaluated as
+    one sharded device program per batch (synthetic MNIST-shaped data)."""
+    import jax
+    import jax.numpy as jnp
+
+    import optuna_tpu
+    from optuna_tpu.distributions import FloatDistribution
+    from optuna_tpu.models.mlp import MLPParams, cross_entropy, mlp_forward
+    from optuna_tpu.parallel import VectorizedObjective, optimize_vectorized
+    from optuna_tpu.samplers import TPESampler
+
+    _silence()
+    rng = np.random.RandomState(0)
+    n_in, n_hidden, n_out, n_batch = 64, 32, 10, 256
+    x = jnp.asarray(rng.normal(size=(n_batch, n_in)), jnp.float32)
+    yl = jnp.asarray(rng.randint(0, n_out, n_batch), jnp.int32)
+    base = MLPParams(
+        w1=jnp.asarray(rng.normal(0, 0.1, (n_in, n_hidden)), jnp.float32),
+        b1=jnp.zeros(n_hidden, jnp.float32),
+        w2=jnp.asarray(rng.normal(0, 0.1, (n_hidden, n_out)), jnp.float32),
+        b2=jnp.zeros(n_out, jnp.float32),
+    )
+
+    def train_one(lr, scale):
+        p = jax.tree.map(lambda a: a * scale, base)
+
+        def step(p, _):
+            loss, grads = jax.value_and_grad(lambda q: cross_entropy(mlp_forward(q, x), yl))(p)
+            return jax.tree.map(lambda a, g: a - lr * g, p, grads), loss
+
+        p, losses = jax.lax.scan(step, p, None, length=10)
+        return cross_entropy(mlp_forward(p, x), yl)
+
+    obj = VectorizedObjective(
+        fn=lambda params: jax.vmap(train_one)(params["lr"], params["init_scale"]),
+        search_space={
+            "lr": FloatDistribution(1e-3, 1.0, log=True),
+            "init_scale": FloatDistribution(0.3, 3.0),
+        },
+    )
+    study = optuna_tpu.create_study(
+        sampler=TPESampler(seed=0, multivariate=True, constant_liar=True, n_startup_trials=10)
+    )
+    optimize_vectorized(study, obj, n_trials=n_warmup, batch_size=batch_size)
+    t0 = time.time()
+    optimize_vectorized(study, obj, n_trials=n_timed, batch_size=batch_size)
+    dt = time.time() - t0
+    return n_timed / dt, study.best_value
+
+
 def run_ours_nsga2(n_warmup: int, n_timed: int) -> tuple[float, float]:
     import optuna_tpu
     from optuna_tpu.hypervolume import compute_hypervolume
@@ -192,7 +243,9 @@ def run_baseline_nsga2(n_timed: int) -> tuple[float, float] | None:
 def main() -> None:
     _setup_jax_cache()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="gp", choices=["gp", "tpe", "cmaes", "nsga2"])
+    parser.add_argument(
+        "--config", default="gp", choices=["gp", "tpe", "cmaes", "nsga2", "mlp"]
+    )
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
 
@@ -215,6 +268,11 @@ def main() -> None:
         ours_rate, ours_best = run_ours_cmaes(n_warm, n_timed)
         base = None
         metric = "cmaes_trials_per_sec_rastrigin50d"
+    elif args.config == "mlp":
+        n_warm, n_timed = (64, 128) if args.quick else (128, 512)
+        ours_rate, ours_best = run_ours_mlp_vectorized(n_warm, n_timed)
+        base = None
+        metric = "vectorized_mlp_trials_per_sec"
     else:
         n_warm, n_timed = (60, 100) if args.quick else (100, 300)
         ours_rate, ours_best = run_ours_nsga2(n_warm, n_timed)

@@ -1,0 +1,109 @@
+"""Non-domination ranking on device: Pallas dominance tiles + XLA peeling.
+
+The north-star names NSGA-II's nondominated sort as a Pallas target
+(BASELINE.md): the O(N^2 M) dominance comparisons are the FLOP body, so they
+run as a tiled Pallas kernel on the VPU (128x128 tiles of the dominance
+matrix); the O(front-count) peeling loop is a `lax.while_loop` over the
+resulting matrix. Host NumPy remains the small-N path (dispatch latency
+dominates below a few hundred points — see ``study/_multi_objective.py``).
+
+CPU tests run the same kernel through ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TILE = 128
+
+
+def _dominance_kernel(vi_ref, vj_ref, out_ref):
+    """out[i, j] = 1.0 iff point i dominates point j (minimization)."""
+    vi = vi_ref[:]  # (TILE, M)
+    vj = vj_ref[:]  # (TILE, M)
+    leq = jnp.all(vi[:, None, :] <= vj[None, :, :], axis=-1)
+    lt = jnp.any(vi[:, None, :] < vj[None, :, :], axis=-1)
+    out_ref[:] = (leq & lt).astype(jnp.float32)
+
+
+def dominance_matrix(values: jnp.ndarray, use_pallas: bool = True) -> jnp.ndarray:
+    """(N, N) float32 dominance matrix; N padded to a 128 multiple by callers."""
+    n, m = values.shape
+    if not use_pallas or n % _TILE != 0:
+        leq = jnp.all(values[:, None, :] <= values[None, :, :], axis=-1)
+        lt = jnp.any(values[:, None, :] < values[None, :, :], axis=-1)
+        return (leq & lt).astype(jnp.float32)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = jax.default_backend() != "tpu"
+    grid = (n // _TILE, n // _TILE)
+    return pl.pallas_call(
+        _dominance_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TILE, m), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE, m), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_TILE, _TILE), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(values, values)
+
+
+@partial(jax.jit, static_argnames=("use_pallas",))
+def non_domination_rank(
+    values: jnp.ndarray, mask: jnp.ndarray, use_pallas: bool = True
+) -> jnp.ndarray:
+    """Ranks (0 = Pareto front) for masked rows; padded rows get a huge rank.
+
+    ``values`` (N, M) minimization-normalized, N a multiple of 128 when the
+    Pallas path is on; ``mask`` (N,) 1.0 for real rows.
+    """
+    n = values.shape[0]
+    big = jnp.asarray(n + 1, jnp.int32)
+    dom = dominance_matrix(values, use_pallas=use_pallas) * mask[:, None] * mask[None, :]
+
+    def cond(state):
+        ranks, remaining, r = state
+        return jnp.any(remaining > 0)
+
+    def body(state):
+        ranks, remaining, r = state
+        dominated = jnp.any((dom * remaining[:, None]) > 0, axis=0)
+        front = (remaining > 0) & ~dominated
+        ranks = jnp.where(front, r, ranks)
+        remaining = jnp.where(front, 0.0, remaining)
+        return ranks, remaining, r + 1
+
+    ranks0 = jnp.full(n, big, jnp.int32)
+    remaining0 = mask.astype(jnp.float32)
+    ranks, _, _ = jax.lax.while_loop(cond, body, (ranks0, remaining0, jnp.asarray(0, jnp.int32)))
+    return ranks
+
+
+def non_domination_rank_np(values: np.ndarray) -> np.ndarray:
+    """Host entry: ordinal-transform, pad to the tile multiple, run the kernel.
+
+    Dominance depends only on each objective's ORDER (ties included), so every
+    column is replaced by its dense rank (0..n_unique-1) before the f32 kernel
+    — exact for any float64 input (overflow, inf, sub-eps gaps included),
+    since ordinals are small integers representable exactly in f32.
+    """
+    n, m = values.shape
+    ordinals = np.empty((n, m), dtype=np.float32)
+    for j in range(m):
+        _, inverse = np.unique(values[:, j], return_inverse=True)  # +inf sorts last
+        ordinals[:, j] = inverse
+    n_pad = ((n + _TILE - 1) // _TILE) * _TILE
+    vp = np.full((n_pad, m), np.float32(n_pad + 1), dtype=np.float32)
+    vp[:n] = ordinals
+    mask = np.zeros(n_pad, dtype=np.float32)
+    mask[:n] = 1.0
+    ranks = non_domination_rank(jnp.asarray(vp), jnp.asarray(mask))
+    return np.asarray(ranks)[:n].astype(np.int64)

@@ -617,13 +617,27 @@ def _calculate_weights_below_for_multi_objective(
         return None
     worst = np.max(loss_vals[finite], axis=0)
     ref_point = _hv_reference_point(worst)
-    hv_total = compute_hypervolume(loss_vals[finite], ref_point)
     contributions = np.zeros(len(below_trials))
     finite_idx = np.flatnonzero(finite)
-    for j, i in enumerate(finite_idx):
-        subset = np.delete(loss_vals[finite], j, axis=0)
-        hv_without = compute_hypervolume(subset, ref_point) if len(subset) else 0.0
-        contributions[i] = max(hv_total - hv_without, 0.0)
+    if loss_vals.shape[1] == 2:
+        # 2-objective exclusive contributions in one device program
+        # (ops/hypervolume.py) instead of n leave-one-out host WFG calls.
+        from optuna_tpu.ops.hypervolume import hypervolume_2d_contributions
+        import jax.numpy as jnp
+
+        contrib = np.asarray(
+            hypervolume_2d_contributions(
+                jnp.asarray(loss_vals[finite], dtype=jnp.float32),
+                jnp.asarray(ref_point, dtype=jnp.float32),
+            )
+        )
+        contributions[finite_idx] = np.maximum(contrib, 0.0)
+    else:
+        hv_total = compute_hypervolume(loss_vals[finite], ref_point)
+        for j, i in enumerate(finite_idx):
+            subset = np.delete(loss_vals[finite], j, axis=0)
+            hv_without = compute_hypervolume(subset, ref_point) if len(subset) else 0.0
+            contributions[i] = max(hv_total - hv_without, 0.0)
     if contributions.sum() <= 0:
         return None
     weights = contributions + 1e-12

@@ -2,8 +2,8 @@
 
 Parity target: ``optuna/study/_multi_objective.py`` (``_get_pareto_front_trials:43``,
 ``_fast_non_domination_rank:49``, ``_dominates:222``). The rank computation is
-vectorized NumPy on host for small populations and delegates to the JAX kernel
-in :mod:`optuna_tpu.ops.nondomination` for large ones (NSGA's per-generation
+vectorized NumPy on host for small populations and delegates to the device
+kernel in :mod:`optuna_tpu.ops.pareto` for large ones (NSGA's per-generation
 sort is the hot path the north star names).
 """
 
@@ -99,12 +99,26 @@ def _fast_non_domination_rank(
         infeasible_order = np.argsort(violation[infeasible], kind="stable")
         infeasible_order = np.flatnonzero(infeasible)[infeasible_order]
 
-    # Tier 1: feasible points ranked by non-domination.
+    # Tier 1: feasible points ranked by non-domination. Large populations go
+    # through the tiled Pallas/XLA kernel (ops/pareto.py) — the O(n^2 m)
+    # dominance comparisons are the FLOP body; host NumPy keeps small n where
+    # dispatch latency would dominate. The device result is a full ranking, a
+    # strict refinement of the host path's early-stopped one: every consumer
+    # iterates ranks from 0 and stops at its own budget, so both agree on the
+    # prefix that matters.
     feas_idx = np.flatnonzero(feasible)
-    n_ranked = 0
-    rank = 0
     values = objective_values[feas_idx]
-    remaining = np.arange(len(feas_idx))
+    if len(feas_idx) >= 512:
+        from optuna_tpu.ops.pareto import non_domination_rank_np
+
+        device_ranks = non_domination_rank_np(values)
+        ranks[feas_idx] = device_ranks
+        rank = int(device_ranks.max()) + 1 if len(device_ranks) else 0
+        remaining = np.array([], dtype=np.int64)
+    else:
+        rank = 0
+        remaining = np.arange(len(feas_idx))
+    n_ranked = 0
     while len(remaining) > 0 and n_ranked < n_below:
         vals = values[remaining]
         # domination matrix: dom[i, j] = i dominates j
