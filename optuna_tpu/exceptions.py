@@ -11,6 +11,10 @@ class OptunaTPUError(Exception):
     """Base class for every exception raised by this framework."""
 
 
+# Drop-in name for code written against the reference's `OptunaError`.
+OptunaError = OptunaTPUError
+
+
 class TrialPruned(OptunaTPUError):
     """Raised inside an objective to signal that the trial was pruned.
 

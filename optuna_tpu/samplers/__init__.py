@@ -17,6 +17,7 @@ __all__ = [
     "GPSampler",
     "GridSampler",
     "LazyRandomState",
+    "MOTPESampler",
     "NSGAIISampler",
     "NSGAIIISampler",
     "PartialFixedSampler",
@@ -26,6 +27,7 @@ __all__ = [
 ]
 
 _LAZY = {
+    "MOTPESampler": ("optuna_tpu.samplers._tpe.sampler", "MOTPESampler"),
     "TPESampler": ("optuna_tpu.samplers._tpe.sampler", "TPESampler"),
     "GPSampler": ("optuna_tpu.samplers._gp.sampler", "GPSampler"),
     "CmaEsSampler": ("optuna_tpu.samplers._cmaes", "CmaEsSampler"),

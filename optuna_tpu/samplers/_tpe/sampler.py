@@ -14,6 +14,7 @@ NumPy/SciPy loops.
 from __future__ import annotations
 
 import math
+import warnings
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
@@ -446,6 +447,42 @@ class TPESampler(BaseSampler):
         assert state in [TrialState.COMPLETE, TrialState.FAIL, TrialState.PRUNED]
         if self._constraints_func is not None:
             _process_constraints_after_trial(self._constraints_func, study, trial, state)
+
+
+class MOTPESampler(TPESampler):
+    """Deprecated multi-objective TPE alias (reference keeps it for
+    compatibility): a TPESampler whose defaults match the MOTPE paper."""
+
+    def __init__(
+        self,
+        *,
+        consider_prior: bool = True,
+        prior_weight: float = 1.0,
+        consider_magic_clip: bool = True,
+        consider_endpoints: bool = True,
+        n_startup_trials: int = 10,
+        n_ehvi_candidates: int = 24,
+        gamma: Callable[[int], int] | None = None,
+        weights_above: Callable[[int], np.ndarray] | None = None,
+        seed: int | None = None,
+    ) -> None:
+        warnings.warn(
+            "MOTPESampler has been deprecated; use TPESampler directly — "
+            "multi-objective handling is built in.",
+            FutureWarning,
+            stacklevel=2,
+        )
+        super().__init__(
+            consider_prior=consider_prior,
+            prior_weight=prior_weight,
+            consider_magic_clip=consider_magic_clip,
+            consider_endpoints=consider_endpoints,
+            n_startup_trials=n_startup_trials,
+            n_ei_candidates=n_ehvi_candidates,
+            gamma=gamma or default_gamma,
+            weights=weights_above or default_weights,
+            seed=seed,
+        )
 
 
 def _hv_reference_point(worst_point: np.ndarray) -> np.ndarray:

@@ -1,5 +1,6 @@
 """Study package (reference ``optuna/study/__init__.py``)."""
 
+from optuna_tpu._callbacks import MaxTrialsCallback
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.study._study_summary import StudySummary
 from optuna_tpu.study.study import (
@@ -14,6 +15,7 @@ from optuna_tpu.study.study import (
 )
 
 __all__ = [
+    "MaxTrialsCallback",
     "ObjectiveFuncType",
     "Study",
     "StudyDirection",

@@ -52,6 +52,38 @@ class FixedTrial:
     ) -> CategoricalChoiceType:
         return self._suggest(name, CategoricalDistribution(choices=choices))
 
+    # Deprecated aliases (pre-v3 reference API) — kept on every trial type.
+
+    def suggest_uniform(self, name, low, high):
+        import warnings
+
+        warnings.warn(
+            "suggest_uniform has been deprecated; use suggest_float instead.",
+            FutureWarning,
+            stacklevel=2,
+        )
+        return self.suggest_float(name, low, high)
+
+    def suggest_loguniform(self, name, low, high):
+        import warnings
+
+        warnings.warn(
+            "suggest_loguniform has been deprecated; use suggest_float(..., log=True).",
+            FutureWarning,
+            stacklevel=2,
+        )
+        return self.suggest_float(name, low, high, log=True)
+
+    def suggest_discrete_uniform(self, name, low, high, q):
+        import warnings
+
+        warnings.warn(
+            "suggest_discrete_uniform has been deprecated; use suggest_float(..., step=q).",
+            FutureWarning,
+            stacklevel=2,
+        )
+        return self.suggest_float(name, low, high, step=q)
+
     def _suggest(self, name: str, distribution: BaseDistribution) -> Any:
         if name not in self._params:
             raise ValueError(

@@ -89,6 +89,33 @@ class Trial:
     ) -> CategoricalChoiceType:
         return self._suggest(name, CategoricalDistribution(choices=choices))
 
+    # Deprecated aliases kept for drop-in compatibility with pre-v3 reference
+    # code (`suggest_uniform`/`suggest_loguniform`/`suggest_discrete_uniform`).
+
+    def suggest_uniform(self, name: str, low: float, high: float) -> float:
+        warnings.warn(
+            "suggest_uniform has been deprecated; use suggest_float instead.",
+            FutureWarning,
+            stacklevel=2,
+        )
+        return self.suggest_float(name, low, high)
+
+    def suggest_loguniform(self, name: str, low: float, high: float) -> float:
+        warnings.warn(
+            "suggest_loguniform has been deprecated; use suggest_float(..., log=True).",
+            FutureWarning,
+            stacklevel=2,
+        )
+        return self.suggest_float(name, low, high, log=True)
+
+    def suggest_discrete_uniform(self, name: str, low: float, high: float, q: float) -> float:
+        warnings.warn(
+            "suggest_discrete_uniform has been deprecated; use suggest_float(..., step=q).",
+            FutureWarning,
+            stacklevel=2,
+        )
+        return self.suggest_float(name, low, high, step=q)
+
     def _suggest(self, name: str, distribution: BaseDistribution) -> Any:
         storage = self.storage
         trial_id = self._trial_id

@@ -264,3 +264,29 @@ def test_trial_ids_survive_delete_study():
     # The pre-delete trial must remain reachable and unchanged.
     assert b.trials[0].value == first_b_value
     assert [t.number for t in b.trials] == [0, 1, 2, 3]
+
+
+def test_deprecated_suggest_aliases():
+    study = create_study(sampler=RandomSampler(seed=0))
+
+    def obj(trial):
+        with pytest.warns(FutureWarning):
+            u = trial.suggest_uniform("u", 0, 1)
+        with pytest.warns(FutureWarning):
+            lu = trial.suggest_loguniform("lu", 1e-3, 1.0)
+        with pytest.warns(FutureWarning):
+            du = trial.suggest_discrete_uniform("du", 0, 1, 0.25)
+        assert 0 <= u <= 1 and 1e-3 <= lu <= 1.0
+        assert du in [0.0, 0.25, 0.5, 0.75, 1.0]
+        return u
+
+    study.optimize(obj, n_trials=1)
+
+
+def test_compat_aliases_exist():
+    import optuna_tpu
+
+    assert optuna_tpu.exceptions.OptunaError is optuna_tpu.exceptions.OptunaTPUError
+    from optuna_tpu.study import MaxTrialsCallback  # noqa: F401
+    with pytest.warns(FutureWarning):
+        optuna_tpu.samplers.MOTPESampler(seed=0)
