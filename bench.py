@@ -272,7 +272,52 @@ def run_baseline_nsga2(n_timed: int) -> tuple[float, float] | None:
         return None
 
 
+def _ensure_responsive_backend() -> None:
+    """The axon TPU rides a network tunnel that can wedge; a hung backend
+    would stall the whole benchmark. Probe it in a subprocess and, if dead,
+    re-exec on the CPU platform so a result is always produced."""
+    import signal
+    import subprocess
+
+    if os.environ.get("OPTUNA_TPU_BENCH_CPU_FALLBACK"):
+        return
+    # start_new_session + killpg: the probe (and any helper it forks while
+    # booting the tunnel) must die as a group, or draining its pipes could
+    # block forever — the very hang this watchdog exists to prevent.
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "import jax, jax.numpy as jnp; jnp.ones(1).sum().block_until_ready()",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        start_new_session=True,
+    )
+    try:
+        _, stderr = proc.communicate(timeout=180)
+        if proc.returncode == 0:
+            return  # backend answers; proceed normally
+        reason = f"probe exited {proc.returncode}"
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        stderr = b""
+        reason = "probe timed out after 180s"
+    tail = stderr.decode(errors="replace")[-500:] if stderr else ""
+    _log(f"accelerator backend unresponsive ({reason}); falling back to CPU. {tail}")
+    env = dict(os.environ)
+    env["OPTUNA_TPU_BENCH_CPU_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # sitecustomize only engages when PALLAS_AXON_POOL_IPS is truthy.
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
+
+
 def main() -> None:
+    _ensure_responsive_backend()
     _setup_jax_cache()
     parser = argparse.ArgumentParser()
     parser.add_argument(
