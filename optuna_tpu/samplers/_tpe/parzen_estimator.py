@@ -28,7 +28,6 @@ from optuna_tpu.distributions import (
 )
 
 EPS = 1e-12
-SIGMA0_MAGNITUDE = 0.2
 
 
 class _ParzenEstimatorParameters(NamedTuple):
@@ -203,9 +202,10 @@ class _ParzenEstimator:
         parameters: _ParzenEstimatorParameters,
         consider_prior: bool,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Reference bandwidth logic (`parzen_estimator.py:186-212`): classic
-        neighbor-distance sigmas for univariate TPE, Scott-rule for
-        multivariate, then the "magic clip"."""
+        """Reference bandwidth logic (`parzen_estimator.py:186-216`):
+        neighbor-distance sigmas (for multivariate KDEs too — the reference
+        has no separate multivariate bandwidth branch), then the
+        "magic clip"."""
         n = len(mus)
         low, high = spec.low, spec.high
         prior_mu = 0.5 * (low + high)
@@ -213,10 +213,6 @@ class _ParzenEstimator:
 
         if n == 0:
             sigmas = np.empty(0)
-        elif parameters.multivariate:
-            d_total = len(self._search_space)
-            sigma = SIGMA0_MAGNITUDE * max(n, 1) ** (-1.0 / (d_total + 4)) * (high - low)
-            sigmas = np.full(n, sigma)
         else:
             # Max distance to the neighbors in sorted order, endpoints included.
             sorted_indices = np.argsort(mus)
@@ -259,21 +255,25 @@ class _ParzenEstimator:
         C = spec.n_choices
         dist_func = parameters.categorical_distance_func.get(spec.name)
 
-        probs = np.full((n_components, C), parameters.prior_weight / n_components)
+        probs = np.full((n_components, C), parameters.prior_weight / max(n_components, 1))
         if dist_func is None:
             probs[np.arange(n), obs_indices] += 1.0
-        else:
-            # Distance kernel: weight of choice c in component i decays with
-            # dist(obs_i, c) (reference's categorical_distance_func support).
+        elif n > 0:
+            # Distance kernel (reference `parzen_estimator.py:152-160`): rows
+            # are *replaced* by exp(-(d/row_max)^2 * coef) with
+            # coef = log(n_kernels/prior_weight) * log(C) / log(6).
             choices = spec.dist.choices
-            dists = np.empty((n, C))
-            for i, oi in enumerate(obs_indices):
-                for c in range(C):
-                    dists[i, c] = float(dist_func(choices[int(oi)], choices[c]))
-            max_d = np.max(dists) if dists.size else 1.0
-            coef = np.log(n_components) * 2 / max(max_d, EPS)
-            probs[:n] += np.exp(-dists * coef)
-        probs /= probs.sum(axis=1, keepdims=True)
+            used, rev = np.unique(obs_indices, return_inverse=True)
+            dists = np.array(
+                [[float(dist_func(choices[int(i)], c)) for c in choices] for i in used]
+            )
+            coef = (
+                np.log(max(n_components, 1) / parameters.prior_weight) * np.log(C) / np.log(6)
+            )
+            row_max = np.maximum(np.max(dists, axis=1, keepdims=True), EPS)
+            probs[:n] = np.exp(-((dists / row_max) ** 2) * coef)[rev]
+        row_sums = probs.sum(axis=1, keepdims=True)
+        probs /= np.where(row_sums == 0, 1.0, row_sums)
         return probs
 
     # ---------------------------------------------------------------- device IO

@@ -223,14 +223,21 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
 
         def __enter__(self) -> sqlite3.Connection:
             con = self._storage._conn()
+            last: sqlite3.OperationalError | None = None
             for attempt in range(60):
                 try:
                     con.execute("BEGIN IMMEDIATE")
                     break
-                except sqlite3.OperationalError:
+                except sqlite3.OperationalError as err:
+                    # Only contention is retryable; "no such table", disk I/O
+                    # errors etc. must surface immediately, not after ~90s.
+                    msg = str(err).lower()
+                    if "locked" not in msg and "busy" not in msg:
+                        raise
+                    last = err
                     time.sleep(0.05 * (attempt + 1))
             else:
-                raise sqlite3.OperationalError("database is locked")
+                raise sqlite3.OperationalError("database is locked") from last
             self._con = con
             return con
 

@@ -26,6 +26,46 @@ LOCK_FILE_SUFFIX = ".lock"
 RENAME_FILE_SUFFIX = ".rename"
 
 
+def _steal_stale_lock(lockfile: str, grace_period: float) -> bool:
+    """Atomically break a stale lock. Renaming the lockfile to a unique name
+    succeeds for exactly one waiter, so two waiters that both observed the
+    lock expired cannot each unlink the other's freshly created lock — the
+    loser's rename fails with ENOENT and it goes back to waiting. Returns
+    True iff this caller won the steal. The lock is re-checked under the
+    unique name before removal so a fresh lock is never broken."""
+    stolen = lockfile + ".stale." + uuid.uuid4().hex[:12]
+    try:
+        os.rename(lockfile, stolen)
+    except OSError:
+        return False  # someone else stole (or released) it first
+    try:
+        st = os.lstat(stolen)
+        if time.time() - st.st_mtime <= grace_period:
+            # Raced with a release+acquire: the lock we grabbed is fresh and
+            # its owner is alive. Restore it with link() — which fails with
+            # EEXIST instead of clobbering — so a lock some third waiter
+            # created in the meantime is never silently overwritten.
+            try:
+                os.link(stolen, lockfile, follow_symlinks=False)
+            except OSError:
+                _logger.error(
+                    f"Lock takeover race on {lockfile}: a live lock was displaced and"
+                    " could not be restored; two holders may briefly coexist."
+                )
+            try:
+                os.unlink(stolen)
+            except OSError:
+                pass
+            return False
+    except OSError:
+        pass
+    try:
+        os.unlink(stolen)
+    except OSError:
+        pass
+    return True
+
+
 class BaseJournalFileLock(abc.ABC):
     @abc.abstractmethod
     def acquire(self) -> bool:
@@ -65,10 +105,11 @@ class JournalFileSymlinkLock(BaseJournalFileLock):
                     # Grace-period takeover: a dead worker's stale lock is
                     # broken after grace_period seconds.
                     if self._grace_period is not None and self._lock_expired():
-                        _logger.warning(
-                            f"Lock {self._lockfile} expired (> {self._grace_period}s); taking over."
-                        )
-                        self._force_release()
+                        if _steal_stale_lock(self._lockfile, self._grace_period):
+                            _logger.warning(
+                                f"Lock {self._lockfile} expired (> {self._grace_period}s);"
+                                " taking over."
+                            )
                         continue
                     time.sleep(min(sleep_secs, 0.05))
                     sleep_secs *= 1.5
@@ -83,12 +124,6 @@ class JournalFileSymlinkLock(BaseJournalFileLock):
             return time.time() - st.st_mtime > self._grace_period
         except OSError:
             return False
-
-    def _force_release(self) -> None:
-        try:
-            os.unlink(self._lockfile)
-        except OSError:
-            pass
 
     def release(self) -> None:
         if self._owns:
@@ -119,13 +154,11 @@ class JournalFileOpenLock(BaseJournalFileLock):
             except OSError as err:
                 if err.errno == errno.EEXIST:
                     if self._grace_period is not None and self._lock_expired():
-                        _logger.warning(
-                            f"Lock {self._lockfile} expired (> {self._grace_period}s); taking over."
-                        )
-                        try:
-                            os.unlink(self._lockfile)
-                        except OSError:
-                            pass
+                        if _steal_stale_lock(self._lockfile, self._grace_period):
+                            _logger.warning(
+                                f"Lock {self._lockfile} expired (> {self._grace_period}s);"
+                                " taking over."
+                            )
                         continue
                     time.sleep(min(sleep_secs, 0.05))
                     sleep_secs *= 1.5

@@ -1,20 +1,24 @@
-"""The optimize loop: sequential and thread-pool trial execution.
+"""Trial-execution engine behind ``Study.optimize``.
 
-Parity target: ``optuna/study/_optimize.py`` (``_optimize:39``,
-``_optimize_sequential:127``, ``_run_trial:186``: heartbeat + fail_stale +
-ask -> objective -> tell). Trial-level parallelism = ``n_jobs`` threads here;
-process/pod-level fan-out goes through shared storage CAS (see
-``optuna_tpu.parallel`` for the vectorized device-batch path).
+Feature parity target: ``optuna/study/_optimize.py`` (n_jobs fan-out,
+timeout, catch, callbacks, gc, heartbeat + fail_stale). The structure here
+is deliberately different from the reference: one shared :class:`_RunBudget`
+hands out per-trial *claims* to however many workers exist (the sequential
+path is simply one worker), and each trial runs through the same
+ask → objective → tell pipeline expressed as an :class:`_Outcome` value
+rather than interleaved state flags. Trial-level parallelism = ``n_jobs``
+threads; device-batch fan-out lives in :mod:`optuna_tpu.parallel`.
 """
 
 from __future__ import annotations
 
-import datetime
 import gc
-import itertools
 import os
 import sys
-from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 from optuna_tpu import exceptions, logging as logging_module
@@ -30,6 +34,166 @@ if TYPE_CHECKING:
 _logger = logging_module.get_logger(__name__)
 
 
+class _RunBudget:
+    """Thread-safe accounting for one ``optimize`` call.
+
+    Workers call :meth:`claim` before each trial; the budget says yes until
+    the trial quota is spent, the wall-clock deadline passes, or the study's
+    stop flag is raised. Centralising the three exit conditions here means
+    the sequential and threaded paths share one definition of "done".
+    """
+
+    def __init__(self, study: "Study", n_trials: int | None, timeout: float | None) -> None:
+        self._study = study
+        self._quota = n_trials
+        self._started = time.monotonic()
+        self._deadline = None if timeout is None else self._started + timeout
+        self._granted = 0
+        self._halted = False
+        self._mutex = threading.Lock()
+
+    def halt(self) -> None:
+        """Stop handing out claims (a worker died); peers finish their
+        current trial and exit, mirroring the reference's early-abort."""
+        self._halted = True
+
+    def claim(self) -> bool:
+        if self._halted or self._study._stop_flag:
+            return False
+        if self._deadline is not None and time.monotonic() >= self._deadline:
+            return False
+        with self._mutex:
+            if self._quota is not None and self._granted >= self._quota:
+                return False
+            self._granted += 1
+            return True
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._started
+
+
+@dataclass
+class _Outcome:
+    """What happened when the objective ran: values (on success), the
+    terminal state override (pruned/failed), and the error to re-raise if
+    it isn't covered by ``catch``."""
+
+    values: float | Sequence[float] | None = None
+    state: TrialState | None = None
+    error: BaseException | None = None
+    exc_info: Any = None
+
+
+def _call_objective(func: "ObjectiveFuncType", trial: Trial) -> _Outcome:
+    try:
+        return _Outcome(values=func(trial))
+    except exceptions.TrialPruned as pruned:
+        return _Outcome(state=TrialState.PRUNED, error=pruned)
+    except (Exception, KeyboardInterrupt) as err:
+        return _Outcome(state=TrialState.FAIL, error=err, exc_info=sys.exc_info())
+
+
+def _announce(study: "Study", frozen: FrozenTrial, outcome: _Outcome) -> None:
+    """Log the trial's terminal state the way the study logger promises."""
+    if frozen.state == TrialState.COMPLETE:
+        study._log_completed_trial(frozen)
+    elif frozen.state == TrialState.PRUNED:
+        _logger.info(f"Trial {frozen.number} pruned. {outcome.error}")
+    elif frozen.state == TrialState.FAIL:
+        reason: Any = None
+        if outcome.error is not None:
+            reason = repr(outcome.error)
+        elif frozen.system_attrs.get("fail_reason") is not None:
+            reason = frozen.system_attrs["fail_reason"]
+        if reason is not None:
+            _logger.warning(
+                f"Trial {frozen.number} failed with parameters: {frozen.params} "
+                f"because of the following error: {reason}.",
+                exc_info=outcome.exc_info,
+            )
+            if outcome.values is not None:
+                _logger.warning(
+                    f"Trial {frozen.number} failed with value {outcome.values}."
+                )
+    else:
+        raise AssertionError(f"Unexpected trial state {frozen.state}.")
+
+
+def _execute_one(
+    study: "Study",
+    func: "ObjectiveFuncType",
+    catch: tuple[type[Exception], ...],
+) -> FrozenTrial:
+    """ask → objective (under a heartbeat) → tell, as one pipeline."""
+    from optuna_tpu.storages._heartbeat import (
+        fail_stale_trials,
+        get_heartbeat_thread,
+        is_heartbeat_enabled,
+    )
+
+    if is_heartbeat_enabled(study._storage):
+        fail_stale_trials(study)
+
+    trial = study.ask()
+    with get_heartbeat_thread(trial._trial_id, study._storage):
+        outcome = _call_objective(func, trial)
+
+    # Misbehaving objectives (wrong arity, NaNs, non-floats) downgrade to
+    # warnings via _tell_with_warning rather than aborting the whole loop.
+    try:
+        frozen = _tell_with_warning(
+            study=study,
+            trial=trial,
+            value_or_values=outcome.values,
+            state=outcome.state,
+            suppress_warning=True,
+        )
+    except Exception:
+        _announce(study, study._storage.get_trial(trial._trial_id), outcome)
+        raise
+    _announce(study, frozen, outcome)
+
+    swallowed = outcome.error is not None and isinstance(outcome.error, catch)
+    if frozen.state == TrialState.FAIL and outcome.error is not None and not swallowed:
+        raise outcome.error
+    return frozen
+
+
+def _worker(
+    study: "Study",
+    func: "ObjectiveFuncType",
+    budget: _RunBudget,
+    catch: tuple[type[Exception], ...],
+    callbacks: Sequence[Callable[["Study", FrozenTrial], None]] | None,
+    gc_after_trial: bool,
+    progress_bar: _ProgressBar | None,
+    reseed: bool,
+) -> None:
+    """Run trials until the shared budget refuses another claim."""
+    study._thread_local.in_optimize_loop = True
+    if reseed:
+        study.sampler.reseed_rng()
+    while budget.claim():
+        # Any escape — objective error not in `catch`, a raising callback,
+        # even the progress bar — halts the budget so peer workers stop
+        # claiming fresh trials instead of draining the whole quota.
+        try:
+            try:
+                frozen = _execute_one(study, func, catch)
+            finally:
+                # Objective locals can pin device buffers; collecting between
+                # trials caps HBM/host growth (upstream issue #1340).
+                if gc_after_trial:
+                    gc.collect()
+            for callback in callbacks or ():
+                callback(study, frozen)
+            if progress_bar is not None:
+                progress_bar.update(budget.elapsed(), study)
+        except BaseException:
+            budget.halt()
+            raise
+
+
 def _optimize(
     study: "Study",
     func: "ObjectiveFuncType",
@@ -42,210 +206,48 @@ def _optimize(
     show_progress_bar: bool = False,
 ) -> None:
     if not isinstance(catch, tuple):
-        raise TypeError("The catch argument is of type '{}' but must be a tuple.".format(
-            type(catch).__name__
-        ))
+        raise TypeError(
+            f"The catch argument is of type '{type(catch).__name__}' but must be a tuple."
+        )
     if study._thread_local.in_optimize_loop:
         raise RuntimeError("Nested invocation of `Study.optimize` method isn't allowed.")
     if show_progress_bar and n_trials is None and timeout is not None and n_jobs != 1:
         _logger.warning("The timeout-based progress bar is not supported with n_jobs != 1.")
         show_progress_bar = False
+    if n_jobs == -1:
+        n_jobs = os.cpu_count() or 1
 
     progress_bar = _ProgressBar(show_progress_bar, n_trials, timeout)
     study._stop_flag = False
+    budget = _RunBudget(study, n_trials, timeout)
 
     try:
         if n_jobs == 1:
-            _optimize_sequential(
-                study,
-                func,
-                n_trials,
-                timeout,
-                catch,
-                callbacks,
-                gc_after_trial,
-                reseed_sampler_rng=False,
-                time_start=None,
-                progress_bar=progress_bar,
+            _worker(
+                study, func, budget, catch, callbacks, gc_after_trial, progress_bar,
+                reseed=False,
             )
         else:
-            if n_jobs == -1:
-                n_jobs = os.cpu_count() or 1
-            time_start = datetime.datetime.now()
-            futures: set[Future] = set()
-            with ThreadPoolExecutor(max_workers=n_jobs) as executor:
-                for n_submitted_trials in itertools.count():
-                    if study._stop_flag:
-                        break
-                    if (
-                        timeout is not None
-                        and (datetime.datetime.now() - time_start).total_seconds() > timeout
-                    ):
-                        break
-                    if n_trials is not None and n_submitted_trials >= n_trials:
-                        break
-                    if len(futures) >= n_jobs:
-                        completed, futures = wait(futures, return_when=FIRST_COMPLETED)
-                        for f in completed:
-                            f.result()  # propagate exceptions
-                    futures.add(
-                        executor.submit(
-                            _optimize_sequential,
-                            study,
-                            func,
-                            1,
-                            timeout,
-                            catch,
-                            callbacks,
-                            gc_after_trial,
-                            True,
-                            time_start,
-                            progress_bar,
+            # Every worker reseeds: thread-parallel trials would otherwise
+            # draw identical streams from a shared per-seed RNG.
+            try:
+                with ThreadPoolExecutor(max_workers=n_jobs) as pool:
+                    handles = [
+                        pool.submit(
+                            _worker,
+                            study, func, budget, catch, callbacks, gc_after_trial,
+                            progress_bar, True,
                         )
-                    )
-                for f in futures:
-                    f.result()
+                        for _ in range(n_jobs)
+                    ]
+                    for handle in handles:
+                        handle.result()  # propagate worker exceptions
+            finally:
+                # A main-thread escape (e.g. KeyboardInterrupt inside
+                # result()) must stop the claim stream, or the executor's
+                # __exit__ join would wait for workers to drain an unbounded
+                # quota.
+                budget.halt()
     finally:
         study._thread_local.in_optimize_loop = False
         progress_bar.close()
-
-
-def _optimize_sequential(
-    study: "Study",
-    func: "ObjectiveFuncType",
-    n_trials: int | None,
-    timeout: float | None,
-    catch: tuple[type[Exception], ...],
-    callbacks: Sequence[Callable[["Study", FrozenTrial], None]] | None,
-    gc_after_trial: bool,
-    reseed_sampler_rng: bool,
-    time_start: datetime.datetime | None,
-    progress_bar: _ProgressBar | None,
-) -> None:
-    study._thread_local.in_optimize_loop = True
-    if reseed_sampler_rng:
-        study.sampler.reseed_rng()
-
-    if time_start is None:
-        time_start = datetime.datetime.now()
-
-    i_trial = 0
-    while True:
-        if study._stop_flag:
-            break
-        if n_trials is not None and i_trial >= n_trials:
-            break
-        i_trial += 1
-
-        if timeout is not None:
-            elapsed_seconds = (datetime.datetime.now() - time_start).total_seconds()
-            if elapsed_seconds >= timeout:
-                break
-
-        try:
-            frozen_trial = _run_trial(study, func, catch)
-        finally:
-            # The trial and its objective's locals can hold device buffers;
-            # an explicit gc between trials caps HBM/host growth (reference
-            # _optimize.py:150-161, issue #1340 in the upstream tracker).
-            if gc_after_trial:
-                gc.collect()
-
-        if callbacks is not None:
-            for callback in callbacks:
-                callback(study, frozen_trial)
-
-        if progress_bar is not None:
-            elapsed_seconds = (datetime.datetime.now() - time_start).total_seconds()
-            progress_bar.update(elapsed_seconds, study)
-
-
-def _run_trial(
-    study: "Study",
-    func: "ObjectiveFuncType",
-    catch: tuple[type[Exception], ...],
-) -> FrozenTrial:
-    from optuna_tpu.storages._heartbeat import (
-        fail_stale_trials,
-        get_heartbeat_thread,
-        is_heartbeat_enabled,
-    )
-
-    if is_heartbeat_enabled(study._storage):
-        fail_stale_trials(study)
-
-    trial = study.ask()
-
-    state: TrialState | None = None
-    value_or_values: float | Sequence[float] | None = None
-    func_err: Exception | KeyboardInterrupt | None = None
-    func_err_fail_exc_info: Any = None
-
-    with get_heartbeat_thread(trial._trial_id, study._storage):
-        try:
-            value_or_values = func(trial)
-        except exceptions.TrialPruned as e:
-            state = TrialState.PRUNED
-            func_err = e
-        except (Exception, KeyboardInterrupt) as e:
-            state = TrialState.FAIL
-            func_err = e
-            func_err_fail_exc_info = sys.exc_info()
-
-    # Use `_tell_with_warning` instead of `study.tell` so misbehaving
-    # objectives produce warnings rather than hard errors mid-loop.
-    try:
-        frozen_trial = _tell_with_warning(
-            study=study,
-            trial=trial,
-            value_or_values=value_or_values,
-            state=state,
-            suppress_warning=True,
-        )
-    except Exception:
-        frozen_trial = study._storage.get_trial(trial._trial_id)
-        raise
-    finally:
-        if frozen_trial.state == TrialState.COMPLETE:
-            study._log_completed_trial(frozen_trial)
-        elif frozen_trial.state == TrialState.PRUNED:
-            _logger.info(f"Trial {frozen_trial.number} pruned. {str(func_err)}")
-        elif frozen_trial.state == TrialState.FAIL:
-            if func_err is not None:
-                _log_failed_trial(
-                    frozen_trial,
-                    repr(func_err),
-                    exc_info=func_err_fail_exc_info,
-                    value_or_values=value_or_values,
-                )
-            elif frozen_trial.system_attrs.get("fail_reason") is not None:
-                _log_failed_trial(
-                    frozen_trial,
-                    frozen_trial.system_attrs["fail_reason"],
-                    value_or_values=value_or_values,
-                )
-        else:
-            raise AssertionError(f"Unexpected trial state {frozen_trial.state}.")
-
-    if (
-        frozen_trial.state == TrialState.FAIL
-        and func_err is not None
-        and not isinstance(func_err, catch)
-    ):
-        raise func_err
-    return frozen_trial
-
-
-def _log_failed_trial(
-    trial: FrozenTrial,
-    message: str | Warning,
-    exc_info: Any = None,
-    value_or_values: Any = None,
-) -> None:
-    _logger.warning(
-        f"Trial {trial.number} failed with parameters: {trial.params} because of the "
-        f"following error: {message}.",
-        exc_info=exc_info,
-    )
-    if value_or_values is not None:
-        _logger.warning(f"Trial {trial.number} failed with value {value_or_values}.")

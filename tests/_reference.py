@@ -1,0 +1,58 @@
+"""Import the reference Optuna (read-only at /root/reference) for numeric
+parity tests.
+
+The image lacks ``colorlog``, which the reference imports unconditionally at
+logging setup; a minimal stand-in is materialised on sys.path first. Tests
+that compare against the reference should ``pytest.importorskip`` via
+:func:`load_reference` so they skip cleanly if the mount is absent.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import tempfile
+
+_REFERENCE_ROOT = "/root/reference"
+_loaded = None
+
+
+def _materialise_colorlog_shim() -> None:
+    if "colorlog" in sys.modules:
+        return
+    shim_dir = tempfile.mkdtemp(prefix="refshim_")
+    with open(os.path.join(shim_dir, "colorlog.py"), "w") as f:
+        f.write(
+            "import logging\n"
+            "class ColoredFormatter(logging.Formatter):\n"
+            "    def __init__(self, fmt=None, *a, log_colors=None, **k):\n"
+            "        if fmt is not None:\n"
+            "            fmt = fmt.replace('%(log_color)s', '').replace('%(reset)s', '')\n"
+            "        super().__init__(fmt)\n"
+            "class TTYColoredFormatter(ColoredFormatter):\n"
+            "    def __init__(self, *a, stream=None, **k):\n"
+            "        super().__init__(*a, **k)\n"
+            "class StreamHandler(logging.StreamHandler):\n"
+            "    pass\n"
+        )
+    sys.path.insert(0, shim_dir)
+
+
+def load_reference():
+    """Return the reference ``optuna`` module, importing it on first use."""
+    global _loaded
+    if _loaded is not None:
+        return _loaded
+    if not os.path.isdir(_REFERENCE_ROOT):
+        return None
+    _materialise_colorlog_shim()
+    if _REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, _REFERENCE_ROOT)
+    try:
+        import optuna  # noqa: F401
+    except Exception:
+        return None
+    optuna.logging.set_verbosity(logging.ERROR)
+    _loaded = optuna
+    return optuna
