@@ -50,45 +50,51 @@ def _log(msg: str) -> None:
 # --------------------------------------------------------------------- ours
 
 
-def _prewarm_gp_buckets(d: int, n_max: int) -> None:
-    """Compile the fused GP program for every trial-count bucket the timed
-    phase will touch, so the measurement excludes XLA compile time."""
-    import jax
-    import jax.numpy as jnp
+def _prewarm_gp(d: int, n_max: int, chain: int, n_startup: int) -> None:
+    """Compile the fused GP programs for every (bucket, fit-variant) combo
+    the timed phase will touch, so the measurement excludes XLA compile time.
 
-    from optuna_tpu.gp.fused import gp_suggest_fused
+    Runs a throwaway study over the same search space and sampler config —
+    the sampler's own dispatch logic picks the jit cache keys, so this
+    cannot drift out of sync with the sampler internals."""
+    import optuna_tpu
+    from optuna_tpu.models.benchmarks import hartmann20
+    from optuna_tpu.samplers import GPSampler
+
+    sampler = GPSampler(seed=1, n_startup_trials=n_startup, speculative_chain=chain)
+    study = optuna_tpu.create_study(sampler=sampler)
     from optuna_tpu.gp.gp import _bucket
-    from optuna_tpu.samplers._gp.sampler import GPSampler
 
-    rng = np.random.RandomState(0)
-    # Shapes must mirror GPSampler._sample_fused's jit cache key: 4 kernel
-    # param starts, n_preliminary_samples + up to 4 incumbent candidates.
-    # If the sampler internals change these, the prewarm misses and compile
-    # time re-enters the measurement — keep them derived, not hard-coded.
-    n_cand = GPSampler()._n_preliminary_samples + 4
-    buckets = sorted({_bucket(n) for n in range(1, n_max + 1)})
-    for N in buckets:
-        X = jnp.asarray(rng.uniform(0, 1, (N, d)), jnp.float32)
-        y = jnp.asarray(rng.normal(size=N), jnp.float32)
-        starts = jnp.asarray(rng.normal(0, 1, (4, d + 2)), jnp.float32)
-        cand = jnp.asarray(rng.uniform(0, 1, (n_cand, d)), jnp.float32)
-        gp_suggest_fused(
-            starts, X, y, jnp.zeros(d, bool), jnp.ones(N, jnp.float32), cand,
-            jax.random.PRNGKey(0), 1e-5, jnp.ones(d, jnp.float32),
-            jnp.zeros(d, jnp.float32), jnp.ones(d, jnp.float32),
-            jnp.zeros((1, d), jnp.float32), jnp.zeros((1, 1), jnp.float32),
-            jnp.zeros((1, 1), bool),
-        )[0].block_until_ready()
+    pad = max(chain, 1)
+    # Visit one trial count per distinct bucket (plus one warm re-fit in the
+    # first bucket so the 2-start warm program also compiles).
+    seen: set[int] = set()
+    counts = []
+    for n in range(n_startup, n_max + 1):
+        b = _bucket(n + pad)
+        if b not in seen:
+            seen.add(b)
+            counts.append(n)
+    target_totals = sorted({c + (chain if chain > 1 else 1) for c in counts} | {n_startup + 2})
+    done = 0
+    for total in target_totals:
+        study.optimize(hartmann20, n_trials=total - done)
+        done = total
+        sampler._spec_queue = []  # force a fresh chain dispatch per bucket
 
 
-def run_ours_gp(n_warmup: int, n_timed: int) -> tuple[float, float]:
+def run_ours_gp(
+    n_warmup: int, n_timed: int, chain: int = 8, n_startup: int = 10
+) -> tuple[float, float]:
     import optuna_tpu
     from optuna_tpu.models.benchmarks import hartmann20
     from optuna_tpu.samplers import GPSampler
 
     _silence()
-    _prewarm_gp_buckets(d=20, n_max=n_warmup + n_timed)
-    study = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=10))
+    _prewarm_gp(d=20, n_max=n_warmup + n_timed, chain=chain, n_startup=n_startup)
+    study = optuna_tpu.create_study(
+        sampler=GPSampler(seed=0, n_startup_trials=n_startup, speculative_chain=chain)
+    )
     study.optimize(hartmann20, n_trials=n_warmup)
     t0 = time.time()
     study.optimize(hartmann20, n_trials=n_timed)
@@ -337,18 +343,25 @@ def main() -> None:
     _setup_jax_cache()
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--config", default="gp", choices=["gp", "tpe", "cmaes", "nsga2", "mlp"]
+        "--config", default="gp", choices=["gp", "gp_batch", "tpe", "cmaes", "nsga2", "mlp"]
     )
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
 
     if args.config == "gp":
-        n_warm, n_timed = (12, 20) if args.quick else (20, 40)
-        _log("running ours (GPSampler / 20D Hartmann)...")
-        ours_rate, ours_best = run_ours_gp(n_warm, n_timed)
+        n_warm, n_timed = (12, 24) if args.quick else (20, 48)
+        _log("running ours (GPSampler / 20D Hartmann, ask-ahead chain=8)...")
+        ours_rate, ours_best = run_ours_gp(n_warm, n_timed, chain=8)
         _log(f"ours: {ours_rate:.3f} trials/s (best {ours_best:.4f}); running baseline...")
         base = run_baseline_gp(n_timed)
         metric = "gp_sampler_trials_per_sec_hartmann20d"
+    elif args.config == "gp_batch":
+        n_warm, n_timed = (16, 32) if args.quick else (32, 64)
+        _log("running ours (GPSampler / 20D Hartmann, q=16 batch ask)...")
+        ours_rate, ours_best = run_ours_gp(n_warm, n_timed, chain=16)
+        _log(f"ours: {ours_rate:.3f} trials/s (best {ours_best:.4f}); running baseline...")
+        base = run_baseline_gp(n_timed)
+        metric = "gp_batch_trials_per_sec_hartmann20d"
     elif args.config == "tpe":
         n_warm, n_timed = (30, 100) if args.quick else (50, 300)
         _log("running ours (TPESampler / Branin)...")

@@ -1,0 +1,72 @@
+"""Profiler tracing hooks (SURVEY §5 observability obligation).
+
+Wraps ``jax.profiler`` so a study run can be captured for TensorBoard /
+Perfetto with zero code changes in objectives:
+
+* :func:`trace` — context manager that starts/stops a ``jax.profiler``
+  trace around a block (typically a whole ``study.optimize`` call).
+* :func:`annotate` — named ``TraceAnnotation`` span; the optimize loop
+  wraps each trial's ask/objective/tell in one so device dispatches line up
+  with trial numbers on the timeline.
+* ``OPTUNA_TPU_TRACE=<logdir>`` — environment switch that traces every
+  ``study.optimize`` call without touching user code.
+
+When no trace is active, :func:`annotate` costs one attribute check — the
+hot path stays clean.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+from optuna_tpu.logging import get_logger
+
+_logger = get_logger(__name__)
+
+_active = False
+
+
+def is_tracing() -> bool:
+    return _active
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace of the enclosed block into
+    ``logdir`` (view with TensorBoard's profile plugin or Perfetto)."""
+    global _active
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    _active = True
+    _logger.info(f"jax profiler trace started -> {logdir}")
+    try:
+        yield
+    finally:
+        _active = False
+        jax.profiler.stop_trace()
+        _logger.info(f"jax profiler trace written to {logdir}")
+
+
+@contextlib.contextmanager
+def maybe_trace_from_env() -> Iterator[None]:
+    """Honor ``OPTUNA_TPU_TRACE=<logdir>``: used by ``Study.optimize`` so any
+    run can be profiled from the environment alone. Nested optimize calls
+    (or an already-active :func:`trace`) don't double-start."""
+    logdir = os.environ.get("OPTUNA_TPU_TRACE")
+    if not logdir or _active:
+        yield
+        return
+    with trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """A named profiler span when a trace is active, else a no-op."""
+    if not _active:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

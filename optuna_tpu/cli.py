@@ -98,6 +98,16 @@ def _cmd_studies(args: argparse.Namespace) -> None:
     print(_format_output(rows, args.format))
 
 
+def _cmd_study_names(args: argparse.Namespace) -> None:
+    import optuna_tpu
+
+    names = [
+        {"name": s.study_name}
+        for s in optuna_tpu.get_all_study_summaries(_storage(args))
+    ]
+    print(_format_output(names, args.format))
+
+
 def _cmd_trials(args: argparse.Namespace) -> None:
     import optuna_tpu
 
@@ -127,12 +137,18 @@ def _cmd_study_set_user_attr(args: argparse.Namespace) -> None:
 
 
 def _cmd_storage_upgrade(args: argparse.Namespace) -> None:
-    # Schema v1 is current; future migrations hook in here (reference keeps
-    # alembic migrations, we keep PRAGMA user_version steps).
-    from optuna_tpu.storages._rdb.storage import SCHEMA_VERSION, RDBStorage
+    # Walk the migration chain to head (reference keeps alembic migrations,
+    # we keep version_info + per-step SQL batches).
+    from optuna_tpu.storages._rdb.storage import RDBStorage
 
-    RDBStorage(args.storage)  # creating it runs/validates the schema
-    print(f"Storage is up to date (schema version {SCHEMA_VERSION}).")
+    storage = RDBStorage(args.storage, skip_compatibility_check=True)
+    before = storage.get_current_version()
+    storage.upgrade()
+    after = storage.get_current_version()
+    if before == after:
+        print(f"Storage is up to date (schema version {after}).")
+    else:
+        print(f"Upgraded storage schema {before} -> {after}.")
 
 
 def _parse_sampler(args: argparse.Namespace):
@@ -219,6 +235,9 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--study-name", required=True)
 
     p = add("studies", _cmd_studies)
+    p.add_argument("-f", "--format", default="table", choices=["table", "json", "yaml"])
+
+    p = add("study-names", _cmd_study_names)
     p.add_argument("-f", "--format", default="table", choices=["table", "json", "yaml"])
 
     p = add("trials", _cmd_trials)

@@ -36,8 +36,11 @@ class DuplicatedStudyError(OptunaTPUError):
     """Raised when a study name already exists and ``load_if_exists=False``."""
 
 
-class UpdateFinishedTrialError(OptunaTPUError):
-    """Raised on attempts to mutate a finished (COMPLETE/PRUNED/FAIL) trial."""
+class UpdateFinishedTrialError(OptunaTPUError, RuntimeError):
+    """Raised on attempts to mutate a finished (COMPLETE/PRUNED/FAIL) trial.
+
+    Also a ``RuntimeError`` so callers written against the reference's
+    documented storage contract (``optuna/exceptions.py:84``) catch it."""
 
 
 class ExperimentalWarning(Warning):

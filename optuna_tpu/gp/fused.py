@@ -1,15 +1,29 @@
-"""The whole GP suggestion as ONE XLA program.
+"""The whole GP suggestion as ONE XLA program — single and q-chain variants.
 
-Per-trial pipeline (reference runs it as dozens of Python/torch/SciPy steps,
-``optuna/samplers/_gp/sampler.py:397``): MAP-fit kernel params (multi-start
-batched L-BFGS) -> Cholesky/alpha finalize -> LogEI over the QMC candidate
-pool -> Gumbel-top-k roulette start selection -> box-constrained L-BFGS
-ascent interleaved with dense discrete sweeps -> argmax.
+Per-trial pipeline (the reference runs it as dozens of Python/torch/SciPy
+steps, ``optuna/samplers/_gp/sampler.py:397``): MAP-fit kernel params
+(multi-start batched L-BFGS) -> Cholesky/alpha finalize -> LogEI over the
+QMC candidate pool -> Gumbel-top-k roulette start selection -> box-
+constrained L-BFGS ascent interleaved with dense discrete sweeps -> argmax.
 
 Fusing it means exactly one device dispatch + one small result fetch per
-trial. On a tunneled TPU (~100ms/dispatch) this is the difference between
-~0.5 and ~15 dispatches of latency; on direct-attached hardware it lets XLA
-overlap everything and keeps the MXU fed.
+suggestion. On a tunneled TPU (~100 ms/dispatch) that is the difference
+between ~1 and ~15 round trips of latency; on direct-attached hardware it
+lets XLA overlap everything and keeps the MXU fed.
+
+Two further latency levers live here:
+
+* **On-device candidates** — the 2048-point preliminary pool is not shipped
+  per trial (that is ~160 KB of host->device traffic each suggestion).
+  Instead a scrambled-Sobol base pool is uploaded once and each call applies
+  a Cranley-Patterson rotation (random shift mod 1) plus per-dim decoding on
+  device, preserving low discrepancy at zero per-trial transfer cost.
+* **The q-chain program** (:func:`gp_suggest_chain_fused`) — one dispatch
+  returns q proposals via kriging-believer fantasies: propose, condition the
+  posterior on the GP mean at the proposal, repeat. The kernel-param fit is
+  amortized over the whole chain and the tunnel round trip over q trials.
+  This is the device-side engine for both batched ask and speculative
+  (ask-ahead) sequential optimization.
 """
 
 from __future__ import annotations
@@ -19,12 +33,20 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from optuna_tpu.gp.acqf import LogEIData
-from optuna_tpu.gp.gp import GPParams, GPState, _kernel_with_noise, _loss
+from optuna_tpu.gp.acqf import LogEIData, logei_value
+from optuna_tpu.gp.gp import (
+    GPParams,
+    GPState,
+    _kernel_with_noise,
+    _loss,
+    posterior,
+)
 from optuna_tpu.ops.lbfgsb import lbfgsb
 
 
-def _fit_and_state(starts, X, y, cat_mask, mask, minimum_noise):
+def _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters):
+    """Multi-start MAP fit of raw log kernel params; returns the winning raw
+    vector and the decoded GPParams."""
     loss_one = lambda r: _loss(r, X, y, cat_mask, mask, minimum_noise)
 
     def value_and_grad(batch_raw):
@@ -37,7 +59,8 @@ def _fit_and_state(starts, X, y, cat_mask, mask, minimum_noise):
     lower = jnp.full((D,), -15.0, starts.dtype)
     upper = jnp.full((D,), 15.0, starts.dtype)
     xs, fs = lbfgsb(
-        value_and_grad, starts, lower, upper, max_iters=60, max_ls=12, value_fn=value_only
+        value_and_grad, starts, lower, upper, max_iters=fit_iters, max_ls=12,
+        value_fn=value_only,
     )
     raw = xs[jnp.argmin(fs)]
 
@@ -47,55 +70,61 @@ def _fit_and_state(starts, X, y, cat_mask, mask, minimum_noise):
         scale=jnp.exp(raw[d]),
         noise=jnp.exp(raw[d + 1]) + minimum_noise,
     )
+    return raw, params
+
+
+def _state_for(params, X, y, cat_mask, mask):
     K = _kernel_with_noise(X, params, cat_mask, mask)
     L = jnp.linalg.cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
-    return raw, GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
+    return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("n_local_search", "n_cycles", "lbfgs_iters", "has_sweep"),
-)
-def gp_suggest_fused(
-    starts: jnp.ndarray,  # (S, d+2) kernel-param starts
-    X: jnp.ndarray,  # (N, d) padded observations
-    y: jnp.ndarray,  # (N,)
-    cat_mask: jnp.ndarray,  # (d,)
-    mask: jnp.ndarray,  # (N,)
-    candidates: jnp.ndarray,  # (C, d) QMC preliminary pool (+ incumbents)
-    key: jax.Array,
-    minimum_noise: float,
-    cont_mask: jnp.ndarray,  # (d,)
-    lower: jnp.ndarray,  # (d,)
-    upper: jnp.ndarray,  # (d,)
-    dim_onehot: jnp.ndarray,  # (Dd, d) sweep tables (dummy (0,d) when unused)
-    choice_grid: jnp.ndarray,  # (Dd, Cmax)
-    choice_valid: jnp.ndarray,  # (Dd, Cmax)
-    stabilizing_noise: float = 1e-10,
-    n_local_search: int = 10,
-    n_cycles: int = 2,
-    lbfgs_iters: int = 40,
-    has_sweep: bool = False,
-):
-    from optuna_tpu.gp.acqf import logei_value
+def device_candidates(sobol_base, key, cat_mask, n_choices, steps):
+    """Decode a randomly shifted Sobol pool into the normalized mixed space.
 
-    raw, state = _fit_and_state(starts, X, y, cat_mask, mask, minimum_noise)
-    best = jnp.max(jnp.where(mask > 0, y, -jnp.inf))
-    data = LogEIData(
-        state=state,
-        cat_mask=cat_mask,
-        best=best,
-        stabilizing_noise=jnp.asarray(stabilizing_noise, dtype=X.dtype),
+    ``sobol_base`` (C, d) lives on device across trials; the per-call shift
+    is a Cranley-Patterson rotation so every trial sees a fresh but still
+    low-discrepancy pool. Categorical dims decode to a choice index, stepped
+    dims snap to grid centers, continuous dims pass through.
+    """
+    d = sobol_base.shape[1]
+    shift = jax.random.uniform(key, (d,), dtype=sobol_base.dtype)
+    u = jnp.mod(sobol_base + shift[None, :], 1.0)
+    nc = jnp.maximum(n_choices, 1.0)
+    cat_vals = jnp.clip(jnp.floor(u * nc[None, :]), 0.0, nc[None, :] - 1.0)
+    safe_step = jnp.where(steps > 0, steps, 1.0)
+    stepped = jnp.clip(safe_step[None, :] * (jnp.floor(u / safe_step[None, :]) + 0.5), 0.0, 1.0)
+    out = jnp.where(
+        cat_mask[None, :], cat_vals, jnp.where(steps[None, :] > 0, stepped, u)
     )
+    return out
 
+
+def _maximize_logei(
+    data,
+    candidates,
+    key,
+    cont_mask,
+    lower,
+    upper,
+    dim_onehot,
+    choice_grid,
+    choice_valid,
+    *,
+    n_local_search,
+    n_cycles,
+    lbfgs_iters,
+    has_sweep,
+):
+    """Preliminary sweep -> Gumbel-top-k starts -> cyclic L-BFGS + discrete
+    sweeps -> (x*, value*)."""
     vals = logei_value(data, candidates)
     vals = jnp.where(jnp.isfinite(vals), vals, -jnp.inf)
     # Start selection: argmax + Gumbel-top-k == softmax sampling w/o
     # replacement (the reference's roulette, optim_mixed.py:309-326).
     gumbel = jax.random.gumbel(key, vals.shape, dtype=vals.dtype)
-    perturbed = vals + gumbel
-    _, noisy_idx = jax.lax.top_k(perturbed, n_local_search)
+    _, noisy_idx = jax.lax.top_k(vals + gumbel, n_local_search)
     idx = noisy_idx.at[0].set(jnp.argmax(vals))
     x = candidates[idx]
     cur = vals[idx]
@@ -128,7 +157,8 @@ def gp_suggest_fused(
 
     for _ in range(n_cycles):
         x_new, neg_new = lbfgsb(
-            neg_batch, x, lower, upper, max_iters=lbfgs_iters, max_ls=10, value_fn=neg_values
+            neg_batch, x, lower, upper, max_iters=lbfgs_iters, max_ls=10,
+            value_fn=neg_values,
         )
         v_new = -neg_new
         better = v_new > cur
@@ -138,4 +168,124 @@ def gp_suggest_fused(
             x, cur = sweep(x, cur)
 
     winner = jnp.argmax(cur)
-    return x[winner], cur[winner], raw
+    return x[winner], cur[winner]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_local_search", "n_cycles", "lbfgs_iters", "fit_iters", "has_sweep"),
+)
+def gp_suggest_fused(
+    starts: jnp.ndarray,  # (S, d+2) kernel-param starts
+    X: jnp.ndarray,  # (N, d) padded observations
+    y: jnp.ndarray,  # (N,)
+    cat_mask: jnp.ndarray,  # (d,)
+    mask: jnp.ndarray,  # (N,)
+    sobol_base: jnp.ndarray,  # (C, d) device-resident Sobol pool
+    incumbents: jnp.ndarray,  # (I, d) recent observed points joining the pool
+    key: jax.Array,
+    minimum_noise: float,
+    cont_mask: jnp.ndarray,  # (d,)
+    lower: jnp.ndarray,  # (d,)
+    upper: jnp.ndarray,  # (d,)
+    n_choices: jnp.ndarray,  # (d,) float; 0 for non-categorical
+    steps: jnp.ndarray,  # (d,) normalized step; 0 => continuous
+    dim_onehot: jnp.ndarray,  # (Dd, d) sweep tables (dummy (1,d) when unused)
+    choice_grid: jnp.ndarray,  # (Dd, Cmax)
+    choice_valid: jnp.ndarray,  # (Dd, Cmax)
+    stabilizing_noise: float = 1e-10,
+    n_local_search: int = 10,
+    n_cycles: int = 2,
+    lbfgs_iters: int = 40,
+    fit_iters: int = 60,
+    has_sweep: bool = False,
+):
+    raw, params = _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters)
+    state = _state_for(params, X, y, cat_mask, mask)
+    best = jnp.max(jnp.where(mask > 0, y, -jnp.inf))
+    data = LogEIData(
+        state=state,
+        cat_mask=cat_mask,
+        best=best,
+        stabilizing_noise=jnp.asarray(stabilizing_noise, dtype=X.dtype),
+    )
+    k_cand, k_start = jax.random.split(key)
+    cand = device_candidates(sobol_base, k_cand, cat_mask, n_choices, steps)
+    cand = jnp.concatenate([incumbents, cand], axis=0)
+    x_best, v_best = _maximize_logei(
+        data, cand, k_start, cont_mask, lower, upper,
+        dim_onehot, choice_grid, choice_valid,
+        n_local_search=n_local_search, n_cycles=n_cycles,
+        lbfgs_iters=lbfgs_iters, has_sweep=has_sweep,
+    )
+    return x_best, v_best, raw
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "q", "n_local_search", "n_cycles", "lbfgs_iters", "fit_iters", "has_sweep"
+    ),
+)
+def gp_suggest_chain_fused(
+    starts: jnp.ndarray,  # (S, d+2)
+    X: jnp.ndarray,  # (N, d) padded, with >= q free (masked-off) slots
+    y: jnp.ndarray,  # (N,)
+    cat_mask: jnp.ndarray,  # (d,)
+    mask: jnp.ndarray,  # (N,)
+    n_real: jnp.ndarray,  # () int32 — index of the first free slot
+    sobol_base: jnp.ndarray,  # (C, d)
+    incumbents: jnp.ndarray,  # (I, d)
+    key: jax.Array,
+    minimum_noise: float,
+    cont_mask: jnp.ndarray,
+    lower: jnp.ndarray,
+    upper: jnp.ndarray,
+    n_choices: jnp.ndarray,
+    steps: jnp.ndarray,
+    dim_onehot: jnp.ndarray,
+    choice_grid: jnp.ndarray,
+    choice_valid: jnp.ndarray,
+    stabilizing_noise: float = 1e-10,
+    q: int = 8,
+    n_local_search: int = 6,
+    n_cycles: int = 1,
+    lbfgs_iters: int = 20,
+    fit_iters: int = 30,
+    has_sweep: bool = False,
+):
+    """q joint proposals from one dispatch via kriging-believer fantasies.
+
+    The kernel-param fit runs once for the whole chain; each scan step
+    rebuilds the Cholesky over the (masked) extended data, maximizes LogEI,
+    then conditions on the posterior mean at the winner. Mirrors the
+    reference's qLogEI intent (``optuna/_gp/acqf.py:154``) but sequential-
+    greedy, which keeps every step a plain LogEI maximization.
+    """
+    raw, params = _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters)
+    noise_c = jnp.asarray(stabilizing_noise, dtype=X.dtype)
+
+    def propose(carry, i):
+        Xc, yc, mc = carry
+        state = _state_for(params, Xc, yc, cat_mask, mc)
+        best = jnp.max(jnp.where(mc > 0, yc, -jnp.inf))
+        data = LogEIData(state=state, cat_mask=cat_mask, best=best, stabilizing_noise=noise_c)
+        k_i = jax.random.fold_in(key, i)
+        k_cand, k_start = jax.random.split(k_i)
+        cand = device_candidates(sobol_base, k_cand, cat_mask, n_choices, steps)
+        cand = jnp.concatenate([incumbents, cand], axis=0)
+        x_i, v_i = _maximize_logei(
+            data, cand, k_start, cont_mask, lower, upper,
+            dim_onehot, choice_grid, choice_valid,
+            n_local_search=n_local_search, n_cycles=n_cycles,
+            lbfgs_iters=lbfgs_iters, has_sweep=has_sweep,
+        )
+        mean_i, _ = posterior(state, x_i[None], cat_mask)
+        slot = n_real + i
+        Xc = Xc.at[slot].set(x_i)
+        yc = yc.at[slot].set(mean_i[0])
+        mc = mc.at[slot].set(1.0)
+        return (Xc, yc, mc), (x_i, v_i)
+
+    (_, _, _), (xs, vs) = jax.lax.scan(propose, (X, y, mask), jnp.arange(q))
+    return xs, vs, raw

@@ -44,31 +44,57 @@ def _tree_boxes(tree) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     return np.asarray(lows), np.asarray(highs), np.asarray(values)
 
 
-def _tree_marginal_variances(tree, n_features: int) -> tuple[np.ndarray, float]:
-    """First-order marginal variance per feature + total variance, exact over
-    the split-box partition (uniform measure on the unit box)."""
+def _tree_group_variances(
+    tree, groups: list[np.ndarray]
+) -> tuple[np.ndarray, float]:
+    """First-order marginal variance per *feature group* + total variance,
+    exact over the split-box partition (uniform measure on the unit box).
+
+    A group is the set of encoded columns of one parameter — a single column
+    for numericals, all one-hot columns for a categorical. Marginalizing the
+    group *jointly* (not summing per-column variances) is what the reference
+    fANOVA computes via ``column_to_encoded_columns``
+    (``_fanova/_evaluator.py:121``, ``_fanova/_fanova.py``)."""
     lows, highs, values = _tree_boxes(tree)
     widths = highs - lows  # (L, d)
     vols = np.prod(widths, axis=1)  # (L,)
     mean = float(np.sum(values * vols))
     total_var = float(np.sum(values * values * vols) - mean * mean)
     if total_var <= 0:
-        return np.zeros(n_features), 0.0
+        return np.zeros(len(groups)), 0.0
 
-    marginal_var = np.zeros(n_features)
-    for j in range(n_features):
-        # Segment [0,1] along j by all leaf boundaries on j.
-        cuts = np.unique(np.concatenate([lows[:, j], highs[:, j], [0.0, 1.0]]))
-        seg_lo, seg_hi = cuts[:-1], cuts[1:]
-        seg_w = seg_hi - seg_lo
-        mids = 0.5 * (seg_lo + seg_hi)
-        # Leaf l covers segment s iff lows[l,j] <= mid < highs[l,j].
-        cover = (lows[:, j][None, :] <= mids[:, None]) & (mids[:, None] < highs[:, j][None, :])
-        vol_other = vols / np.where(widths[:, j] > 0, widths[:, j], 1.0)  # (L,)
-        m = cover @ (values * vol_other)  # (S,) marginal mean per segment
-        var_j = float(np.sum(seg_w * (m - mean) ** 2))
-        marginal_var[j] = max(var_j, 0.0)
-    return marginal_var, total_var
+    group_var = np.zeros(len(groups))
+    for gi, dims in enumerate(groups):
+        seg_weights = []  # per dim: (S_j,)
+        covers = []  # per dim: (S_j, L)
+        for j in dims:
+            cuts = np.unique(np.concatenate([lows[:, j], highs[:, j], [0.0, 1.0]]))
+            seg_lo, seg_hi = cuts[:-1], cuts[1:]
+            mids = 0.5 * (seg_lo + seg_hi)
+            seg_weights.append(seg_hi - seg_lo)
+            covers.append(
+                (lows[:, j][None, :] <= mids[:, None])
+                & (mids[:, None] < highs[:, j][None, :])
+            )
+        denom = np.prod(
+            [np.where(widths[:, j] > 0, widths[:, j], 1.0) for j in dims], axis=0
+        )
+        # M[s1..sk] = sum_l (prod_j cover_j[s_j, l]) * value_l * vol_other_l:
+        # one contraction over the shared leaf index. Integer-sublist einsum
+        # form — letter subscripts would collide/overflow past 25 group dims
+        # (e.g. a 26-choice categorical).
+        k = len(dims)
+        leaf_ax = k  # shared contracted axis id
+        operands: list = []
+        for ax, cov in enumerate(covers):
+            operands.extend([cov.astype(np.float64), [ax, leaf_ax]])
+        operands.extend([values * vols / denom, [leaf_ax]])
+        m = np.einsum(*operands, list(range(k)))
+        w = seg_weights[0]
+        for sw in seg_weights[1:]:
+            w = np.multiply.outer(w, sw)
+        group_var[gi] = max(float(np.sum(w * (m - mean) ** 2)), 0.0)
+    return group_var, total_var
 
 
 class FanovaImportanceEvaluator:
@@ -88,7 +114,14 @@ class FanovaImportanceEvaluator:
 
         trials, params = _get_filtered_trials(study, params, target)
         space = {p: trials[0].distributions[p] for p in params}
-        trans = SearchSpaceTransform(space, transform_log=True, transform_step=True, transform_0_1=True)
+        # Raw (non-log) numerical values, like the reference's fANOVA
+        # (`_fanova/_evaluator.py:110`): the ANOVA measure is uniform over the
+        # *raw* box. The affine 0-1 rescaling preserves both sklearn's split
+        # structure and uniform-measure marginal variances, so the unit-box
+        # math below matches the reference's raw-bounds computation exactly.
+        trans = SearchSpaceTransform(
+            space, transform_log=False, transform_step=False, transform_0_1=True
+        )
         X = trans.encode_many([t.params for t in trials])
         y = _target_values(trials, target)
 
@@ -104,19 +137,16 @@ class FanovaImportanceEvaluator:
         )
         forest.fit(X, y)
 
-        n_enc = X.shape[1]
-        fractions = np.zeros(n_enc)
+        groups = [np.asarray(cols) for cols in trans.column_to_encoded_columns]
+        fractions = np.zeros(len(groups))
         n_used = 0
         for tree in forest.estimators_:
-            mv, tv = _tree_marginal_variances(tree, n_enc)
+            gv, tv = _tree_group_variances(tree, groups)
             if tv > 0:
-                fractions += mv / tv
+                fractions += gv / tv
                 n_used += 1
         if n_used:
             fractions /= n_used
 
-        # Collapse one-hot columns back onto their parameter.
-        importances = {p: 0.0 for p in params}
-        for enc_col, col in enumerate(trans.encoded_column_to_column):
-            importances[params[int(col)]] += float(fractions[enc_col])
+        importances = {p: float(fractions[i]) for i, p in enumerate(params)}
         return dict(sorted(importances.items(), key=lambda kv: kv[1], reverse=True))

@@ -40,6 +40,16 @@ class CmaState(NamedTuple):
     c_mu: jnp.ndarray
     chi_n: jnp.ndarray
     sep: jnp.ndarray  # () bool — separable (diagonal) update
+    # Learning-rate adaptation (LRA-CMA-ES, the reference activates it via
+    # its cmaes package's lr_adapt flag): EMA signal/noise trackers for the
+    # mean and covariance updates plus the adapted rates themselves. Inert
+    # (eta == 1, trackers unread) unless cma_tell(..., lr_adapt=True).
+    eta_m: jnp.ndarray  # ()
+    eta_c: jnp.ndarray  # ()
+    e_m: jnp.ndarray  # (d,) EMA of normalized mean updates
+    v_m: jnp.ndarray  # () EMA of their squared norm
+    e_c: jnp.ndarray  # (d, d) EMA of covariance updates
+    v_c: jnp.ndarray  # () EMA of their squared Frobenius norm
 
 
 def default_popsize(dim: int) -> int:
@@ -88,6 +98,12 @@ def cma_init(
         c_mu=jnp.asarray(c_mu, dtype=jnp.float32),
         chi_n=jnp.asarray(chi_n, dtype=jnp.float32),
         sep=jnp.asarray(sep),
+        eta_m=jnp.asarray(1.0, dtype=jnp.float32),
+        eta_c=jnp.asarray(1.0, dtype=jnp.float32),
+        e_m=jnp.zeros(d, dtype=jnp.float32),
+        v_m=jnp.asarray(0.0, dtype=jnp.float32),
+        e_c=jnp.zeros((d, d), dtype=jnp.float32),
+        v_c=jnp.asarray(0.0, dtype=jnp.float32),
     )
 
 
@@ -116,8 +132,10 @@ def cma_ask(state: CmaState, key: jax.Array, n: int) -> jnp.ndarray:
     return jnp.clip(x, 0.0, 1.0)
 
 
-@jax.jit
-def cma_tell(state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray) -> CmaState:
+@partial(jax.jit, static_argnames=("lr_adapt",))
+def cma_tell(
+    state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray, lr_adapt: bool = False
+) -> CmaState:
     """One generation update from evaluated population (X (lam,d), minimize)."""
     d = state.mean.shape[0]
     lam = X.shape[0]
@@ -167,6 +185,43 @@ def cma_tell(state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray) -> CmaState:
         C_new,
     )
 
+    lr_fields = {}
+    if lr_adapt:
+        # LRA-CMA-ES-style rate adaptation: estimate the signal-to-noise
+        # ratio of the (normalized) mean and covariance updates through EMAs
+        # and scale each learning rate toward SNR/alpha == 1 (the reference
+        # reaches this via its cmaes package's lr_adapt=True). The raw
+        # updates above stay untouched; only the applied fraction changes.
+        beta_m, beta_c, gamma, alpha_snr = 0.1, 0.03, 0.1, 1.4
+
+        def adapt(e, v, delta, norm2, beta, eta):
+            e_new = (1 - beta) * e + beta * delta
+            v_new = (1 - beta) * v + beta * norm2
+            e2 = jnp.sum(e_new * e_new)
+            snr = (e2 - beta / (2 - beta) * v_new) / jnp.maximum(v_new - e2, 1e-20)
+            eta_new = eta * jnp.exp(
+                jnp.minimum(gamma * eta, beta) * (snr / alpha_snr - 1.0)
+            )
+            return e_new, v_new, jnp.clip(eta_new, 1e-4, 1.0)
+
+        dm = (mean_new - state.mean) / jnp.maximum(state.sigma, 1e-20)
+        e_m, v_m, eta_m = adapt(
+            state.e_m, state.v_m, dm, jnp.sum(dm * dm), beta_m, state.eta_m
+        )
+        dC = C_new - state.C
+        e_c, v_c, eta_c = adapt(
+            state.e_c, state.v_c, dC, jnp.sum(dC * dC), beta_c, state.eta_c
+        )
+        mean_new = state.mean + eta_m * (mean_new - state.mean)
+        C_new = state.C + eta_c * (C_new - state.C)
+        C_new = jax.lax.cond(
+            state.sep,
+            lambda C: jnp.diag(jnp.diagonal(C)),
+            lambda C: 0.5 * (C + C.T),
+            C_new,
+        )
+        lr_fields = dict(eta_m=eta_m, eta_c=eta_c, e_m=e_m, v_m=v_m, e_c=e_c, v_c=v_c)
+
     return state._replace(
         mean=mean_new,
         sigma=sigma_new,
@@ -174,12 +229,18 @@ def cma_tell(state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray) -> CmaState:
         p_sigma=p_sigma,
         p_c=p_c,
         generation=state.generation + 1,
+        **lr_fields,
     )
 
 
-@partial(jax.jit, static_argnames=("n",))
+@partial(jax.jit, static_argnames=("n", "lr_adapt"))
 def cma_tell_and_ask(
-    state: CmaState, X: jnp.ndarray, fitness: jnp.ndarray, key: jax.Array, n: int
+    state: CmaState,
+    X: jnp.ndarray,
+    fitness: jnp.ndarray,
+    key: jax.Array,
+    n: int,
+    lr_adapt: bool = False,
 ) -> tuple[CmaState, jnp.ndarray]:
     """Fused generation update + next-population sampling.
 
@@ -187,8 +248,98 @@ def cma_tell_and_ask(
     tunneled TPU each dispatch costs ~100ms of latency, so the whole ask/tell
     cycle is a single XLA program and the per-trial path is pure host work.
     """
-    new_state = cma_tell(state, X, fitness)
+    new_state = cma_tell(state, X, fitness, lr_adapt=lr_adapt)
     return new_state, cma_ask(new_state, key, n)
+
+
+# ------------------------------------------------------- margin & termination
+
+
+def apply_margin(state: CmaState, steps: np.ndarray, alpha: float) -> CmaState:
+    """CMA-with-margin correction for discrete dims (reference routes
+    int/stepped spaces through its cmaes package's CMAwM when
+    ``with_margin=True``; Hamano et al. 2022).
+
+    ``steps`` holds each dimension's normalized grid step (0 = continuous).
+    For every discrete dim the per-dim std is inflated until the probability
+    of sampling *outside* the mean's current grid cell is at least ``alpha``
+    (>= alpha/2 per tail), so the optimizer can never freeze into one cell
+    while sigma collapses. Runs on host once per generation — O(d) scalar
+    math on an already-fetched state."""
+    from scipy.stats import norm
+
+    steps = np.asarray(steps, dtype=np.float64)
+    if not np.any(steps > 0):
+        return state
+    mean = np.asarray(state.mean, dtype=np.float64)
+    sigma = float(np.asarray(state.sigma))
+    C = np.array(state.C, dtype=np.float64)
+    z_tail = float(norm.ppf(1.0 - alpha / 2.0))
+    changed = False
+    for i in np.nonzero(steps > 0)[0]:
+        s = steps[i]
+        cell = np.floor(mean[i] / s)
+        low_edge, high_edge = s * cell, s * (cell + 1)
+        sd_i = sigma * math.sqrt(max(C[i, i], 0.0))
+        needed = max(high_edge - mean[i], mean[i] - low_edge) / max(z_tail, 1e-12)
+        if sd_i < needed:
+            C[i, i] = (needed / max(sigma, 1e-20)) ** 2
+            changed = True
+    if not changed:
+        return state
+    return state._replace(C=jnp.asarray(C, dtype=jnp.float32))
+
+
+def should_stop(
+    state: CmaState,
+    fitness: np.ndarray,
+    best_history: np.ndarray,
+    sigma0: float,
+) -> str | None:
+    """Restart-triggering termination criteria, evaluated on host once per
+    generation (the standard CMA-ES tolerance set the reference inherits
+    from its cmaes package: tolfun/tolx/tolxup/conditioncov/noeffect*).
+
+    Returns the name of the tripped criterion, or None."""
+    mean = np.asarray(state.mean, dtype=np.float64)
+    sigma = float(np.asarray(state.sigma))
+    C = np.array(state.C, dtype=np.float64)
+    d = len(mean)
+    diag = np.clip(np.diagonal(C), 0.0, None)
+
+    f = np.asarray(fitness, dtype=np.float64)
+    if len(f) and np.ptp(f) < 1e-12 and (
+        len(best_history) >= 10 and np.ptp(best_history[-10:]) < 1e-12
+    ):
+        return "tolfun"
+    tolx = 1e-12 * sigma0
+    if np.all(sigma * np.sqrt(diag) < tolx) and np.all(
+        sigma * np.abs(np.asarray(state.p_c)) < tolx
+    ):
+        return "tolx"
+    eigvals = diag if bool(np.asarray(state.sep)) else np.clip(
+        np.linalg.eigvalsh(C), 0.0, None
+    )
+    if sigma * math.sqrt(float(np.max(eigvals, initial=0.0))) > 1e4 * sigma0:
+        return "tolxup"
+    lo = float(np.min(eigvals, initial=0.0))
+    if lo > 0 and float(np.max(eigvals)) / lo > 1e14:
+        return "conditioncov"
+    if np.all(mean == mean + 0.2 * sigma * np.sqrt(diag)):
+        return "noeffectcoord"
+    gen = int(np.asarray(state.generation))
+    if not bool(np.asarray(state.sep)) and d > 0:
+        w, B = np.linalg.eigh(C)
+        i = gen % d
+        axis = 0.1 * sigma * math.sqrt(max(w[i], 0.0)) * B[:, i]
+        if np.all(mean == mean + axis):
+            return "noeffectaxis"
+    if len(best_history) > 120 + 30 * d:
+        recent = best_history[-20:]
+        older = best_history[-(120 + 30 * d):][:20]
+        if np.median(recent) >= np.median(older):
+            return "stagnation"
+    return None
 
 
 # ------------------------------------------------------------- serialization

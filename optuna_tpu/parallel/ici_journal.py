@@ -63,22 +63,35 @@ class IciJournalBackend(BaseJournalBackend):
         payload = buf[_HEADER : _HEADER + n].tobytes()
         return [json.loads(line) for line in payload.splitlines() if line]
 
-    def exchange(self) -> None:
-        """Collective sync point: allgather every host's pending ops and merge
-        them in (round, process_index, local order)."""
+    def _allgather(self, buf: np.ndarray) -> np.ndarray | None:
+        """Pod-wide gather of one packed buffer -> (P, buffer) rows in
+        process_index order; None means single-process (degenerate gather).
+
+        Overridable seam: tests drive a fake multi-host bus through it, and a
+        different transport (e.g. a DCN sidecar) can slot in without touching
+        the merge/replay logic."""
         import jax
 
         if jax.process_count() == 1:
+            return None
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(buf))
+
+    def exchange(self) -> None:
+        """Collective sync point: allgather every host's pending ops and merge
+        them in (round, process_index, local order).
+
+        Crash safety: ``_pending`` is only drained *after* the collective
+        returns, so a failed/interrupted exchange loses nothing — the caller
+        can retry and the ops ride the next round exactly once."""
+        gathered = self._allgather(self._pack(self._pending))
+        if gathered is None:
             # Degenerate gather: local ops become globally visible directly.
             self._merged.extend(self._pending)
             self._pending = []
             self._round += 1
             return
-
-        from jax.experimental import multihost_utils
-
-        buf = self._pack(self._pending)
-        gathered = np.asarray(multihost_utils.process_allgather(buf))  # (P, buffer)
         self._pending = []
         for p in range(gathered.shape[0]):
             self._merged.extend(self._unpack(gathered[p]))

@@ -35,6 +35,7 @@ if TYPE_CHECKING:
 _logger = get_logger(__name__)
 
 _GENERATION_KEY = "cma:generation"
+_RUN_KEY = "cma:run"  # increments on IPOP/BIPOP restarts
 _X_KEY = "cma:x"
 _STATE_KEY_PREFIX = "cma:state"
 _MAX_CHUNK = 2045  # mirrors the reference's RDB varchar-safe chunking
@@ -74,14 +75,6 @@ class CmaEsSampler(BaseSampler):
         self._lr_adapt = lr_adapt
         if restart_strategy is not None and restart_strategy not in ("ipop", "bipop"):
             raise ValueError("restart_strategy must be one of 'ipop', 'bipop' or None.")
-        for flag, name in ((with_margin, "with_margin"),
-                           (restart_strategy is not None, "restart_strategy"),
-                           (lr_adapt, "lr_adapt")):
-            if flag:
-                _logger.warning(
-                    f"`{name}` is accepted for API compatibility but not yet active "
-                    "in this version; the option currently has no effect."
-                )
 
     def reseed_rng(self) -> None:
         self._rng.seed()
@@ -135,11 +128,12 @@ class CmaEsSampler(BaseSampler):
 
         trans = SearchSpaceTransform(search_space, transform_0_1=True)
         dim = len(trans.bounds)
-        popsize = self._popsize or cma_ops.default_popsize(dim)
+        sigma0 = self._sigma0 or 0.3  # [0,1]-normalized space
+        steps = self._normalized_steps(trans, search_space) if self._with_margin else None
 
         restored = self._restore_state(study)
         if restored is not None and (
-            restored[0].mean.shape[0] != dim or restored[1].shape[1] != dim
+            restored[0].mean.shape[0] != dim or restored[1]["queue"].shape[1] != dim
         ):
             # Dynamic define-by-run space changed dimensionality: the stored
             # optimizer no longer matches (reference _cmaes.py:414 guard).
@@ -149,25 +143,44 @@ class CmaEsSampler(BaseSampler):
             )
             restored = None
         if restored is None:
+            popsize = self._popsize or cma_ops.default_popsize(dim)
             mean0 = self._initial_mean(trans, search_space)
-            sigma0 = self._sigma0 or 0.3  # [0,1]-normalized space
             state = cma_ops.cma_init(
                 mean0, sigma0, popsize=popsize, sep=self._use_separable_cma
             )
+            if steps is not None:
+                state = cma_ops.apply_margin(state, steps, self._margin_alpha(dim, popsize))
             key = jax.random.fold_in(jax.random.PRNGKey(self._seed_value()), 0)
             queue = np.asarray(cma_ops.cma_ask(state, key, popsize), dtype=np.float64)
-            self._store_state(study, state, queue)
+            extra = {
+                "queue": queue,
+                "run": np.asarray(0),
+                "popsize": np.asarray(popsize),
+                "n_restarts": np.asarray(0),
+                "n_large": np.asarray(0),
+                "budget_large": np.asarray(0),
+                "budget_small": np.asarray(0),
+                "evals_run": np.asarray(0),
+                "best_hist": np.zeros(0),
+                "regime": np.asarray(0),  # 0 = large (the initial run), 1 = small
+            }
+            self._store_state(study, state, extra)
         else:
-            state, queue = restored
+            state, extra = restored
+        popsize = int(np.asarray(extra["popsize"]))
+        run = int(np.asarray(extra["run"]))
+        queue = np.asarray(extra["queue"], dtype=np.float64)
 
         # Tell when the current generation has a full set of completed
-        # solutions; fused tell+ask = ONE device dispatch per generation (the
-        # per-trial path below is pure host work).
+        # solutions; the plain config fuses tell+ask into ONE device dispatch
+        # per generation (margin/restart checks add host-side work only on
+        # generation boundaries; the per-trial path below is pure host work).
         gen = int(np.asarray(state.generation))
         gen_trials = [
             t
             for t in completed
             if t.system_attrs.get(_GENERATION_KEY) == gen
+            and t.system_attrs.get(_RUN_KEY, 0) == run
             and _X_KEY in t.system_attrs
             and t.values is not None  # pruned trials without reports carry no value
         ]
@@ -177,26 +190,149 @@ class CmaEsSampler(BaseSampler):
             sign = 1.0 if study.direction == StudyDirection.MINIMIZE else -1.0
             fitness = np.asarray([sign * t.value for t in gen_trials], dtype=np.float32)
             key = jax.random.fold_in(
-                jax.random.PRNGKey(self._seed_value()), gen + 1
+                jax.random.PRNGKey(self._seed_value()), (run << 16) ^ (gen + 1)
             )
-            state, queue_j = cma_ops.cma_tell_and_ask(
-                state, X, fitness, key, popsize
+            # Keep enough history for every termination criterion: the
+            # stagnation test needs 120 + 30*d generations plus its 20-gen
+            # comparison windows.
+            hist_cap = 120 + 30 * dim + 60
+            extra["best_hist"] = np.append(
+                np.asarray(extra["best_hist"], dtype=np.float64), float(fitness.min())
+            )[-hist_cap:]
+            extra["evals_run"] = np.asarray(int(np.asarray(extra["evals_run"])) + popsize)
+
+            needs_host_state = (
+                steps is not None or self._restart_strategy is not None
             )
-            queue = np.asarray(queue_j, dtype=np.float64)
-            self._store_state(study, state, queue)
+            if not needs_host_state:
+                state, queue_j = cma_ops.cma_tell_and_ask(
+                    state, X, fitness, key, popsize, lr_adapt=self._lr_adapt
+                )
+                queue = np.asarray(queue_j, dtype=np.float64)
+            else:
+                state = cma_ops.cma_tell(state, X, fitness, lr_adapt=self._lr_adapt)
+                stop = (
+                    cma_ops.should_stop(
+                        state, fitness, np.asarray(extra["best_hist"]), sigma0
+                    )
+                    if self._restart_strategy is not None
+                    else None
+                )
+                if stop is not None:
+                    state, extra, popsize = self._restarted(extra, sigma0, stop, dim)
+                    run = int(np.asarray(extra["run"]))
+                if steps is not None:
+                    state = cma_ops.apply_margin(
+                        state, steps, self._margin_alpha(dim, popsize)
+                    )
+                queue = np.asarray(
+                    cma_ops.cma_ask(state, key, popsize), dtype=np.float64
+                )
+            extra["queue"] = queue
+            self._store_state(study, state, extra)
             gen = int(np.asarray(state.generation))
 
         # Pop the next queued solution: index = how many trials this
         # generation already claimed (completed or running).
         all_trials = study._get_trials(deepcopy=False, use_cache=True)
         n_claimed = sum(
-            1 for t in all_trials if t.system_attrs.get(_GENERATION_KEY) == gen
+            1
+            for t in all_trials
+            if t.system_attrs.get(_GENERATION_KEY) == gen
+            and t.system_attrs.get(_RUN_KEY, 0) == run
         )
         x = queue[n_claimed % popsize]
 
         study._storage.set_trial_system_attr(trial._trial_id, _GENERATION_KEY, gen)
+        if run:
+            study._storage.set_trial_system_attr(trial._trial_id, _RUN_KEY, run)
         study._storage.set_trial_system_attr(trial._trial_id, _X_KEY, x.tolist())
         return trans.untransform(x)
+
+    # ------------------------------------------------------- restarts / margin
+
+    @staticmethod
+    def _margin_alpha(dim: int, popsize: int) -> float:
+        # CMAwM's default margin: 1 / (d * lambda).
+        return 1.0 / max(dim * popsize, 1)
+
+    @staticmethod
+    def _normalized_steps(
+        trans: SearchSpaceTransform, search_space: dict[str, BaseDistribution]
+    ) -> np.ndarray | None:
+        """Per-encoded-dim grid step in the [0,1] space (0 = continuous)."""
+        steps = []
+        for dist in search_space.values():
+            step = getattr(dist, "step", None)
+            if step:
+                low, high = float(dist.low), float(dist.high)
+                # The transform widens discrete bounds by half a step.
+                steps.append(step / max(high - low + step, 1e-12))
+            else:
+                steps.append(0.0)
+        arr = np.asarray(steps, dtype=np.float64)
+        return arr if np.any(arr > 0) else None
+
+    def _restarted(self, extra, sigma0, reason, dim):
+        """Build a fresh optimizer per the IPOP/BIPOP schedule (reference
+        ``_cmaes.py:507-589``: IPOP multiplies popsize by ``inc_popsize``
+        each restart; BIPOP alternates large and budget-matched small
+        regimes)."""
+        from optuna_tpu.ops import cmaes as cma_ops
+
+        default = cma_ops.default_popsize(dim)
+        n_restarts = int(np.asarray(extra["n_restarts"])) + 1
+        n_large = int(np.asarray(extra["n_large"]))
+        budget_large = int(np.asarray(extra["budget_large"]))
+        budget_small = int(np.asarray(extra["budget_small"]))
+        evals_run = int(np.asarray(extra["evals_run"]))
+        prev_popsize = int(np.asarray(extra["popsize"]))
+
+        prev_regime = int(np.asarray(extra.get("regime", 0)))
+
+        rng = self._rng.rng
+        new_regime = 0
+        if self._restart_strategy == "ipop":
+            popsize = prev_popsize * self._inc_popsize
+            n_large += 1
+            budget_large += evals_run
+        else:  # bipop
+            # Attribute the finished run's evals to its *recorded* regime —
+            # a small-regime draw can exceed the default popsize, so the
+            # regime cannot be inferred from the popsize.
+            if prev_regime == 0:
+                budget_large += evals_run
+            else:
+                budget_small += evals_run
+            if budget_small < budget_large:
+                new_regime = 1
+                ratio = 0.5 * self._inc_popsize ** n_large
+                popsize = max(
+                    2, int(default * ratio ** (rng.uniform() ** 2))
+                )
+            else:
+                n_large += 1
+                popsize = default * self._inc_popsize ** n_large
+        _logger.info(
+            f"CMA-ES restart #{n_restarts} ({self._restart_strategy}, reason="
+            f"{reason}): popsize {prev_popsize} -> {popsize}."
+        )
+        mean0 = rng.uniform(0.0, 1.0, size=dim)
+        state = cma_ops.cma_init(
+            mean0, sigma0, popsize=popsize, sep=self._use_separable_cma
+        )
+        extra.update(
+            run=np.asarray(int(np.asarray(extra["run"])) + 1),
+            popsize=np.asarray(popsize),
+            n_restarts=np.asarray(n_restarts),
+            n_large=np.asarray(n_large),
+            budget_large=np.asarray(budget_large),
+            budget_small=np.asarray(budget_small),
+            evals_run=np.asarray(0),
+            best_hist=np.zeros(0),
+            regime=np.asarray(new_regime),
+        )
+        return state, extra, popsize
 
     def _initial_mean(
         self, trans: SearchSpaceTransform, search_space: dict[str, BaseDistribution]
@@ -217,10 +353,10 @@ class CmaEsSampler(BaseSampler):
         variant = "sep" if self._use_separable_cma else "full"
         return f"{_STATE_KEY_PREFIX}:{variant}"
 
-    def _store_state(self, study: "Study", state, queue: np.ndarray) -> None:
+    def _store_state(self, study: "Study", state, extra: dict[str, np.ndarray]) -> None:
         from optuna_tpu.ops.cmaes import state_to_bytes
 
-        payload = state_to_bytes(state, extra={"queue": queue})
+        payload = state_to_bytes(state, extra=extra)
         hexstr = payload.hex()
         chunks = [hexstr[i : i + _MAX_CHUNK] for i in range(0, len(hexstr), _MAX_CHUNK)]
         key = self._attr_key()
@@ -233,7 +369,7 @@ class CmaEsSampler(BaseSampler):
         study._storage.set_study_system_attr(
             study._study_id, f"{key}:head", {"ver": ver, "n": len(chunks)}
         )
-        self._state_cache = (hexstr, (state, queue))
+        self._state_cache = (hexstr, (state, extra))
 
     def _restore_state(self, study: "Study"):
         from optuna_tpu.ops.cmaes import state_from_bytes
@@ -249,7 +385,7 @@ class CmaEsSampler(BaseSampler):
             if cached is not None and cached[0] == hexstr:
                 return cached[1]
             state, extra = state_from_bytes(bytes.fromhex(hexstr))
-            result = (state, np.asarray(extra["queue"]))
+            result = (state, extra)
             self._state_cache = (hexstr, result)
             return result
         except Exception:  # corrupt/racing attrs of any flavor -> clean restart

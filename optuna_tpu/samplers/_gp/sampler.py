@@ -52,6 +52,7 @@ class GPSampler(BaseSampler):
         constraints_func: Callable[[FrozenTrial], Sequence[float]] | None = None,
         n_preliminary_samples: int = 2048,
         n_local_search: int = 10,
+        speculative_chain: int = 0,
     ) -> None:
         self._rng = LazyRandomState(seed)
         self._independent_sampler = independent_sampler or RandomSampler(seed=seed)
@@ -64,6 +65,17 @@ class GPSampler(BaseSampler):
         # Warm-start cache: search-space signature -> raw log kernel params
         # (reference gp/sampler.py:244 kernel-param cache).
         self._kernel_params_cache: dict[tuple, list[np.ndarray]] = {}
+        # Device-resident per-space constants (Sobol pool, bounds, sweep
+        # tables) so per-trial host->device traffic is just history + starts.
+        self._device_space_cache: dict[tuple, "_DeviceSpace"] = {}
+        # Speculative ask-ahead: >1 turns sequential asks into kriging-
+        # believer chains of that depth, amortizing one device dispatch over
+        # `speculative_chain` trials. Proposal k of a chain is conditioned on
+        # GP-mean fantasies for the k-1 before it (not their true outcomes).
+        self._spec_chain = int(speculative_chain)
+        self._spec_queue: list[dict[str, Any]] = []
+        self._spec_sig: tuple | None = None
+        self._spec_expected_n = -1
 
     def reseed_rng(self) -> None:
         self._rng.seed()
@@ -135,6 +147,28 @@ class GPSampler(BaseSampler):
             and self._constraints_func is None
             and (running is None or len(running) == 0)
         ):
+            if self._spec_chain > 1:
+                # Ask-ahead: serve from (or refill) the speculative chain so
+                # q sequential asks cost one device dispatch. The queue is
+                # keyed by (study, space signature, completed count): a
+                # sampler shared across studies must never cross-serve.
+                n = len(trials)
+                spec_key = (study._study_id,) + sig
+                if (
+                    self._spec_queue
+                    and self._spec_sig == spec_key
+                    and n == self._spec_expected_n
+                ):
+                    self._spec_expected_n += 1
+                    return self._spec_queue.pop(0)
+                proposals = self._sample_chain(
+                    study, space, search_space, X, is_cat, trials, warm, sig, seed,
+                    q=self._spec_chain,
+                )
+                self._spec_queue = proposals[1:]
+                self._spec_sig = spec_key
+                self._spec_expected_n = n + 1
+                return proposals[0]
             # Hot path: the entire fit->acqf->optimize pipeline as ONE
             # device dispatch (gp/fused.py).
             return self._sample_fused(study, space, search_space, X, is_cat, trials, warm, sig, seed)
@@ -186,14 +220,26 @@ class GPSampler(BaseSampler):
         )
         return space.unnormalize_one(x_best)
 
-    def _sample_fused(self, study, space, search_space, X, is_cat, trials, warm, sig, seed):
-        """Single-objective unconstrained suggestion in one device dispatch."""
-        import jax
+    # --------------------------------------------------------- fused dispatch
+
+    # Fit budgets: cold multi-start when no warm kernel params exist for the
+    # space; a short 2-start refinement (default + previous optimum) once
+    # they do. Two (starts, iters) combos keep the jit cache small.
+    _COLD_FIT = (4, 60)
+    _WARM_FIT = (2, 24)
+
+    def _device_space(self, sig: tuple, space) -> "_DeviceSpace":
+        dev = self._device_space_cache.get(sig)
+        if dev is None:
+            dev = _DeviceSpace(space, self._n_preliminary_samples)
+            self._device_space_cache[sig] = dev
+        return dev
+
+    def _fused_inputs(self, study, space, X, trials, warm, pad_extra: int = 0):
+        """Shared host-side packing for the single and chain programs."""
         import jax.numpy as jnp
 
-        from optuna_tpu.gp.fused import gp_suggest_fused
         from optuna_tpu.gp.gp import _bucket
-        from optuna_tpu.gp.optim_mixed import _sweep_tables, continuous_bounds, snap_steps
 
         rng = self._rng.rng
         n, d = X.shape
@@ -201,7 +247,7 @@ class GPSampler(BaseSampler):
         score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
         y, _, _ = _standardize(score)
 
-        N = _bucket(n)
+        N = _bucket(n + pad_extra)
         Xp = np.zeros((N, d), dtype=np.float32)
         Xp[:n] = X
         yp = np.zeros(N, dtype=np.float32)
@@ -211,50 +257,121 @@ class GPSampler(BaseSampler):
 
         default = np.zeros(d + 2, dtype=np.float32)
         default[d + 1] = np.log(1e-2)
-        starts = [default]
         if warm is not None and len(warm):
-            starts.append(np.asarray(warm[0], dtype=np.float32))
-        while len(starts) < 4:
-            starts.append(
-                (default + rng.normal(0, 1.0, size=d + 2)).astype(np.float32)
-            )
-
-        cand = space.sample_normalized(
-            self._n_preliminary_samples, seed=int(rng.randint(0, 2**31 - 1))
-        ).astype(np.float32)
-        cand = np.concatenate([X[-min(n, 4):], cand], axis=0)
-
-        tables = _sweep_tables(space)
-        if tables is None:
-            onehot = np.zeros((1, d))
-            grid = np.zeros((1, 1))
-            valid = np.zeros((1, 1), dtype=bool)
+            n_starts, fit_iters = self._WARM_FIT
+            starts = [default, np.asarray(warm[0], dtype=np.float32)][:n_starts]
         else:
-            onehot, grid, valid = tables
-        cont_mask, lower, upper = continuous_bounds(space)
+            n_starts, fit_iters = self._COLD_FIT
+            starts = [default]
+        while len(starts) < n_starts:
+            starts.append((default + rng.normal(0, 1.0, size=d + 2)).astype(np.float32))
 
-        x_best, _, raw = gp_suggest_fused(
+        # Fixed-shape incumbent block: the most recent observations join the
+        # candidate pool so local search can start from near the frontier.
+        inc = X[-min(n, 4):]
+        if len(inc) < 4:
+            inc = np.concatenate([np.repeat(inc[:1], 4 - len(inc), axis=0), inc])
+        return (
             jnp.asarray(np.stack(starts)),
             jnp.asarray(Xp),
             jnp.asarray(yp),
-            jnp.asarray(is_cat.astype(bool)),
             jnp.asarray(maskp),
-            jnp.asarray(cand),
+            jnp.asarray(inc.astype(np.float32)),
+            n,
+            fit_iters,
+        )
+
+    def _sample_fused(self, study, space, search_space, X, is_cat, trials, warm, sig, seed):
+        """Single-objective unconstrained suggestion in one device dispatch."""
+        import jax
+
+        from optuna_tpu.gp.fused import gp_suggest_fused
+        from optuna_tpu.gp.optim_mixed import snap_steps
+
+        dev = self._device_space(sig, space)
+        starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
+            study, space, X, trials, warm
+        )
+        x_best, _, raw = gp_suggest_fused(
+            starts, Xp, yp, dev.cat_mask, maskp, dev.sobol_base, inc,
             jax.random.PRNGKey(seed),
             1e-7 if self._deterministic else 1e-5,
-            jnp.asarray(cont_mask, dtype=jnp.float32),
-            jnp.asarray(lower, dtype=jnp.float32),
-            jnp.asarray(upper, dtype=jnp.float32),
-            jnp.asarray(onehot, dtype=jnp.float32),
-            jnp.asarray(grid, dtype=jnp.float32),
-            jnp.asarray(valid),
+            dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
+            dev.dim_onehot, dev.choice_grid, dev.choice_valid,
             n_local_search=self._n_local_search,
-            has_sweep=tables is not None,
+            fit_iters=fit_iters,
+            has_sweep=dev.has_sweep,
         )
         self._kernel_params_cache[sig] = [np.asarray(raw)]
         # Snap stepped dims (the fused kernel treats them as continuous).
         x_np = snap_steps(space, np.asarray(x_best, dtype=np.float64))
         return space.unnormalize_one(x_np)
+
+    def _sample_chain(
+        self, study, space, search_space, X, is_cat, trials, warm, sig, seed, q
+    ) -> list[dict[str, Any]]:
+        """q kriging-believer proposals from one dispatch (gp/fused.py chain)."""
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.fused import gp_suggest_chain_fused
+        from optuna_tpu.gp.optim_mixed import snap_steps
+
+        dev = self._device_space(sig, space)
+        starts, Xp, yp, maskp, inc, n, fit_iters = self._fused_inputs(
+            study, space, X, trials, warm, pad_extra=q
+        )
+        xs, _, raw = gp_suggest_chain_fused(
+            starts, Xp, yp, dev.cat_mask, maskp, jnp.asarray(n, jnp.int32),
+            dev.sobol_base, inc,
+            jax.random.PRNGKey(seed),
+            1e-7 if self._deterministic else 1e-5,
+            dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
+            dev.dim_onehot, dev.choice_grid, dev.choice_valid,
+            q=q,
+            n_local_search=min(self._n_local_search, 6),
+            fit_iters=fit_iters,
+            has_sweep=dev.has_sweep,
+        )
+        self._kernel_params_cache[sig] = [np.asarray(raw)]
+        xs_np = np.asarray(xs, dtype=np.float64)
+        return [
+            space.unnormalize_one(snap_steps(space, xs_np[i])) for i in range(len(xs_np))
+        ]
+
+    def sample_relative_batch(
+        self,
+        study: "Study",
+        search_space: dict[str, BaseDistribution],
+        batch_size: int,
+    ) -> list[dict[str, Any]]:
+        """Batched ask: q joint proposals per device dispatch (the GP
+        counterpart of TPE's batch-ask; consumed by
+        :func:`optuna_tpu.parallel.optimize_vectorized`)."""
+        if not search_space:
+            return [{} for _ in range(batch_size)]
+        trials = study._get_trials(
+            deepcopy=False, states=(TrialState.COMPLETE,), use_cache=True
+        )
+        trials = [t for t in trials if all(p in t.params for p in search_space)]
+        if (
+            len(trials) < self._n_startup_trials
+            or len(study.directions) != 1
+            or self._constraints_func is not None
+        ):
+            return [{} for _ in range(batch_size)]
+
+        from optuna_tpu.gp.search_space import SearchSpace
+
+        space = SearchSpace(search_space)
+        X = space.normalize([t.params for t in trials]).astype(np.float32)
+        is_cat = np.asarray(space.is_categorical)
+        sig = self._space_signature(search_space)
+        warm = self._kernel_params_cache.get(sig)
+        seed = int(self._rng.rng.randint(0, 2**31 - 1))
+        return self._sample_chain(
+            study, space, search_space, X, is_cat, trials, warm, sig, seed, q=batch_size
+        )
 
     # ------------------------------------------------------------ acqf builds
 
@@ -436,6 +553,43 @@ class GPSampler(BaseSampler):
         if self._constraints_func is not None:
             _process_constraints_after_trial(self._constraints_func, study, trial, state)
         self._independent_sampler.after_trial(study, trial, state, values)
+
+
+class _DeviceSpace:
+    """Per-search-space constants resident on device across trials.
+
+    Uploading these once (Sobol pool especially: 2048 x d float32 is ~160 KB
+    at d=20) turns the per-trial host->device payload into just history +
+    kernel-param starts — a few KB — which matters when every transfer rides
+    a ~100 ms tunnel."""
+
+    def __init__(self, space, n_preliminary: int) -> None:
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.optim_mixed import _sweep_tables, continuous_bounds
+        from optuna_tpu.ops.qmc import sobol_sample
+
+        d = space.dim
+        base = sobol_sample(n_preliminary, d, seed=0)
+        self.sobol_base = jnp.asarray(base, dtype=jnp.float32)
+        self.cat_mask = jnp.asarray(np.asarray(space.is_categorical).astype(bool))
+        cont_mask, lower, upper = continuous_bounds(space)
+        self.cont_mask = jnp.asarray(cont_mask, dtype=jnp.float32)
+        self.lower = jnp.asarray(lower, dtype=jnp.float32)
+        self.upper = jnp.asarray(upper, dtype=jnp.float32)
+        self.n_choices = jnp.asarray(space.n_choices.astype(np.float32))
+        self.steps = jnp.asarray(space.steps.astype(np.float32))
+        tables = _sweep_tables(space)
+        self.has_sweep = tables is not None
+        if tables is None:
+            onehot = np.zeros((1, d))
+            grid = np.zeros((1, 1))
+            valid = np.zeros((1, 1), dtype=bool)
+        else:
+            onehot, grid, valid = tables
+        self.dim_onehot = jnp.asarray(onehot, dtype=jnp.float32)
+        self.choice_grid = jnp.asarray(grid, dtype=jnp.float32)
+        self.choice_valid = jnp.asarray(valid)
 
 
 def _standardize(values: np.ndarray) -> tuple[np.ndarray, float, float]:

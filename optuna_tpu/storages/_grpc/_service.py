@@ -2,21 +2,35 @@
 
 Parity target: ``optuna/storages/_grpc/`` (proto service + servicer +
 client). The reference generates protobuf stubs with protoc; this
-environment has the gRPC C-core runtime but no Python codegen plugin, so the
-service is defined through grpc's *generic handler* API with a
-pickle-based serializer — same HTTP/2 transport and fan-out properties,
-no generated code.
+environment has the gRPC C-core runtime but no Python codegen plugin, so
+the service rides grpc's *generic handler* API with a hand-rolled,
+**versioned JSON** wire codec — same HTTP/2 transport and fan-out
+properties, no generated code, and (unlike pickle) nothing on the wire can
+instantiate arbitrary classes: every rich type decodes through an explicit
+constructor table and unknown wire versions are rejected outright.
 
-Every storage method is one unary-unary RPC: request = (method_name,
-args tuple), response = (ok, payload-or-exception).
+Every storage method is one unary-unary RPC:
+request  = ``{"v": WIRE_VERSION, "m": method, "a": [...], "k": {...}}``
+response = ``{"v": WIRE_VERSION, "ok": bool, "p": payload-or-error}``.
 """
 
 from __future__ import annotations
 
-import pickle
+import datetime
+import json
+import math
 from typing import Any
 
+from optuna_tpu import exceptions as _exc
+from optuna_tpu.distributions import distribution_to_json, json_to_distribution
+
 SERVICE_NAME = "optuna_tpu.StorageProxy"
+WIRE_VERSION = 1
+
+
+class WireVersionError(RuntimeError):
+    """Peer speaks an unknown wire version."""
+
 
 # The BaseStorage surface exposed over the wire.
 METHODS = (
@@ -49,10 +63,180 @@ METHODS = (
     "get_failed_trial_callback",
 )
 
+# Exceptions allowed to re-materialize client-side, by name. Anything else
+# becomes a plain RuntimeError carrying the message — never an arbitrary
+# class lookup on attacker-controlled input.
+_ERROR_TYPES: dict[str, type[Exception]] = {
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+    "RuntimeError": RuntimeError,
+    "TypeError": TypeError,
+    "NotImplementedError": NotImplementedError,
+    "DuplicatedStudyError": _exc.DuplicatedStudyError,
+    "UpdateFinishedTrialError": _exc.UpdateFinishedTrialError,
+    "StorageInternalError": getattr(_exc, "StorageInternalError", RuntimeError),
+}
 
-def serialize(obj: Any) -> bytes:
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+def _enc(obj: Any) -> Any:
+    """Recursively encode one value into plain JSON types."""
+    from optuna_tpu.distributions import BaseDistribution
+    from optuna_tpu.study._frozen import FrozenStudy
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.trial._frozen import FrozenTrial
+    from optuna_tpu.trial._state import TrialState
+
+    # Enum checks must precede the int check: both enums are IntEnums, so
+    # isinstance(x, int) is True for them and would strip the type tag.
+    if isinstance(obj, StudyDirection):
+        return {"__t": "dir", "v": int(obj)}
+    if isinstance(obj, TrialState):
+        return {"__t": "st", "v": int(obj)}
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        if math.isfinite(obj):
+            return obj
+        return {"__t": "f", "v": repr(obj)}  # 'nan' / 'inf' / '-inf'
+    # numpy scalars (accepted by the old pickle wire) degrade to Python
+    # scalars; import-free duck checks keep numpy optional here.
+    if type(obj).__module__ == "numpy" and hasattr(obj, "item") and not hasattr(obj, "__len__"):
+        return _enc(obj.item())
+    if isinstance(obj, datetime.datetime):
+        return {"__t": "dt", "v": obj.isoformat()}
+    if isinstance(obj, BaseDistribution):
+        return {"__t": "dist", "v": distribution_to_json(obj)}
+    if isinstance(obj, FrozenTrial):
+        return {
+            "__t": "trial",
+            "number": obj.number,
+            "state": int(obj.state),
+            "values": _enc(obj.values),
+            "start": _enc(obj.datetime_start),
+            "complete": _enc(obj.datetime_complete),
+            "params": _enc(obj.params),
+            "dists": {k: distribution_to_json(d) for k, d in obj.distributions.items()},
+            "user": _enc(obj.user_attrs),
+            "system": _enc(obj.system_attrs),
+            "intermediate": [[k, _enc(v)] for k, v in obj.intermediate_values.items()],
+            "id": obj._trial_id,
+        }
+    if isinstance(obj, FrozenStudy):
+        return {
+            "__t": "study",
+            "name": obj.study_name,
+            "directions": [int(d) for d in obj.directions],
+            "user": _enc(obj.user_attrs),
+            "system": _enc(obj.system_attrs),
+            "id": obj._study_id,
+        }
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        items = [_enc(x) for x in obj]
+        if isinstance(obj, list):
+            return items
+        kind = "tuple" if isinstance(obj, tuple) else "set"
+        return {"__t": kind, "items": items}
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) and k != "__t" for k in obj):
+            return {k: _enc(v) for k, v in obj.items()}
+        return {"__t": "map", "items": [[_enc(k), _enc(v)] for k, v in obj.items()]}
+    raise TypeError(f"Cannot encode {type(obj).__name__} for the storage wire.")
 
 
-def deserialize(data: bytes) -> Any:
-    return pickle.loads(data)
+def _dec(obj: Any) -> Any:
+    from optuna_tpu.study._frozen import FrozenStudy
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.trial._frozen import FrozenTrial
+    from optuna_tpu.trial._state import TrialState
+
+    if isinstance(obj, list):
+        return [_dec(x) for x in obj]
+    if not isinstance(obj, dict):
+        return obj
+    tag = obj.get("__t")
+    if tag is None:
+        return {k: _dec(v) for k, v in obj.items()}
+    if tag == "f":
+        return float(obj["v"])
+    if tag == "dir":
+        return StudyDirection(obj["v"])
+    if tag == "st":
+        return TrialState(obj["v"])
+    if tag == "dt":
+        return datetime.datetime.fromisoformat(obj["v"])
+    if tag == "dist":
+        return json_to_distribution(obj["v"])
+    if tag == "tuple":
+        return tuple(_dec(x) for x in obj["items"])
+    if tag == "set":
+        return set(_dec(x) for x in obj["items"])
+    if tag == "map":
+        return {_dec(k): _dec(v) for k, v in obj["items"]}
+    if tag == "trial":
+        values = _dec(obj["values"])
+        return FrozenTrial(
+            number=obj["number"],
+            state=TrialState(obj["state"]),
+            value=None,
+            values=values,
+            datetime_start=_dec(obj["start"]),
+            datetime_complete=_dec(obj["complete"]),
+            params=_dec(obj["params"]),
+            distributions={k: json_to_distribution(d) for k, d in obj["dists"].items()},
+            user_attrs=_dec(obj["user"]),
+            system_attrs=_dec(obj["system"]),
+            intermediate_values={int(k): _dec(v) for k, v in obj["intermediate"]},
+            trial_id=obj["id"],
+        )
+    if tag == "study":
+        return FrozenStudy(
+            study_name=obj["name"],
+            direction=None,
+            directions=[StudyDirection(d) for d in obj["directions"]],
+            user_attrs=_dec(obj["user"]),
+            system_attrs=_dec(obj["system"]),
+            study_id=obj["id"],
+        )
+    if tag == "err":
+        cls = _ERROR_TYPES.get(obj["cls"], RuntimeError)
+        return cls(obj["msg"])
+    raise WireVersionError(f"Unknown wire tag {tag!r}.")
+
+
+def encode_request(method: str, args: tuple, kwargs: dict) -> bytes:
+    return json.dumps(
+        {"v": WIRE_VERSION, "m": method, "a": _enc(list(args)), "k": _enc(kwargs)},
+        separators=(",", ":"),
+    ).encode()
+
+
+def decode_request(data: bytes) -> tuple[str, list, dict]:
+    msg = json.loads(data)
+    if not isinstance(msg, dict) or msg.get("v") != WIRE_VERSION:
+        raise WireVersionError(
+            f"Unsupported request wire version {msg.get('v') if isinstance(msg, dict) else '?'}"
+            f" (server speaks v{WIRE_VERSION})."
+        )
+    return msg["m"], _dec(msg["a"]), _dec(msg["k"])
+
+
+def encode_response(ok: bool, payload: Any) -> bytes:
+    if not ok:
+        payload = {"__t": "err", "cls": type(payload).__name__, "msg": str(payload)}
+        body = payload
+    else:
+        body = _enc(payload)
+    return json.dumps(
+        {"v": WIRE_VERSION, "ok": ok, "p": body}, separators=(",", ":")
+    ).encode()
+
+
+def decode_response(data: bytes) -> tuple[bool, Any]:
+    msg = json.loads(data)
+    if not isinstance(msg, dict) or msg.get("v") != WIRE_VERSION:
+        raise WireVersionError(
+            f"Unsupported response wire version"
+            f" {msg.get('v') if isinstance(msg, dict) else '?'}"
+            f" (client speaks v{WIRE_VERSION})."
+        )
+    return msg["ok"], _dec(msg["p"])

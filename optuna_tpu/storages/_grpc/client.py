@@ -10,7 +10,11 @@ from typing import Any, Callable, Container, Sequence
 
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.storages._base import BaseStorage
-from optuna_tpu.storages._grpc._service import SERVICE_NAME, deserialize, serialize
+from optuna_tpu.storages._grpc._service import (
+    SERVICE_NAME,
+    decode_response,
+    encode_request,
+)
 from optuna_tpu.storages._heartbeat import BaseHeartbeat
 from optuna_tpu.study._frozen import FrozenStudy
 from optuna_tpu.study._study_direction import StudyDirection
@@ -46,7 +50,7 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
             request_serializer=None,
             response_deserializer=None,
         )
-        ok, payload = deserialize(rpc(serialize((method, args, kwargs))))
+        ok, payload = decode_response(rpc(encode_request(method, args, kwargs)))
         if not ok:
             raise payload
         return payload

@@ -12,7 +12,13 @@ from typing import TYPE_CHECKING
 
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage
-from optuna_tpu.storages._grpc._service import METHODS, SERVICE_NAME, deserialize, serialize
+from optuna_tpu.storages._grpc._service import (
+    METHODS,
+    SERVICE_NAME,
+    WireVersionError,
+    decode_request,
+    encode_response,
+)
 
 if TYPE_CHECKING:
     import grpc
@@ -31,17 +37,22 @@ def _make_handler(storage: BaseStorage):
     }
 
     def handle(request_bytes: bytes, context) -> bytes:
-        method_name, args, kwargs = deserialize(request_bytes)
+        try:
+            method_name, args, kwargs = decode_request(request_bytes)
+        except WireVersionError as e:
+            return encode_response(False, e)
+        except Exception as e:  # malformed request — reject, never crash
+            return encode_response(False, ValueError(f"Malformed request: {e}"))
         if method_name not in METHODS:
-            return serialize((False, ValueError(f"Unknown method {method_name!r}")))
+            return encode_response(False, ValueError(f"Unknown method {method_name!r}"))
         if method_name in _HEARTBEAT_DEFAULTS and not hasattr(storage, method_name):
             # Backing storage without heartbeat support: behave as disabled.
-            return serialize((True, _HEARTBEAT_DEFAULTS[method_name]))
+            return encode_response(True, _HEARTBEAT_DEFAULTS[method_name])
         try:
             result = getattr(storage, method_name)(*args, **kwargs)
-            return serialize((True, result))
+            return encode_response(True, result)
         except Exception as e:  # noqa: BLE001 — exceptions ride the wire
-            return serialize((False, e))
+            return encode_response(False, e)
 
     class Handler(grpc.GenericRpcHandler):
         def service(self, handler_call_details):

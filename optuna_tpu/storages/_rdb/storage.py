@@ -40,12 +40,28 @@ from optuna_tpu.trial._state import TrialState
 
 _logger = get_logger(__name__)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+# Fresh databases are created directly at the head schema below. Databases
+# written by older versions are carried forward through _MIGRATIONS — one
+# ordered SQL batch per (from_version -> from_version+1) step, the stdlib
+# analogue of the reference's alembic chain
+# (optuna/storages/_rdb/alembic/versions/, storage.py:1021-1039).
+_MIGRATIONS: dict[int, list[str]] = {
+    1: [
+        # v2: study creation timestamps + a covering index for the hot
+        # "trials of study S in state X" scan (claim CAS, get_all_trials).
+        "ALTER TABLE studies ADD COLUMN created_at TEXT",
+        "CREATE INDEX IF NOT EXISTS ix_trials_study_state"
+        " ON trials(study_id, state)",
+    ],
+}
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS studies (
     study_id INTEGER PRIMARY KEY AUTOINCREMENT,
-    study_name TEXT NOT NULL UNIQUE
+    study_name TEXT NOT NULL UNIQUE,
+    created_at TEXT
 );
 CREATE TABLE IF NOT EXISTS study_directions (
     study_id INTEGER NOT NULL REFERENCES studies(study_id) ON DELETE CASCADE,
@@ -74,6 +90,7 @@ CREATE TABLE IF NOT EXISTS trials (
     datetime_complete TEXT
 );
 CREATE INDEX IF NOT EXISTS ix_trials_study_id ON trials(study_id);
+CREATE INDEX IF NOT EXISTS ix_trials_study_state ON trials(study_id, state);
 CREATE TABLE IF NOT EXISTS trial_params (
     trial_id INTEGER NOT NULL REFERENCES trials(trial_id) ON DELETE CASCADE,
     param_name TEXT NOT NULL,
@@ -248,6 +265,46 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
             else:
                 self._con.execute("ROLLBACK")
 
+    # ------------------------------------------------------ schema versioning
+
+    def get_current_version(self) -> str:
+        """The schema version of the backing database (reference
+        ``storage.py:1026`` exposes alembic revisions; here versions are
+        small integers rendered as ``v{N}``)."""
+        row = self._conn().execute("SELECT schema_version FROM version_info").fetchone()
+        return f"v{row[0]}" if row else "v0"
+
+    def get_head_version(self) -> str:
+        return f"v{SCHEMA_VERSION}"
+
+    def get_all_versions(self) -> list[str]:
+        return [f"v{n}" for n in range(1, SCHEMA_VERSION + 1)]
+
+    def upgrade(self) -> None:
+        """Walk the migration chain from the database's version to head.
+
+        Each step applies inside one IMMEDIATE transaction, so a crash
+        mid-step leaves the database at a well-defined version."""
+        while True:
+            row = self._conn().execute(
+                "SELECT schema_version FROM version_info"
+            ).fetchone()
+            current = row[0] if row else 0
+            if current >= SCHEMA_VERSION:
+                return
+            steps = _MIGRATIONS.get(current)
+            if steps is None:
+                raise RuntimeError(
+                    f"No migration path from schema v{current} to v{SCHEMA_VERSION}."
+                )
+            _logger.info(f"Upgrading RDB schema v{current} -> v{current + 1}.")
+            with self._txn() as con:
+                for sql in steps:
+                    con.execute(sql)
+                con.execute(
+                    "UPDATE version_info SET schema_version = ?", (current + 1,)
+                )
+
     def remove_session(self) -> None:
         con = getattr(self._local, "con", None)
         if con is not None:
@@ -274,7 +331,8 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         try:
             with self._txn() as con:
                 cur = con.execute(
-                    "INSERT INTO studies (study_name) VALUES (?)", (study_name,)
+                    "INSERT INTO studies (study_name, created_at) VALUES (?, ?)",
+                    (study_name, datetime.datetime.now().isoformat()),
                 )
                 study_id = cur.lastrowid
                 con.executemany(

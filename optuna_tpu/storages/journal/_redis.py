@@ -15,7 +15,21 @@ from optuna_tpu.storages.journal._base import BaseJournalBackend
 class JournalRedisBackend(BaseJournalBackend):
     """Journal as a Redis list plus a snapshot key."""
 
-    def __init__(self, url: str, use_cluster: bool = False, prefix: str = "optuna_tpu") -> None:
+    def __init__(
+        self,
+        url: str,
+        use_cluster: bool = False,
+        prefix: str = "optuna_tpu",
+        client: Any | None = None,
+    ) -> None:
+        """``client`` injects a pre-built Redis-compatible client (tests use
+        :class:`optuna_tpu.testing._fake_redis.FakeRedis`); otherwise the
+        ``redis`` package is required."""
+        self._url = url
+        self._prefix = prefix
+        if client is not None:
+            self._redis = client
+            return
         try:
             import redis
         except ImportError as e:  # pragma: no cover - environment-dependent
@@ -23,8 +37,6 @@ class JournalRedisBackend(BaseJournalBackend):
                 "JournalRedisBackend requires the `redis` package; "
                 "install it or use JournalFileBackend."
             ) from e
-        self._url = url
-        self._prefix = prefix
         self._redis = redis.Redis.from_url(url)
 
     def read_logs(self, log_number_from: int) -> list[dict[str, Any]]:

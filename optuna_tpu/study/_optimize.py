@@ -131,12 +131,16 @@ def _execute_one(
         is_heartbeat_enabled,
     )
 
+    from optuna_tpu import _tracing
+
     if is_heartbeat_enabled(study._storage):
         fail_stale_trials(study)
 
-    trial = study.ask()
+    with _tracing.annotate("optuna_tpu.ask"):
+        trial = study.ask()
     with get_heartbeat_thread(trial._trial_id, study._storage):
-        outcome = _call_objective(func, trial)
+        with _tracing.annotate(f"optuna_tpu.trial.{trial.number}"):
+            outcome = _call_objective(func, trial)
 
     # Misbehaving objectives (wrong arity, NaNs, non-floats) downgrade to
     # warnings via _tell_with_warning rather than aborting the whole loop.

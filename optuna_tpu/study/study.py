@@ -191,20 +191,25 @@ class Study:
         gc_after_trial: bool = False,
         show_progress_bar: bool = False,
     ) -> None:
-        """Run the ask -> objective -> tell loop (reference ``study.py:413``)."""
+        """Run the ask -> objective -> tell loop (reference ``study.py:413``).
+
+        Set ``OPTUNA_TPU_TRACE=<logdir>`` to capture a ``jax.profiler``
+        trace of the whole run (see :mod:`optuna_tpu._tracing`)."""
+        from optuna_tpu import _tracing
         from optuna_tpu.study._optimize import _optimize
 
-        _optimize(
-            study=self,
-            func=func,
-            n_trials=n_trials,
-            timeout=timeout,
-            n_jobs=n_jobs,
-            catch=tuple(catch) if isinstance(catch, Iterable) else (catch,),
-            callbacks=callbacks,
-            gc_after_trial=gc_after_trial,
-            show_progress_bar=show_progress_bar,
-        )
+        with _tracing.maybe_trace_from_env():
+            _optimize(
+                study=self,
+                func=func,
+                n_trials=n_trials,
+                timeout=timeout,
+                n_jobs=n_jobs,
+                catch=tuple(catch) if isinstance(catch, Iterable) else (catch,),
+                callbacks=callbacks,
+                gc_after_trial=gc_after_trial,
+                show_progress_bar=show_progress_bar,
+            )
 
     def ask(self, fixed_distributions: dict[str, BaseDistribution] | None = None) -> Trial:
         """Create a new (or claim a WAITING) trial (reference ``study.py:527``)."""

@@ -1,0 +1,165 @@
+"""IPOP/BIPOP restarts, CMA-with-margin, and lr adaptation.
+
+Covers the three CmaEsSampler options the reference activates through its
+cmaes package (``optuna/samplers/_cmaes.py:507-589``): restart scheduling
+with popsize growth, the discrete-dim margin correction, and LRA-style
+learning-rate adaptation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu.ops import cmaes as cma_ops
+from optuna_tpu.samplers import CmaEsSampler
+
+
+def _rastrigin(trial, dim=4):
+    xs = np.array([trial.suggest_float(f"x{i}", -5.12, 5.12) for i in range(dim)])
+    return float(10 * dim + np.sum(xs * xs - 10 * np.cos(2 * np.pi * xs)))
+
+
+# ------------------------------------------------------------------ restarts
+
+
+def test_should_stop_tolfun_on_flat_fitness():
+    state = cma_ops.cma_init(np.full(3, 0.5), 0.3, popsize=6)
+    flat = np.zeros(6)
+    hist = np.zeros(12)
+    assert cma_ops.should_stop(state, flat, hist, 0.3) == "tolfun"
+
+
+def test_should_stop_tolx_on_collapsed_sigma():
+    state = cma_ops.cma_init(np.full(3, 0.5), 0.3, popsize=6)
+    state = state._replace(sigma=state.sigma * 0.0 + 1e-20)
+    assert (
+        cma_ops.should_stop(state, np.arange(6.0), np.arange(5.0), 0.3) == "tolx"
+    )
+
+
+def test_should_stop_none_on_healthy_state():
+    state = cma_ops.cma_init(np.full(3, 0.5), 0.3, popsize=6)
+    assert cma_ops.should_stop(state, np.arange(6.0), np.arange(5.0), 0.3) is None
+
+
+def test_ipop_restart_doubles_popsize():
+    sampler = CmaEsSampler(
+        seed=1, popsize=4, restart_strategy="ipop", inc_popsize=2,
+        warn_independent_sampling=False,
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    # A constant objective trips tolfun once 10 generations of history are
+    # flat: 4/gen * ~12 gens = ~50 trials.
+    study.optimize(lambda t: (t.suggest_float("a", 0, 1), t.suggest_float("b", 0, 1))
+                   and 7.0, n_trials=60)
+    state, extra = sampler._restore_state(study)
+    assert int(np.asarray(extra["n_restarts"])) >= 1
+    assert int(np.asarray(extra["popsize"])) == 8  # 4 * inc_popsize
+    assert int(np.asarray(extra["run"])) >= 1
+
+
+def test_bipop_restart_schedules_both_regimes():
+    sampler = CmaEsSampler(
+        seed=2, popsize=4, restart_strategy="bipop", inc_popsize=2,
+        warn_independent_sampling=False,
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(lambda t: (t.suggest_float("a", 0, 1), t.suggest_float("b", 0, 1))
+                   and 3.0, n_trials=280)
+    state, extra = sampler._restore_state(study)
+    n_restarts = int(np.asarray(extra["n_restarts"]))
+    assert n_restarts >= 2
+    # After >= 2 restarts at least one large regime must have been opened
+    # and budgets attributed.
+    assert int(np.asarray(extra["n_large"])) >= 1
+    assert int(np.asarray(extra["budget_large"])) + int(
+        np.asarray(extra["budget_small"])
+    ) > 0
+
+
+def test_restart_still_optimizes():
+    sampler = CmaEsSampler(
+        seed=3, popsize=6, restart_strategy="ipop", warn_independent_sampling=False
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(lambda t: _rastrigin(t, dim=3), n_trials=90)
+    assert study.best_value < 30.0
+
+
+# -------------------------------------------------------------------- margin
+
+
+def test_apply_margin_inflates_discrete_variance():
+    state = cma_ops.cma_init(np.array([0.52, 0.5]), 0.3, popsize=6)
+    # Collapse the first (discrete) dim's variance far below its cell width.
+    C = np.asarray(state.C).copy()
+    C[0, 0] = 1e-12
+    state = state._replace(C=cma_ops.jnp.asarray(C, dtype=cma_ops.jnp.float32))
+    steps = np.array([0.25, 0.0])
+    out = cma_ops.apply_margin(state, steps, alpha=0.05)
+    sd0 = float(np.asarray(out.sigma)) * np.sqrt(float(np.asarray(out.C)[0, 0]))
+    # The per-dim std must now reach the cell edge at the alpha/2 quantile.
+    from scipy.stats import norm
+
+    z = norm.ppf(1 - 0.05 / 2)
+    cell_hi = 0.75  # mean 0.52 lives in [0.5, 0.75)
+    assert sd0 * z >= (cell_hi - 0.52) - 1e-9
+    # Continuous dim untouched.
+    assert np.asarray(out.C)[1, 1] == pytest.approx(np.asarray(state.C)[1, 1])
+
+
+def test_apply_margin_noop_when_variance_sufficient():
+    state = cma_ops.cma_init(np.array([0.5, 0.5]), 0.3, popsize=6)
+    out = cma_ops.apply_margin(state, np.array([0.25, 0.0]), alpha=0.05)
+    np.testing.assert_allclose(np.asarray(out.C), np.asarray(state.C))
+
+
+def test_with_margin_keeps_int_dims_alive():
+    def objective(trial):
+        k = trial.suggest_int("k", 0, 10)
+        j = trial.suggest_int("j", 0, 10)
+        return float((k - 3) ** 2 + (j - 7) ** 2)
+
+    sampler = CmaEsSampler(
+        seed=4, popsize=6, with_margin=True, warn_independent_sampling=False
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(objective, n_trials=80)
+    assert study.best_value <= 2.0
+    # Margin keeps exploration alive: the tail of the run still tries more
+    # than one distinct (k, j) cell.
+    tail = {(t.params["k"], t.params["j"]) for t in study.trials[-18:]}
+    assert len(tail) > 1
+
+
+# ------------------------------------------------------------------ lr_adapt
+
+
+def test_lr_adapt_reduces_eta_under_noise():
+    rng = np.random.RandomState(0)
+    state = cma_ops.cma_init(np.full(4, 0.5), 0.3, popsize=8)
+    for g in range(25):
+        X = np.clip(rng.normal(0.5, 0.3, size=(8, 4)), 0, 1).astype(np.float32)
+        fitness = rng.normal(size=8).astype(np.float32)  # pure noise
+        state = cma_ops.cma_tell(state, X, fitness, lr_adapt=True)
+    assert float(np.asarray(state.eta_m)) < 1.0
+    assert float(np.asarray(state.eta_c)) < 1.0
+
+
+def test_lr_adapt_off_keeps_eta_fixed():
+    rng = np.random.RandomState(0)
+    state = cma_ops.cma_init(np.full(4, 0.5), 0.3, popsize=8)
+    X = np.clip(rng.normal(0.5, 0.3, size=(8, 4)), 0, 1).astype(np.float32)
+    state = cma_ops.cma_tell(state, X, np.arange(8.0, dtype=np.float32))
+    assert float(np.asarray(state.eta_m)) == 1.0
+
+
+def test_lr_adapt_end_to_end_still_optimizes():
+    sampler = CmaEsSampler(
+        seed=5, popsize=8, lr_adapt=True, warn_independent_sampling=False
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(lambda t: _rastrigin(t, dim=3), n_trials=80)
+    assert study.best_value < 40.0

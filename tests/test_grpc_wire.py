@@ -1,0 +1,184 @@
+"""Versioned JSON wire codec for the gRPC storage proxy.
+
+Security/compat properties the codec must hold: no pickle anywhere on the
+path, unknown wire versions rejected by both peers, exceptions
+re-materialized only from the explicit whitelist, and a lossless round-trip
+for every rich storage type (trials, studies, distributions, NaN/Inf,
+datetimes, int-keyed maps).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import math
+
+import numpy as np
+import pytest
+
+from optuna_tpu.distributions import (
+    CategoricalDistribution,
+    FloatDistribution,
+    IntDistribution,
+)
+from optuna_tpu.exceptions import DuplicatedStudyError
+from optuna_tpu.storages._grpc import _service as wire
+from optuna_tpu.study._frozen import FrozenStudy
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+
+def _round_trip(value):
+    ok, decoded = wire.decode_response(wire.encode_response(True, value))
+    assert ok
+    return decoded
+
+
+def test_primitives_round_trip():
+    assert _round_trip(None) is None
+    assert _round_trip(42) == 42
+    assert _round_trip("name") == "name"
+    assert _round_trip(True) is True
+    assert _round_trip(3.25) == 3.25
+    assert _round_trip([1, "a", None]) == [1, "a", None]
+    assert _round_trip((1, 2)) == (1, 2)
+    assert _round_trip({"k": [1, {"n": 2}]}) == {"k": [1, {"n": 2}]}
+
+
+def test_nonfinite_floats_round_trip():
+    assert math.isnan(_round_trip(float("nan")))
+    assert _round_trip(float("inf")) == float("inf")
+    assert _round_trip(float("-inf")) == float("-inf")
+
+
+def test_enums_datetimes_and_intkey_maps():
+    assert _round_trip(StudyDirection.MAXIMIZE) is StudyDirection.MAXIMIZE
+    assert _round_trip(TrialState.PRUNED) is TrialState.PRUNED
+    now = datetime.datetime(2026, 7, 29, 12, 0, 1, 5)
+    assert _round_trip(now) == now
+    assert _round_trip({0: 1.5, 7: 2.5}) == {0: 1.5, 7: 2.5}
+
+
+def test_distributions_round_trip():
+    for dist in (
+        FloatDistribution(0.0, 1.0),
+        FloatDistribution(1e-4, 10.0, log=True),
+        FloatDistribution(0.0, 1.0, step=0.25),
+        IntDistribution(1, 64, log=True),
+        CategoricalDistribution(("a", 1, None)),
+    ):
+        assert _round_trip(dist) == dist
+
+
+def test_frozen_trial_round_trip():
+    trial = FrozenTrial(
+        number=3,
+        state=TrialState.COMPLETE,
+        value=None,
+        values=[1.0, -2.0],
+        datetime_start=datetime.datetime(2026, 1, 1),
+        datetime_complete=datetime.datetime(2026, 1, 2),
+        params={"x": 0.5, "c": "b"},
+        distributions={
+            "x": FloatDistribution(0, 1),
+            "c": CategoricalDistribution(("a", "b")),
+        },
+        user_attrs={"note": [1, 2]},
+        system_attrs={"constraints": (0.1,)},
+        intermediate_values={0: 1.0, 3: float("nan")},
+        trial_id=17,
+    )
+    got = _round_trip(trial)
+    assert got.number == 3 and got._trial_id == 17
+    assert got.values == [1.0, -2.0]
+    assert got.params == trial.params
+    assert got.distributions == trial.distributions
+    assert got.user_attrs == {"note": [1, 2]}
+    assert math.isnan(got.intermediate_values[3])
+
+
+def test_frozen_study_round_trip():
+    study = FrozenStudy(
+        study_name="s",
+        direction=None,
+        directions=[StudyDirection.MINIMIZE, StudyDirection.MAXIMIZE],
+        user_attrs={"a": 1},
+        system_attrs={},
+        study_id=9,
+    )
+    got = _round_trip(study)
+    assert got.study_name == "s" and got._study_id == 9
+    assert got.directions == study.directions
+
+
+def test_unknown_request_version_rejected():
+    bad = json.dumps({"v": 999, "m": "get_trial", "a": [1], "k": {}}).encode()
+    with pytest.raises(wire.WireVersionError):
+        wire.decode_request(bad)
+
+
+def test_unknown_response_version_rejected():
+    bad = json.dumps({"v": 0, "ok": True, "p": 1}).encode()
+    with pytest.raises(wire.WireVersionError):
+        wire.decode_response(bad)
+
+
+def test_error_whitelist_limits_exception_types():
+    ok, err = wire.decode_response(
+        wire.encode_response(False, DuplicatedStudyError("dup"))
+    )
+    assert not ok and isinstance(err, DuplicatedStudyError)
+    ok, err = wire.decode_response(wire.encode_response(False, KeyError("missing")))
+    assert not ok and isinstance(err, KeyError)
+
+    # A non-whitelisted class degrades to RuntimeError instead of a lookup.
+    class Evil(Exception):
+        pass
+
+    ok, err = wire.decode_response(wire.encode_response(False, Evil("payload")))
+    assert not ok
+    assert type(err) is RuntimeError
+    assert "payload" in str(err)
+
+
+def test_forged_error_tag_cannot_name_arbitrary_class():
+    forged = json.dumps(
+        {"v": 1, "ok": False, "p": {"__t": "err", "cls": "SystemExit", "msg": "x"}}
+    ).encode()
+    ok, err = wire.decode_response(forged)
+    assert not ok and type(err) is RuntimeError
+
+
+def test_unencodable_object_raises_server_side():
+    with pytest.raises(TypeError):
+        wire.encode_request("set_trial_user_attr", (1, "k", object()), {})
+
+
+def test_no_pickle_in_grpc_package():
+    import pathlib
+
+    pkg = pathlib.Path(wire.__file__).parent
+    for f in pkg.glob("*.py"):
+        src = f.read_text()
+        assert "pickle.loads" not in src and "pickle.dumps" not in src, f.name
+
+
+def test_server_rejects_versioned_garbage_without_crashing():
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc.server import _make_handler
+
+    handler = _make_handler(InMemoryStorage())
+    # Reach the inner handle() through the generic handler machinery.
+    import types
+
+    details = types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/get_trial")
+    method_handler = handler.service(details)
+    resp = method_handler.unary_unary(b"not json at all", None)
+    ok, err = wire.decode_response(resp)
+    assert not ok and isinstance(err, (ValueError, RuntimeError))
+    resp = method_handler.unary_unary(
+        json.dumps({"v": 5, "m": "get_trial", "a": [], "k": {}}).encode(), None
+    )
+    ok, err = wire.decode_response(resp)
+    assert not ok and "version" in str(err)

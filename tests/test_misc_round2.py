@@ -1,0 +1,97 @@
+"""Round-2 odds and ends: tracing hooks, dense discrete line search,
+study-names CLI command."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import _tracing
+from optuna_tpu.samplers import GPSampler
+
+
+def test_trace_context_writes_profile(tmp_path):
+    logdir = str(tmp_path / "prof")
+    with _tracing.trace(logdir):
+        assert _tracing.is_tracing()
+        study = optuna_tpu.create_study()
+        study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=3)
+    assert not _tracing.is_tracing()
+    # jax writes a plugins/profile/<run>/ tree with at least one event file.
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(logdir) for f in fs
+    ]
+    assert found, "profiler trace produced no files"
+
+
+def test_env_var_traces_optimize(tmp_path, monkeypatch):
+    logdir = str(tmp_path / "envprof")
+    monkeypatch.setenv("OPTUNA_TPU_TRACE", logdir)
+    study = optuna_tpu.create_study()
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=2)
+    assert os.path.isdir(logdir)
+
+
+def test_annotate_is_noop_without_trace():
+    with _tracing.annotate("nothing"):
+        pass  # must not require an active profiler
+
+
+def test_gp_sweeps_high_cardinality_int():
+    """A 200-choice int dim must be searched on a dense subgrid (the Brent
+    replacement), not merely snapped after continuous ascent."""
+    from optuna_tpu.gp.optim_mixed import _sweep_tables
+    from optuna_tpu.gp.search_space import SearchSpace
+
+    space = SearchSpace(
+        {
+            "k": optuna_tpu.distributions.IntDistribution(0, 199),
+            "x": optuna_tpu.distributions.FloatDistribution(0.0, 1.0),
+        }
+    )
+    tables = _sweep_tables(space)
+    assert tables is not None
+    onehot, grid, valid = tables
+    assert onehot.shape[0] == 1  # only the int dim is swept
+    n_points = int(valid[0].sum())
+    assert 32 < n_points <= 64
+    # Every swept point must sit on a real grid center.
+    step = space.steps[0]
+    k = grid[0][valid[0]] / step - 0.5
+    np.testing.assert_allclose(k, np.round(k), atol=1e-9)
+
+
+def test_gp_optimizes_high_cardinality_int_study():
+    def objective(trial):
+        k = trial.suggest_int("k", 0, 199)
+        x = trial.suggest_float("x", 0.0, 1.0)
+        return (k - 120) ** 2 / 1e4 + (x - 0.5) ** 2
+
+    study = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=5))
+    study.optimize(objective, n_trials=20)
+    assert study.best_value < 1.0
+    assert all(isinstance(t.params["k"], int) for t in study.trials)
+
+
+def test_cli_study_names(tmp_path):
+    db = f"sqlite:///{tmp_path / 'cli.db'}"
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    for name in ("s-one", "s-two"):
+        subprocess.run(
+            [sys.executable, "-m", "optuna_tpu.cli", "create-study",
+             "--storage", db, "--study-name", name],
+            check=True, capture_output=True, env=env, timeout=120,
+        )
+    out = subprocess.run(
+        [sys.executable, "-m", "optuna_tpu.cli", "study-names",
+         "--storage", db, "-f", "json"],
+        check=True, capture_output=True, text=True, env=env, timeout=120,
+    )
+    names = {row["name"] for row in json.loads(out.stdout)}
+    assert names == {"s-one", "s-two"}

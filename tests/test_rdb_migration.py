@@ -1,0 +1,119 @@
+"""RDB schema-version chain: v1 fixture -> head, version APIs, dialects.
+
+The committed ``tests/fixtures/rdb_v1.db`` was produced by the round-1 (v1)
+schema — ``studies`` without ``created_at``, no ``ix_trials_study_state``
+index — and already contains a study with two completed trials, so the
+upgrade has real rows to carry forward (the reference walks alembic
+revisions the same way, ``optuna/storages/_rdb/storage.py:1021-1039``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sqlite3
+import subprocess
+import sys
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu.storages._rdb.storage import SCHEMA_VERSION, RDBStorage
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "rdb_v1.db")
+
+
+@pytest.fixture
+def v1_db(tmp_path):
+    path = str(tmp_path / "legacy.db")
+    shutil.copy(FIXTURE, path)
+    return path
+
+
+def test_head_version_is_two():
+    assert SCHEMA_VERSION == 2
+
+
+def test_opening_v1_db_demands_upgrade(v1_db):
+    with pytest.raises(RuntimeError, match="storage upgrade"):
+        RDBStorage(f"sqlite:///{v1_db}")
+
+
+def test_upgrade_walks_v1_to_head(v1_db):
+    storage = RDBStorage(f"sqlite:///{v1_db}", skip_compatibility_check=True)
+    assert storage.get_current_version() == "v1"
+    assert storage.get_head_version() == f"v{SCHEMA_VERSION}"
+    assert storage.get_all_versions() == [f"v{n}" for n in range(1, SCHEMA_VERSION + 1)]
+    storage.upgrade()
+    assert storage.get_current_version() == storage.get_head_version()
+    # The new column and index exist.
+    con = sqlite3.connect(v1_db)
+    cols = {r[1] for r in con.execute("PRAGMA table_info(studies)")}
+    assert "created_at" in cols
+    indexes = {r[1] for r in con.execute("PRAGMA index_list(trials)")}
+    assert "ix_trials_study_state" in indexes
+    con.close()
+
+
+def test_upgraded_db_preserves_legacy_data(v1_db):
+    storage = RDBStorage(f"sqlite:///{v1_db}", skip_compatibility_check=True)
+    storage.upgrade()
+    study = optuna_tpu.load_study(study_name="legacy-study", storage=storage)
+    assert len(study.trials) == 2
+    assert study.best_value == 0.0625
+    assert study.trials[0].params == {"x": 0.25}
+    # And the upgraded database accepts new work.
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=3)
+    assert len(study.trials) == 5
+
+
+def test_upgrade_is_idempotent(v1_db):
+    storage = RDBStorage(f"sqlite:///{v1_db}", skip_compatibility_check=True)
+    storage.upgrade()
+    storage.upgrade()  # no-op
+    assert storage.get_current_version() == storage.get_head_version()
+
+
+def test_fresh_db_is_created_at_head(tmp_path):
+    storage = RDBStorage(f"sqlite:///{tmp_path / 'new.db'}")
+    assert storage.get_current_version() == storage.get_head_version()
+    sid = storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    con = storage._conn()
+    row = con.execute(
+        "SELECT created_at FROM studies WHERE study_id = ?", (sid,)
+    ).fetchone()
+    assert row[0]  # creation timestamp recorded
+
+
+def test_future_schema_version_refused(tmp_path):
+    path = str(tmp_path / "future.db")
+    RDBStorage(f"sqlite:///{path}")
+    con = sqlite3.connect(path)
+    con.execute("UPDATE version_info SET schema_version = 99")
+    con.commit()
+    con.close()
+    with pytest.raises(RuntimeError):
+        RDBStorage(f"sqlite:///{path}")
+    # ... and there is no downgrade path.
+    s = RDBStorage(f"sqlite:///{path}", skip_compatibility_check=True)
+    s.upgrade()  # already past head: upgrade must not touch it
+    assert s.get_current_version() == "v99"
+
+
+def test_cli_storage_upgrade_command(v1_db):
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "optuna_tpu.cli", "storage-upgrade",
+         "--storage", f"sqlite:///{v1_db}"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "v1 -> v2" in out.stdout or "Upgraded" in out.stdout
+    assert RDBStorage(f"sqlite:///{v1_db}").get_current_version() == "v2"
+
+
+@pytest.mark.parametrize("url", ["mysql://u:p@h/db", "postgresql://u:p@h/db",
+                                 "mysql+pymysql://u:p@h/db"])
+def test_server_dialect_urls_rejected_with_guidance(url):
+    with pytest.raises(ValueError, match="JournalStorage|gRPC"):
+        RDBStorage(url)

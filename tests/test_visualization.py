@@ -1,0 +1,295 @@
+"""Figure-level tests for the plotly-schema visualization backend.
+
+Each test asserts on the *data series content* of the figure dict (trace
+x/y values, axis types, tick mappings, contour grids) — not merely that
+something renders — per the reference's own visualization test style
+(``tests/visualization_tests/``). plotly being absent from the image is
+fine: the figures are plain dicts in plotly's schema.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import visualization as vis
+from optuna_tpu.samplers import RandomSampler, TPESampler
+
+
+def _fig_dict(fig):
+    return fig if isinstance(fig, dict) else fig.to_dict()
+
+
+@pytest.fixture(scope="module")
+def study():
+    s = optuna_tpu.create_study(study_name="viz", sampler=RandomSampler(seed=0))
+
+    def objective(trial):
+        x = trial.suggest_float("x", -3.0, 3.0)
+        lr = trial.suggest_float("lr", 1e-5, 1e-1, log=True)
+        c = trial.suggest_categorical("c", ["adam", "sgd"])
+        for step in range(3):
+            trial.report(x * x + step, step)
+        return x * x + (0.5 if c == "sgd" else 0.0) + math.log10(lr) * 0.01
+
+    s.optimize(objective, n_trials=30)
+    return s
+
+
+@pytest.fixture(scope="module")
+def mo_study():
+    s = optuna_tpu.create_study(
+        directions=["minimize", "minimize"], sampler=RandomSampler(seed=1)
+    )
+    # y = (1-a)(1+b): for any a, b > 0 is dominated by the same a at b = 0,
+    # so the study has both front and dominated points.
+    s.optimize(
+        lambda t: (
+            t.suggest_float("a", 0, 1),
+            (1 - t.params["a"]) * (1 + t.suggest_float("b", 0, 1)),
+        ),
+        n_trials=25,
+    )
+    return s
+
+
+# ------------------------------------------------------------------- history
+
+
+def test_optimization_history_traces(study):
+    fig = _fig_dict(vis.plot_optimization_history(study))
+    markers = [t for t in fig["data"] if t["mode"] == "markers"]
+    lines = [t for t in fig["data"] if t["mode"] == "lines"]
+    assert len(markers) == 1 and len(lines) == 1
+    assert markers[0]["x"] == [t.number for t in study.trials]
+    assert markers[0]["y"] == [t.value for t in study.trials]
+    # Best-value line is the running minimum.
+    np.testing.assert_allclose(
+        lines[0]["y"], np.minimum.accumulate([t.value for t in study.trials])
+    )
+    assert fig["layout"]["xaxis"]["title"]["text"] == "Trial"
+
+
+def test_optimization_history_target_suppresses_best_line(study):
+    fig = _fig_dict(
+        vis.plot_optimization_history(study, target=lambda t: t.params["x"], target_name="x")
+    )
+    assert all(t["mode"] != "lines" for t in fig["data"])
+    assert fig["layout"]["yaxis"]["title"]["text"] == "x"
+
+
+def test_optimization_history_error_bar_aggregates():
+    studies = []
+    for seed in (0, 1, 2):
+        s = optuna_tpu.create_study(study_name=f"eb{seed}", sampler=RandomSampler(seed=seed))
+        s.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=10)
+        studies.append(s)
+    fig = _fig_dict(vis.plot_optimization_history(studies, error_bar=True))
+    markers = [t for t in fig["data"] if t["mode"] == "markers"]
+    assert len(markers) == 1
+    assert "error_y" in markers[0]
+    assert len(markers[0]["error_y"]["array"]) == 10
+    expected_mean = np.mean(
+        [[t.value for t in s.trials] for s in studies], axis=0
+    )
+    np.testing.assert_allclose(markers[0]["y"], expected_mean)
+
+
+def test_intermediate_values_series(study):
+    fig = _fig_dict(vis.plot_intermediate_values(study))
+    assert len(fig["data"]) == 30
+    t0 = study.trials[0]
+    s0 = next(tr for tr in fig["data"] if tr["name"] == "Trial0")
+    assert s0["x"] == [0, 1, 2]
+    assert s0["y"] == [t0.params["x"] ** 2 + k for k in range(3)]
+
+
+def test_edf_shared_grid():
+    s1 = optuna_tpu.create_study(study_name="e1", sampler=RandomSampler(seed=0))
+    s1.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=12)
+    s2 = optuna_tpu.create_study(study_name="e2", sampler=RandomSampler(seed=5))
+    s2.optimize(lambda t: 2 * t.suggest_float("x", 0, 1), n_trials=12)
+    fig = _fig_dict(vis.plot_edf([s1, s2]))
+    assert [t["name"] for t in fig["data"]] == ["e1", "e2"]
+    # Shared x-grid spanning the union of both value ranges.
+    assert fig["data"][0]["x"] == fig["data"][1]["x"]
+    ys = np.asarray(fig["data"][0]["y"])
+    assert np.all(np.diff(ys) >= 0) and ys[-1] == 1.0
+
+
+# ---------------------------------------------------------------- param plots
+
+
+def test_slice_subplots_and_log_axis(study):
+    fig = _fig_dict(vis.plot_slice(study))
+    names = [t["name"] for t in fig["data"]]
+    # Default param order = intersection space order (alphabetical).
+    assert names == ["c", "lr", "x"]
+    lr_trace = fig["data"][1]
+    assert fig["layout"]["xaxis2"]["type"] == "log"
+    assert lr_trace["y"] == [t.value for t in study.trials]
+    # Categorical param serialized as labels.
+    assert set(fig["data"][0]["x"]) <= {"adam", "sgd"}
+
+
+def test_slice_param_subset(study):
+    fig = _fig_dict(vis.plot_slice(study, params=["x"]))
+    assert len(fig["data"]) == 1
+    assert fig["data"][0]["x"] == [t.params["x"] for t in study.trials]
+
+
+def test_contour_two_params_grid(study):
+    fig = _fig_dict(vis.plot_contour(study, params=["x", "lr"]))
+    contours = [t for t in fig["data"] if t["type"] == "contour"]
+    scatters = [t for t in fig["data"] if t["type"] == "scatter"]
+    assert len(contours) == 1 and len(scatters) == 1
+    z = np.asarray(
+        [[np.nan if v is None else v for v in row] for row in contours[0]["z"]],
+        dtype=np.float64,
+    )
+    assert z.shape == (100, 100)
+    # Interpolated surface must span the observed objective range (within
+    # interpolation, no extrapolation beyond data values).
+    vals = [t.value for t in study.trials]
+    assert np.nanmin(z) >= min(vals) - 1e-6
+    assert np.nanmax(z) <= max(vals) + 1e-6
+    # y axis is the log param, mapped to log10 with a labeled axis.
+    assert "log10(lr)" in fig["layout"]["yaxis"]["title"]["text"]
+    assert len(scatters[0]["x"]) == 30
+
+
+def test_contour_categorical_axis(study):
+    fig = _fig_dict(vis.plot_contour(study, params=["x", "c"]))
+    yaxis = fig["layout"]["yaxis"]
+    assert yaxis["ticktext"] == ["adam", "sgd"]
+    assert yaxis["tickvals"] == [0, 1]
+
+
+def test_contour_matrix_for_three_params(study):
+    fig = _fig_dict(vis.plot_contour(study))
+    contours = [t for t in fig["data"] if t["type"] == "contour"]
+    # 3 params -> 3x3 matrix minus the diagonal = 6 cells.
+    assert len(contours) == 6
+
+
+def test_contour_rejects_single_param(study):
+    with pytest.raises(ValueError):
+        vis.plot_contour(study, params=["x", "x"])
+
+
+def test_rank_normalized_colors(study):
+    fig = _fig_dict(vis.plot_rank(study, params=["x"]))
+    colors = fig["data"][0]["marker"]["color"]
+    assert min(colors) == 0.0 and max(colors) == 1.0
+    best_idx = int(np.argmin([t.value for t in study.trials]))
+    assert colors[best_idx] == 0.0  # best trial gets rank 0
+
+
+def test_parallel_coordinate_dimensions(study):
+    fig = _fig_dict(vis.plot_parallel_coordinate(study))
+    dims = fig["data"][0]["dimensions"]
+    assert [d["label"] for d in dims] == ["Objective Value", "c", "lr", "x"]
+    # Categorical dim carries its tick mapping.
+    cdim = dims[1]
+    assert cdim["ticktext"] == ["adam", "sgd"]
+    assert set(cdim["values"]) <= {0.0, 1.0}
+    # Log dim is log10-mapped with power-of-ten ticks.
+    lr_dim = dims[2]
+    assert all(-5 <= v <= -1 for v in lr_dim["values"])
+    assert any(lab.startswith("1e") for lab in lr_dim["ticktext"])
+    # Line color == objective values.
+    assert fig["data"][0]["line"]["color"] == [t.value for t in study.trials]
+
+
+def test_param_importances_bars(study):
+    fig = _fig_dict(vis.plot_param_importances(study))
+    bar = fig["data"][0]
+    assert bar["type"] == "bar" and bar["orientation"] == "h"
+    assert set(bar["y"]) == {"x", "lr", "c"}
+    assert all(v >= 0 for v in bar["x"])
+    assert abs(sum(bar["x"]) - 1.0) < 1e-6
+
+
+# ------------------------------------------------------------ multi-objective
+
+
+def test_pareto_front_splits_best_and_dominated(mo_study):
+    fig = _fig_dict(vis.plot_pareto_front(mo_study))
+    by_name = {t["name"]: t for t in fig["data"]}
+    assert "Best Trial" in by_name and "Trial" in by_name
+    n_total = len(by_name["Best Trial"]["x"]) + len(by_name["Trial"]["x"])
+    assert n_total == 25
+    # Points on the front are non-dominated: sorted by x, y must decrease.
+    xs = np.asarray(by_name["Best Trial"]["x"])
+    ys = np.asarray(by_name["Best Trial"]["y"])
+    order = np.argsort(xs)
+    assert np.all(np.diff(ys[order]) <= 1e-12)
+
+
+def test_pareto_front_exclude_dominated(mo_study):
+    fig = _fig_dict(vis.plot_pareto_front(mo_study, include_dominated_trials=False))
+    assert [t["name"] for t in fig["data"]] == ["Best Trial"]
+
+
+def test_pareto_front_constraint_coloring():
+    def cfn(frozen):
+        return (frozen.params["a"] - 0.5,)  # feasible iff a <= 0.5
+
+    s = optuna_tpu.create_study(
+        directions=["minimize", "minimize"],
+        sampler=TPESampler(seed=0, n_startup_trials=5, constraints_func=cfn),
+    )
+    s.optimize(lambda t: (t.suggest_float("a", 0, 1), 1.0), n_trials=12)
+    fig = _fig_dict(vis.plot_pareto_front(s))
+    names = [t["name"] for t in fig["data"]]
+    assert "Infeasible Trial" in names
+    infeasible = next(t for t in fig["data"] if t["name"] == "Infeasible Trial")
+    assert all(x > 0.5 for x in infeasible["x"])
+
+
+def test_hypervolume_history_monotone(mo_study):
+    fig = _fig_dict(vis.plot_hypervolume_history(mo_study, reference_point=[2.0, 2.0]))
+    hv = fig["data"][0]["y"]
+    assert len(hv) == 25
+    assert all(b >= a - 1e-12 for a, b in zip(hv, hv[1:]))
+
+
+# ------------------------------------------------------------ ops/diagnostics
+
+
+def test_timeline_groups_by_state(study):
+    fig = _fig_dict(vis.plot_timeline(study))
+    complete = next(t for t in fig["data"] if t["name"] == "COMPLETE")
+    assert len(complete["y"]) == 30
+    assert all(x >= 0 for x in complete["x"])  # durations in ms
+    assert fig["layout"]["xaxis"]["type"] == "date"
+
+
+def test_terminator_improvement_series(study):
+    fig = _fig_dict(vis.plot_terminator_improvement(study, min_n_trials=10))
+    by_name = {t["name"]: t for t in fig["data"]}
+    assert set(by_name) == {"Improvement", "Error"}
+    assert len(by_name["Improvement"]["x"]) == 30 - 10 + 1
+
+
+def test_figures_jsonable(study, mo_study):
+    """Every figure must be valid JSON — the schema plotly itself speaks."""
+    import json
+
+    figs = [
+        vis.plot_optimization_history(study),
+        vis.plot_slice(study),
+        vis.plot_contour(study, params=["x", "lr"]),
+        vis.plot_rank(study),
+        vis.plot_parallel_coordinate(study),
+        vis.plot_edf(study),
+        vis.plot_pareto_front(mo_study),
+        vis.plot_timeline(study),
+        vis.plot_intermediate_values(study),
+        vis.plot_param_importances(study),
+    ]
+    for fig in figs:
+        json.dumps(_fig_dict(fig))
