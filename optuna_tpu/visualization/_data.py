@@ -156,6 +156,15 @@ class SliceSubplot:
     trial_numbers: list[int]
     is_log: bool
     is_categorical: bool
+    # Categorical display order + per-trial index into it, shared by both
+    # backends so category ordering cannot drift between them.
+    labels: list[str] = field(default_factory=list)
+    x_indices: list[int] = field(default_factory=list)
+
+
+def _categorical_mapping(values: list) -> tuple[list[str], list[int]]:
+    labels = sorted({str(v) for v in values})
+    return labels, [labels.index(str(v)) for v in values]
 
 
 def slice_data(
@@ -166,14 +175,19 @@ def slice_data(
     out = []
     for p in names:
         sub = [t for t in trials if p in t.params]
+        xs = [t.params[p] for t in sub]
+        is_cat = _is_categorical(sub, p)
+        labels, idx = _categorical_mapping(xs) if is_cat else ([], [])
         out.append(
             SliceSubplot(
                 param=p,
-                x=[t.params[p] for t in sub],
+                x=xs,
                 y=[_value_of(t, target) for t in sub],
                 trial_numbers=[t.number for t in sub],
                 is_log=_is_log(sub, p),
-                is_categorical=_is_categorical(sub, p),
+                is_categorical=is_cat,
+                labels=labels,
+                x_indices=idx,
             )
         )
     return out
@@ -366,6 +380,8 @@ class RankSubplot:
     trial_numbers: list[int]
     is_log: bool
     is_categorical: bool
+    labels: list[str] = field(default_factory=list)
+    x_indices: list[int] = field(default_factory=list)
 
 
 def rank_data(
@@ -385,15 +401,20 @@ def rank_data(
     for p in names:
         mask = np.asarray([p in t.params for t in trials])
         sub = [t for t, m in zip(trials, mask) if m]
+        xs = [t.params[p] for t in sub]
+        is_cat = _is_categorical(sub, p)
+        labels, idx = _categorical_mapping(xs) if is_cat else ([], [])
         out.append(
             RankSubplot(
                 param=p,
-                x=[t.params[p] for t in sub],
+                x=xs,
                 y=[float(v) for v in values[mask]],
                 colors=[float(c) for c in norm[mask]],
                 trial_numbers=[t.number for t in sub],
                 is_log=_is_log(sub, p),
-                is_categorical=_is_categorical(sub, p),
+                is_categorical=is_cat,
+                labels=labels,
+                x_indices=idx,
             )
         )
     return out

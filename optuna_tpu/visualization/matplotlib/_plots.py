@@ -1,9 +1,12 @@
 """The 12 study plots, matplotlib edition.
 
-Parity targets: ``optuna/visualization/_*.py`` (plotly) and their matplotlib
-mirrors (~6.5k LoC in the reference). Each function returns the Axes so
-callers can style/save; figures are created with the non-interactive Agg
-backend in headless environments.
+Feature parity targets: the reference's ``optuna/visualization/matplotlib/``
+mirror. Every plot renders from the same backend-neutral builders as the
+plotly-schema backend (:mod:`optuna_tpu.visualization._data`) — contour
+grid interpolation, log and categorical axes, error-bar aggregation,
+constraint-aware Pareto fronts — so the two backends show the same data by
+construction. Each function returns the Axes (or array of Axes) so callers
+can style/save.
 """
 
 from __future__ import annotations
@@ -13,9 +16,9 @@ from typing import TYPE_CHECKING, Callable, Sequence
 import numpy as np
 
 from optuna_tpu.logging import get_logger
-from optuna_tpu.study._multi_objective import _get_pareto_front_trials
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._state import TrialState
+from optuna_tpu.visualization import _data as D
 
 if TYPE_CHECKING:
     from matplotlib.axes import Axes
@@ -34,32 +37,39 @@ def _axes(ax=None) -> "Axes":
     return ax
 
 
-def _complete_trials(study: "Study"):
-    return [t for t in study.get_trials(deepcopy=False) if t.state == TrialState.COMPLETE]
-
-
-def _target_or_value(trial, target: Callable | None):
-    return target(trial) if target is not None else trial.value
+def _studies(study) -> list:
+    return [study] if not isinstance(study, (list, tuple)) else list(study)
 
 
 # ------------------------------------------------------------------- history
 
 
 def plot_optimization_history(
-    study: "Study", *, target: Callable | None = None, target_name: str = "Objective Value", ax=None
+    study: "Study",
+    *,
+    target: Callable | None = None,
+    target_name: str = "Objective Value",
+    error_bar: bool = False,
+    ax=None,
 ) -> "Axes":
     ax = _axes(ax)
-    trials = _complete_trials(study)
-    xs = [t.number for t in trials]
-    ys = [_target_or_value(t, target) for t in trials]
-    ax.scatter(xs, ys, s=12, alpha=0.6, label=target_name)
-    if target is None and not study._is_multi_objective():
-        best = (
-            np.minimum.accumulate(ys)
-            if study.direction == StudyDirection.MINIMIZE
-            else np.maximum.accumulate(ys)
-        )
-        ax.plot(xs, best, color="crimson", label="Best Value")
+    series = D.optimization_history_data(_studies(study), target, target_name, error_bar)
+    multi = len(series) > 1
+    for s in series:
+        # s.stdev marks the aggregated error-bar series (single combined
+        # series); per-study labels only matter for true multi-study plots.
+        label = f"{target_name} ({s.study_name})" if multi else target_name
+        if s.stdev is not None:
+            ax.errorbar(
+                s.trial_numbers, s.values, yerr=s.stdev, fmt="o", ms=3,
+                alpha=0.6, label=label,
+            )
+        else:
+            ax.scatter(s.trial_numbers, s.values, s=12, alpha=0.6, label=label)
+        if s.best_values is not None:
+            best_label = f"Best Value ({s.study_name})" if multi else "Best Value"
+            line_kwargs = {} if multi else {"color": "crimson"}
+            ax.plot(s.trial_numbers, s.best_values, label=best_label, **line_kwargs)
     ax.set_xlabel("Trial")
     ax.set_ylabel(target_name)
     ax.set_title("Optimization History Plot")
@@ -69,10 +79,9 @@ def plot_optimization_history(
 
 def plot_intermediate_values(study: "Study", *, ax=None) -> "Axes":
     ax = _axes(ax)
-    for t in study.get_trials(deepcopy=False):
-        if t.intermediate_values:
-            steps, vals = zip(*sorted(t.intermediate_values.items()))
-            ax.plot(steps, vals, alpha=0.4, label=f"Trial{t.number}")
+    for s in D.intermediate_values_data(study):
+        color = "tab:orange" if s.state == TrialState.PRUNED else None
+        ax.plot(s.steps, s.values, alpha=0.4, color=color, label=f"Trial{s.trial_number}")
     ax.set_xlabel("Step")
     ax.set_ylabel("Intermediate Value")
     ax.set_title("Intermediate Values Plot")
@@ -83,18 +92,12 @@ def plot_edf(
     study: "Study | Sequence[Study]", *, target: Callable | None = None,
     target_name: str = "Objective Value", ax=None
 ) -> "Axes":
-    from optuna_tpu.study.study import Study as _Study
-
     ax = _axes(ax)
-    studies = [study] if isinstance(study, _Study) else list(study)
-    for s in studies:
-        values = np.sort([_target_or_value(t, target) for t in _complete_trials(s)])
-        if len(values) == 0:
-            continue
-        ecdf = np.arange(1, len(values) + 1) / len(values)
-        ax.plot(values, ecdf, drawstyle="steps-post", label=s.study_name)
+    for s in D.edf_data(_studies(study), target):
+        ax.plot(s.x, s.y, drawstyle="steps-post", label=s.study_name)
     ax.set_xlabel(target_name)
     ax.set_ylabel("Cumulative Probability")
+    ax.set_ylim(0, 1)
     ax.set_title("Empirical Distribution Function Plot")
     ax.legend()
     return ax
@@ -103,14 +106,12 @@ def plot_edf(
 # --------------------------------------------------------------- param plots
 
 
-def _param_values(trials, param: str) -> tuple[list, bool]:
-    from optuna_tpu.distributions import CategoricalDistribution
-
-    dist = next(t.distributions[param] for t in trials if param in t.distributions)
-    is_cat = isinstance(dist, CategoricalDistribution)
-    is_log = bool(getattr(dist, "log", False))
-    vals = [t.params[param] for t in trials]
-    return vals, is_log
+def _apply_x_axis(ax: "Axes", is_log: bool, is_categorical: bool, labels: list[str]):
+    if is_log:
+        ax.set_xscale("log")
+    if is_categorical and labels:
+        ax.set_xticks(range(len(labels)))
+        ax.set_xticklabels(labels)
 
 
 def plot_slice(
@@ -119,122 +120,138 @@ def plot_slice(
 ) -> "np.ndarray":
     import matplotlib.pyplot as plt
 
-    trials = _complete_trials(study)
-    if params is None:
-        from optuna_tpu.search_space import intersection_search_space
-
-        params = [k for k, v in intersection_search_space(trials).items() if not v.single()]
-    fig, axes = plt.subplots(1, max(len(params), 1), figsize=(4 * max(len(params), 1), 4))
+    subplots = D.slice_data(study, params, target)
+    n = max(len(subplots), 1)
+    fig, axes = plt.subplots(1, n, figsize=(4 * n, 4), sharey=True)
     axes = np.atleast_1d(axes)
-    for ax, p in zip(axes, params):
-        sub = [t for t in trials if p in t.params]
-        xs, is_log = _param_values(sub, p)
-        ys = [_target_or_value(t, target) for t in sub]
-        ax.scatter(xs, ys, s=12, alpha=0.6, c=[t.number for t in sub], cmap="Blues")
-        if is_log:
-            ax.set_xscale("log")
-        ax.set_xlabel(p)
-        ax.set_ylabel(target_name)
+    sc = None
+    for ax, sp in zip(axes, subplots):
+        xs = sp.x_indices if sp.is_categorical else sp.x
+        sc = ax.scatter(xs, sp.y, s=12, alpha=0.6, c=sp.trial_numbers, cmap="Blues")
+        _apply_x_axis(ax, sp.is_log, sp.is_categorical, sp.labels)
+        ax.set_xlabel(sp.param)
+    axes[0].set_ylabel(target_name)
+    if sc is not None:
+        fig.colorbar(sc, ax=axes[-1], label="Trial")
     fig.suptitle("Slice Plot")
     return axes
 
 
 def plot_contour(
-    study: "Study", params: list[str] | None = None, *, target: Callable | None = None, ax=None
-) -> "Axes":
-    trials = _complete_trials(study)
-    if params is None:
-        from optuna_tpu.search_space import intersection_search_space
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None,
+    target_name: str = "Objective Value", ax=None
+) -> "Axes | np.ndarray":
+    import matplotlib.pyplot as plt
 
-        params = [k for k, v in intersection_search_space(trials).items() if not v.single()][:2]
-    if len(params) != 2:
-        raise ValueError("plot_contour needs exactly two params (got %r)." % (params,))
-    ax = _axes(ax)
-    px, py = params
-    sub = [t for t in trials if px in t.params and py in t.params]
-    xs = np.asarray([float(t.params[px]) for t in sub])
-    ys = np.asarray([float(t.params[py]) for t in sub])
-    zs = np.asarray([_target_or_value(t, target) for t in sub])
-    if len(sub) >= 4:
-        tri = ax.tricontourf(xs, ys, zs, levels=14, cmap="viridis", alpha=0.8)
-        import matplotlib.pyplot as plt
+    matrix = D.contour_data(study, params, target)
+    n = len(matrix)
 
-        plt.colorbar(tri, ax=ax)
-    ax.scatter(xs, ys, c="black", s=10)
-    ax.set_xlabel(px)
-    ax.set_ylabel(py)
-    ax.set_title("Contour Plot")
-    return ax
+    def render(ax: "Axes", pair: D.ContourPair, colorbar: bool) -> None:
+        masked = np.ma.masked_invalid(pair.grid_z)
+        if masked.count():
+            cf = ax.contourf(
+                pair.grid_x, pair.grid_y, masked, levels=14, cmap="Blues_r", alpha=0.9
+            )
+            if colorbar:
+                plt.colorbar(cf, ax=ax, label=target_name)
+        ax.scatter(pair.x_points, pair.y_points, c="black", s=8)
+        ax.set_xlim(*pair.x.range)
+        ax.set_ylim(*pair.y.range)
+        ax.set_xlabel(f"log10({pair.x.param})" if pair.x.is_log else pair.x.param)
+        ax.set_ylabel(f"log10({pair.y.param})" if pair.y.is_log else pair.y.param)
+        if pair.x.is_categorical:
+            ax.set_xticks(range(len(pair.x.labels)))
+            ax.set_xticklabels(pair.x.labels)
+        if pair.y.is_categorical:
+            ax.set_yticks(range(len(pair.y.labels)))
+            ax.set_yticklabels(pair.y.labels)
+
+    if n == 2:
+        ax = _axes(ax)
+        render(ax, matrix[1][0], colorbar=True)
+        ax.set_title("Contour Plot")
+        return ax
+    fig, axes = plt.subplots(n, n, figsize=(3 * n, 3 * n))
+    for r in range(n):
+        for c in range(n):
+            pair = matrix[r][c]
+            if pair is None:
+                axes[r][c].axis("off")
+            else:
+                render(axes[r][c], pair, colorbar=False)
+    fig.suptitle("Contour Plot")
+    return axes
 
 
 def plot_rank(
-    study: "Study", params: list[str] | None = None, *, target: Callable | None = None
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None,
+    target_name: str = "Objective Value",
 ) -> "np.ndarray":
     import matplotlib.pyplot as plt
-    from scipy.stats import rankdata
 
-    trials = _complete_trials(study)
-    if params is None:
-        from optuna_tpu.search_space import intersection_search_space
-
-        params = [k for k, v in intersection_search_space(trials).items() if not v.single()]
-    values = np.asarray([_target_or_value(t, target) for t in trials])
-    ranks = rankdata(values)
-    fig, axes = plt.subplots(1, max(len(params), 1), figsize=(4 * max(len(params), 1), 4))
+    subplots = D.rank_data(study, params, target)
+    n = max(len(subplots), 1)
+    fig, axes = plt.subplots(1, n, figsize=(4 * n, 4), sharey=True)
     axes = np.atleast_1d(axes)
-    for ax, p in zip(axes, params):
-        mask = [p in t.params for t in trials]
-        xs = [t.params[p] for t, m in zip(trials, mask) if m]
-        sc = ax.scatter(xs, ranks[mask], c=ranks[mask], cmap="coolwarm", s=14)
-        ax.set_xlabel(p)
-        ax.set_ylabel("Rank")
-    fig.suptitle("Rank Plot")
+    sc = None
+    for ax, sp in zip(axes, subplots):
+        xs = sp.x_indices if sp.is_categorical else sp.x
+        _apply_x_axis(ax, sp.is_log, sp.is_categorical, sp.labels)
+        sc = ax.scatter(xs, sp.y, c=sp.colors, cmap="coolwarm", vmin=0.0, vmax=1.0, s=14)
+        ax.set_xlabel(sp.param)
+    axes[0].set_ylabel(target_name)
+    if sc is not None:
+        fig.colorbar(sc, ax=axes[-1], label="Rank")
+    fig.suptitle(f"Rank ({target_name})")
     return axes
 
 
 def plot_parallel_coordinate(
-    study: "Study", params: list[str] | None = None, *, target: Callable | None = None, ax=None
+    study: "Study", params: list[str] | None = None, *, target: Callable | None = None,
+    target_name: str = "Objective Value", ax=None
 ) -> "Axes":
-    ax = _axes(ax)
-    trials = _complete_trials(study)
-    if params is None:
-        from optuna_tpu.search_space import intersection_search_space
-
-        params = [k for k, v in intersection_search_space(trials).items() if not v.single()]
-    trials = [t for t in trials if all(p in t.params for p in params)]
-    if not trials:
-        return ax
-    values = np.asarray([_target_or_value(t, target) for t in trials], dtype=float)
-    vmin, vmax = values.min(), values.max()
-    span = vmax - vmin if vmax > vmin else 1.0
     import matplotlib.cm as cm
 
-    # Column 0 = objective, then one column per param, all min-max scaled.
-    columns = [values]
-    for p in params:
-        col = np.asarray([float(_numeric(t, p)) for t in trials])
-        lo, hi = col.min(), col.max()
-        columns.append((col - lo) / (hi - lo if hi > lo else 1.0))
-    columns[0] = (values - vmin) / span
-    mat = np.stack(columns, axis=1)
-    for i in range(len(trials)):
-        ax.plot(range(mat.shape[1]), mat[i], color=cm.viridis(1 - mat[i, 0]), alpha=0.4)
-    ax.set_xticks(range(mat.shape[1]))
-    ax.set_xticklabels(["Objective"] + params, rotation=30)
+    ax = _axes(ax)
+    axes_data, colors = D.parallel_coordinate_data(study, params, target, target_name)
+    if not colors:
+        return ax
+    cmin, cmax = min(colors), max(colors)
+    span = (cmax - cmin) or 1.0
+
+    # Min-max scale every axis into [0, 1] for a shared vertical scale.
+    scaled = []
+    for a in axes_data:
+        lo, hi = a.range
+        width = (hi - lo) or 1.0
+        scaled.append([(v - lo) / width for v in a.values])
+    mat = np.asarray(scaled).T  # (n_trials, n_axes)
+    for i in range(mat.shape[0]):
+        ax.plot(
+            range(mat.shape[1]), mat[i],
+            color=cm.Blues(1.0 - (colors[i] - cmin) / span), alpha=0.4,
+        )
+    ax.set_xticks(range(len(axes_data)))
+    ax.set_xticklabels([a.label for a in axes_data], rotation=30)
+    # Annotate categorical/log tick mappings on their vertical axes, in the
+    # same data coordinates the polylines use (scaled to [0, 1]).
+    ax.set_ylim(0.0, 1.0)
+    for xi, a in enumerate(axes_data):
+        if a.tick_labels:
+            lo, hi = a.range
+            width = (hi - lo) or 1.0
+            for tv, tl in zip(a.tick_values, a.tick_labels):
+                y = (tv - lo) / width
+                if 0.0 <= y <= 1.0:
+                    ax.annotate(tl, (xi, y), fontsize=6, xycoords="data")
+    ax.set_yticks([])
     ax.set_title("Parallel Coordinate Plot")
     return ax
 
 
-def _numeric(trial, p: str) -> float:
-    v = trial.params[p]
-    if isinstance(v, (int, float)):
-        return float(v)
-    return float(trial.distributions[p].to_internal_repr(v))
-
-
 def plot_param_importances(
     study: "Study", *, evaluator=None, params: list[str] | None = None,
-    target: Callable | None = None, ax=None
+    target: Callable | None = None, target_name: str = "Objective Value", ax=None
 ) -> "Axes":
     from optuna_tpu.importance import get_param_importances
 
@@ -243,7 +260,9 @@ def plot_param_importances(
     names = list(importances.keys())[::-1]
     vals = [importances[n] for n in names]
     ax.barh(names, vals, color="steelblue")
-    ax.set_xlabel("Importance")
+    for y, v in enumerate(vals):
+        ax.text(v, y, f" {v:.2f}", va="center", fontsize=8)
+    ax.set_xlabel(f"Importance for {target_name}")
     ax.set_title("Hyperparameter Importances")
     return ax
 
@@ -253,27 +272,44 @@ def plot_param_importances(
 
 def plot_pareto_front(
     study: "Study", *, target_names: list[str] | None = None, ax=None,
-    include_dominated_trials: bool = True,
+    include_dominated_trials: bool = True, targets: Callable | None = None,
 ) -> "Axes":
-    ax = _axes(ax)
-    if len(study.directions) != 2:
-        raise ValueError("plot_pareto_front supports 2-objective studies in this backend.")
-    trials = _complete_trials(study)
-    front = set(t.number for t in _get_pareto_front_trials(study))
-    names = target_names or (study.metric_names or ["Objective 0", "Objective 1"])
-    if include_dominated_trials:
-        dom = [t for t in trials if t.number not in front]
-        ax.scatter(
-            [t.values[0] for t in dom], [t.values[1] for t in dom],
-            s=12, alpha=0.4, label="Trial", color="steelblue",
-        )
-    par = [t for t in trials if t.number in front]
-    ax.scatter(
-        [t.values[0] for t in par], [t.values[1] for t in par],
-        s=22, label="Best Trial", color="crimson",
-    )
-    ax.set_xlabel(names[0])
-    ax.set_ylabel(names[1])
+    pf = D.pareto_front_data(study, target_names, include_dominated_trials, targets)
+    # Plot dimensionality follows the actual value vectors: a `targets`
+    # callable may project an N-objective study down to 2 or 3 axes.
+    all_vals = pf.best_values or pf.other_values or pf.infeasible_values
+    n_axes = len(all_vals[0]) if all_vals else pf.n_objectives
+    if n_axes not in (2, 3):
+        raise ValueError(f"plot_pareto_front renders 2 or 3 axes, got {n_axes}.")
+    if n_axes == 3:
+        import matplotlib.pyplot as plt
+
+        if ax is None:
+            fig = plt.figure()
+            ax = fig.add_subplot(projection="3d")
+
+        def scat3(vals, **kw):
+            if vals:
+                ax.scatter(*np.asarray(vals).T, **kw)
+
+        scat3(pf.infeasible_values, s=8, alpha=0.4, label="Infeasible Trial", color="#cccccc")
+        scat3(pf.other_values, s=12, alpha=0.4, label="Trial", color="steelblue")
+        scat3(pf.best_values, s=22, label="Best Trial", color="crimson")
+        if len(pf.target_names) > 2:
+            ax.set_zlabel(pf.target_names[2])
+    else:
+        ax = _axes(ax)
+
+        def scat(vals, **kw):
+            if vals:
+                arr = np.asarray(vals)
+                ax.scatter(arr[:, 0], arr[:, 1], **kw)
+
+        scat(pf.infeasible_values, s=8, alpha=0.4, label="Infeasible Trial", color="#cccccc")
+        scat(pf.other_values, s=12, alpha=0.4, label="Trial", color="steelblue")
+        scat(pf.best_values, s=22, label="Best Trial", color="crimson")
+    ax.set_xlabel(pf.target_names[0])
+    ax.set_ylabel(pf.target_names[1])
     ax.set_title("Pareto-front Plot")
     ax.legend()
     return ax
@@ -286,7 +322,7 @@ def plot_hypervolume_history(
     from optuna_tpu.study._multi_objective import _normalize_values
 
     ax = _axes(ax)
-    trials = _complete_trials(study)
+    trials = D._completed(study)
     ref = np.asarray(reference_point, dtype=np.float64)
     values = _normalize_values(
         np.asarray([t.values for t in trials], dtype=np.float64), study.directions
@@ -294,10 +330,7 @@ def plot_hypervolume_history(
     signs = np.asarray(
         [-1.0 if d == StudyDirection.MAXIMIZE else 1.0 for d in study.directions]
     )
-    ref_n = ref * signs
-    hv = [
-        compute_hypervolume(values[: i + 1], ref_n) for i in range(len(trials))
-    ]
+    hv = [compute_hypervolume(values[: i + 1], ref * signs) for i in range(len(trials))]
     ax.plot([t.number for t in trials], hv, marker="o", ms=3)
     ax.set_xlabel("Trial")
     ax.set_ylabel("Hypervolume")
@@ -320,12 +353,13 @@ def plot_timeline(study: "Study", *, ax=None) -> "Axes":
         TrialState.RUNNING: "tab:green",
         TrialState.WAITING: "tab:gray",
     }
-    for t in study.get_trials(deepcopy=False):
-        if t.datetime_start is None:
-            continue
-        start = mdates.date2num(t.datetime_start)
-        end = mdates.date2num(t.datetime_complete) if t.datetime_complete else start
-        ax.barh(t.number, max(end - start, 1e-9), left=start, color=colors[t.state], height=0.8)
+    for bar in D.timeline_data(study):
+        start = mdates.date2num(bar.start)
+        end = mdates.date2num(bar.complete)
+        ax.barh(
+            bar.number, max(end - start, 1e-9), left=start,
+            color=colors[bar.state], height=0.8,
+        )
     ax.xaxis_date()
     ax.set_xlabel("Datetime")
     ax.set_ylabel("Trial")
@@ -339,16 +373,12 @@ def plot_terminator_improvement(
     study: "Study", *, improvement_evaluator=None, error_evaluator=None,
     min_n_trials: int = 20, ax=None,
 ) -> "Axes":
-    from optuna_tpu.terminator import (
-        CrossValidationErrorEvaluator,
-        MedianErrorEvaluator,
-        RegretBoundEvaluator,
-    )
+    from optuna_tpu.terminator import MedianErrorEvaluator, RegretBoundEvaluator
 
     ax = _axes(ax)
     improvement_evaluator = improvement_evaluator or RegretBoundEvaluator()
     error_evaluator = error_evaluator or MedianErrorEvaluator()
-    trials = _complete_trials(study)
+    trials = D._completed(study)
     xs, improvements, errors = [], [], []
     for i in range(min_n_trials, len(trials) + 1):
         sub = trials[:i]

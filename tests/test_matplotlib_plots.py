@@ -1,0 +1,166 @@
+"""Matplotlib-mirror plot tests asserting rendered data content.
+
+The mirror renders from the same builders as the plotly-schema backend, so
+these tests check the matplotlib artists carry the right data — collection
+offsets, line vertices, axis scales/ticks — per the reference's matplotlib
+test style."""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu.samplers import RandomSampler, TPESampler
+from optuna_tpu.visualization import matplotlib as mvis
+
+
+@pytest.fixture(scope="module")
+def study():
+    s = optuna_tpu.create_study(study_name="mviz", sampler=RandomSampler(seed=0))
+
+    def objective(trial):
+        x = trial.suggest_float("x", -3.0, 3.0)
+        lr = trial.suggest_float("lr", 1e-5, 1e-1, log=True)
+        c = trial.suggest_categorical("c", ["adam", "sgd"])
+        trial.report(x * x, 0)
+        trial.report(x * x / 2, 1)
+        return x * x + (0.5 if c == "sgd" else 0.0)
+
+    s.optimize(objective, n_trials=25)
+    return s
+
+
+@pytest.fixture(scope="module")
+def mo_study():
+    s = optuna_tpu.create_study(
+        directions=["minimize", "minimize"], sampler=RandomSampler(seed=1)
+    )
+    s.optimize(
+        lambda t: (
+            t.suggest_float("a", 0, 1),
+            (1 - t.params["a"]) * (1 + t.suggest_float("b", 0, 1)),
+        ),
+        n_trials=20,
+    )
+    return s
+
+
+def test_history_scatter_matches_values(study):
+    ax = mvis.plot_optimization_history(study)
+    pts = ax.collections[0].get_offsets()
+    assert len(pts) == 25
+    np.testing.assert_allclose(pts[:, 1], [t.value for t in study.trials])
+    best_line = ax.lines[0]
+    np.testing.assert_allclose(
+        best_line.get_ydata(), np.minimum.accumulate([t.value for t in study.trials])
+    )
+
+
+def test_history_error_bar_mode():
+    studies = []
+    for seed in (0, 1):
+        s = optuna_tpu.create_study(study_name=f"meb{seed}", sampler=RandomSampler(seed=seed))
+        s.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=8)
+        studies.append(s)
+    ax = mvis.plot_optimization_history(studies, error_bar=True)
+    # errorbar() creates caps/segments; the means are on the first line.
+    means = np.asarray(ax.lines[0].get_ydata(), dtype=np.float64)
+    expected = np.mean([[t.value for t in s.trials] for s in studies], axis=0)
+    np.testing.assert_allclose(means, expected)
+
+
+def test_slice_log_axis_and_categorical_ticks(study):
+    axes = mvis.plot_slice(study)
+    by_label = {ax.get_xlabel(): ax for ax in axes}
+    assert set(by_label) == {"x", "lr", "c"}
+    assert by_label["lr"].get_xscale() == "log"
+    tick_labels = [t.get_text() for t in by_label["c"].get_xticklabels()]
+    assert tick_labels == ["adam", "sgd"]
+
+
+def test_contour_pair_has_interpolated_surface(study):
+    ax = mvis.plot_contour(study, params=["x", "lr"])
+    # A filled contour set plus the observation scatter.
+    assert len(ax.collections) >= 2
+    offsets = ax.collections[-1].get_offsets()
+    assert len(offsets) == 25
+    assert "log10(lr)" in ax.get_ylabel()
+
+
+def test_contour_matrix_three_params(study):
+    axes = mvis.plot_contour(study)
+    assert axes.shape == (3, 3)
+    # Diagonal switched off; off-diagonals have data.
+    assert not axes[0][0].axison
+    assert len(axes[1][0].collections) >= 1
+
+
+def test_rank_colors_normalized(study):
+    axes = mvis.plot_rank(study, params=["x"])
+    arr = axes[0].collections[0].get_array()
+    assert float(arr.min()) == 0.0 and float(arr.max()) == 1.0
+
+
+def test_parallel_coordinate_draws_all_trials(study):
+    ax = mvis.plot_parallel_coordinate(study)
+    assert len(ax.lines) == 25
+    labels = [t.get_text() for t in ax.get_xticklabels()]
+    assert labels == ["Objective Value", "c", "lr", "x"]
+
+
+def test_pareto_front_constraint_split():
+    def cfn(frozen):
+        return (frozen.params["a"] - 0.5,)
+
+    s = optuna_tpu.create_study(
+        directions=["minimize", "minimize"],
+        sampler=TPESampler(seed=0, n_startup_trials=4, constraints_func=cfn),
+    )
+    s.optimize(lambda t: (t.suggest_float("a", 0, 1), 1.0), n_trials=10)
+    ax = mvis.plot_pareto_front(s)
+    labels = [t.get_text() for t in ax.get_legend().get_texts()]
+    assert "Infeasible Trial" in labels and "Best Trial" in labels
+
+
+def test_pareto_front_two_objectives(mo_study):
+    ax = mvis.plot_pareto_front(mo_study)
+    total = sum(len(c.get_offsets()) for c in ax.collections)
+    assert total == 20
+
+
+def test_hypervolume_history_monotone(mo_study):
+    ax = mvis.plot_hypervolume_history(mo_study, reference_point=[2.5, 2.5])
+    hv = ax.lines[0].get_ydata()
+    assert len(hv) == 20
+    assert all(b >= a - 1e-12 for a, b in zip(hv, hv[1:]))
+
+
+def test_timeline_has_bar_per_trial(study):
+    ax = mvis.plot_timeline(study)
+    assert len(ax.patches) >= 25
+
+
+def test_intermediate_values_lines(study):
+    ax = mvis.plot_intermediate_values(study)
+    assert len(ax.lines) == 25
+    assert list(ax.lines[0].get_xdata()) == [0, 1]
+
+
+def test_param_importances_bars(study):
+    ax = mvis.plot_param_importances(study)
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert set(labels) == {"x", "lr", "c"}
+
+
+def test_edf_multiple_studies_share_grid(study):
+    s2 = optuna_tpu.create_study(study_name="m2", sampler=RandomSampler(seed=9))
+    s2.optimize(lambda t: 2.0 + t.suggest_float("x", 0, 1), n_trials=10)
+    ax = mvis.plot_edf([study, s2])
+    assert len(ax.lines) == 2
+    x0, x1 = ax.lines[0].get_xdata(), ax.lines[1].get_xdata()
+    np.testing.assert_allclose(x0, x1)
