@@ -179,6 +179,10 @@ class Trial:
     def report(self, value: float, step: int) -> None:
         """Record an intermediate objective value at ``step`` for pruning
         (reference ``_trial.py:419``)."""
+        if self.study._is_multi_objective():
+            raise NotImplementedError(
+                "Trial.report is not supported for multi-objective optimization."
+            )
         try:
             value = float(value)
         except (TypeError, ValueError) as e:

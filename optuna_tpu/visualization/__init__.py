@@ -218,7 +218,9 @@ def plot_slice(
                 "type": "scatter",
                 "mode": "markers",
                 "name": sp.param,
-                "x": [str(v) for v in sp.x] if sp.is_categorical else sp.x,
+                # Categorical x uses the builder's index mapping so both
+                # backends share one category ordering.
+                "x": sp.x_indices if sp.is_categorical else sp.x,
                 "y": sp.y,
                 "xaxis": f"x{suffix}",
                 "yaxis": f"y{suffix}",
@@ -236,7 +238,10 @@ def plot_slice(
         w = max((1.0 - gap * (n - 1)) / n, 1e-3)
         left = (i - 1) * (w + gap)
         layout[f"xaxis{suffix}"] = {
-            **_axis(sp.param, log=sp.is_log),
+            **_axis(
+                sp.param, log=sp.is_log,
+                categories=sp.labels if sp.is_categorical else None,
+            ),
             "domain": [left, left + w],
             "anchor": f"y{suffix}",
         }
@@ -340,7 +345,7 @@ def plot_rank(
                 "type": "scatter",
                 "mode": "markers",
                 "name": sp.param,
-                "x": [str(v) for v in sp.x] if sp.is_categorical else sp.x,
+                "x": sp.x_indices if sp.is_categorical else sp.x,
                 "y": sp.y,
                 "xaxis": f"x{suffix}",
                 "yaxis": f"y{suffix}",
@@ -354,7 +359,13 @@ def plot_rank(
                 "text": [f"Trial {k}" for k in sp.trial_numbers],
             }
         )
-        layout[f"xaxis{suffix}"] = {**_axis(sp.param, log=sp.is_log), "anchor": f"y{suffix}"}
+        layout[f"xaxis{suffix}"] = {
+            **_axis(
+                sp.param, log=sp.is_log,
+                categories=sp.labels if sp.is_categorical else None,
+            ),
+            "anchor": f"y{suffix}",
+        }
         layout[f"yaxis{suffix}"] = {"anchor": f"x{suffix}"}
     return _figure(data, layout)
 

@@ -297,12 +297,21 @@ def contour_data(
     if len(set(names)) < 2:
         raise ValueError("plot_contour needs at least two distinct parameters.")
     names = list(dict.fromkeys(names))
-    matrix: list[list[ContourPair | None]] = []
-    for py in names:
-        row: list[ContourPair | None] = []
-        for px in names:
-            row.append(None if px == py else contour_pair_data(study, px, py, target))
-        matrix.append(row)
+    k = len(names)
+    matrix: list[list[ContourPair | None]] = [[None] * k for _ in range(k)]
+    for r in range(k):
+        for c in range(r + 1, k):
+            # Cell (r, c): x = names[c], y = names[r]; its mirror is the
+            # same surface transposed — no second interpolation pass.
+            pair = contour_pair_data(study, names[c], names[r], target)
+            matrix[r][c] = pair
+            matrix[c][r] = ContourPair(
+                x=pair.y, y=pair.x,
+                x_points=pair.y_points, y_points=pair.x_points,
+                z_points=pair.z_points,
+                grid_x=pair.grid_y, grid_y=pair.grid_x,
+                grid_z=pair.grid_z.T,
+            )
     return matrix
 
 

@@ -38,7 +38,9 @@ def _axes(ax=None) -> "Axes":
 
 
 def _studies(study) -> list:
-    return [study] if not isinstance(study, (list, tuple)) else list(study)
+    # A single Study quacks with get_trials; anything else is an iterable of
+    # studies (list, tuple, generator, ...).
+    return [study] if hasattr(study, "get_trials") else list(study)
 
 
 # ------------------------------------------------------------------- history
@@ -287,6 +289,11 @@ def plot_pareto_front(
         if ax is None:
             fig = plt.figure()
             ax = fig.add_subplot(projection="3d")
+        elif not hasattr(ax, "zaxis"):
+            raise ValueError(
+                "plot_pareto_front with 3 axes needs a 3D Axes "
+                "(add_subplot(projection='3d'))."
+            )
 
         def scat3(vals, **kw):
             if vals:

@@ -1,0 +1,164 @@
+"""Pruning-decision parity against the reference pruners.
+
+For identical trial histories (same intermediate-value streams), each
+pruner here must make the same keep/prune decision at every step as its
+reference counterpart — decision-level parity, stronger than the
+behavior-shape checks in test_pruners.py.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from tests._reference import load_reference
+
+_NOW = datetime.datetime(2026, 1, 1)
+
+
+@pytest.fixture(scope="module")
+def optuna_ref():
+    ref = load_reference()
+    if ref is None:
+        pytest.skip("reference optuna not importable")
+    return ref
+
+
+def _seed_history(mod, study, n_trials: int, n_steps: int, seed: int) -> None:
+    """Complete `n_trials` trials with seeded intermediate streams."""
+    rng = np.random.RandomState(seed)
+    for i in range(n_trials):
+        base = rng.uniform(0.0, 1.0)
+        curve = {s: float(base + 0.1 * s + rng.normal(0, 0.01)) for s in range(n_steps)}
+        study.add_trial(
+            mod.trial.FrozenTrial(
+                number=i,
+                state=mod.trial.TrialState.COMPLETE,
+                value=float(curve[n_steps - 1]),
+                datetime_start=_NOW,
+                datetime_complete=_NOW,
+                params={"x": float(rng.uniform())},
+                distributions={"x": mod.distributions.FloatDistribution(0.0, 1.0)},
+                user_attrs={},
+                system_attrs={},
+                intermediate_values=curve,
+                trial_id=i,
+            )
+        )
+
+
+def _decision_stream(mod, pruner, direction: str, probe: list[float], seed: int,
+                     n_history: int = 12, n_steps: int = 8) -> list[bool]:
+    study = mod.create_study(direction=direction, pruner=pruner)
+    _seed_history(mod, study, n_history, n_steps, seed)
+    trial = study.ask()
+    decisions = []
+    for step, v in enumerate(probe):
+        trial.report(v, step)
+        decisions.append(trial.should_prune())
+    study.tell(trial, probe[-1])
+    return decisions
+
+
+PROBES = [
+    [0.9, 1.0, 1.1, 1.2, 1.3, 1.4],   # consistently bad
+    [0.1, 0.15, 0.2, 0.25, 0.3, 0.35],  # consistently good
+    [0.5, 0.52, 0.55, 0.6, 0.62, 0.64],  # middling
+]
+
+
+def _pairs(optuna_ref):
+    o = optuna_tpu.pruners
+    r = optuna_ref.pruners
+    return [
+        ("median", o.MedianPruner(n_startup_trials=4, n_warmup_steps=1),
+         r.MedianPruner(n_startup_trials=4, n_warmup_steps=1)),
+        ("median-interval", o.MedianPruner(n_startup_trials=2, interval_steps=2),
+         r.MedianPruner(n_startup_trials=2, interval_steps=2)),
+        ("pct25", o.PercentilePruner(25.0, n_startup_trials=4),
+         r.PercentilePruner(25.0, n_startup_trials=4)),
+        ("pct75-minsz", o.PercentilePruner(75.0, n_min_trials=3),
+         r.PercentilePruner(75.0, n_min_trials=3)),
+        ("sha", o.SuccessiveHalvingPruner(min_resource=1, reduction_factor=2),
+         r.SuccessiveHalvingPruner(min_resource=1, reduction_factor=2)),
+        ("threshold", o.ThresholdPruner(upper=1.05),
+         r.ThresholdPruner(upper=1.05)),
+        ("patient", o.PatientPruner(o.MedianPruner(n_startup_trials=4), patience=2),
+         r.PatientPruner(r.MedianPruner(n_startup_trials=4), patience=2)),
+    ]
+
+
+@pytest.mark.parametrize("probe_idx", range(len(PROBES)))
+@pytest.mark.parametrize("direction", ["minimize", "maximize"])
+def test_pruning_decisions_match_reference(optuna_ref, probe_idx, direction):
+    probe = PROBES[probe_idx]
+    for name, ours, theirs in _pairs(optuna_ref):
+        got = _decision_stream(optuna_tpu, ours, direction, probe, seed=11)
+        want = _decision_stream(optuna_ref, theirs, direction, probe, seed=11)
+        assert got == want, f"{name} [{direction}] probe{probe_idx}: {got} != {want}"
+
+
+def test_wilcoxon_decisions_match_reference(optuna_ref):
+    """Wilcoxon compares stepwise against the best trial; needs step-keyed
+    values, exercised on its own probe matrix."""
+    def run(mod, pruner):
+        study = mod.create_study(direction="minimize", pruner=pruner)
+        rng = np.random.RandomState(5)
+        for i in range(6):
+            curve = {s: float(rng.uniform(0.2, 0.4)) for s in range(10)}
+            study.add_trial(
+                mod.trial.FrozenTrial(
+                    number=i, state=mod.trial.TrialState.COMPLETE,
+                    value=float(np.mean(list(curve.values()))),
+                    datetime_start=_NOW, datetime_complete=_NOW,
+                    params={"x": 0.5},
+                    distributions={"x": mod.distributions.FloatDistribution(0, 1)},
+                    user_attrs={}, system_attrs={},
+                    intermediate_values=curve, trial_id=i,
+                )
+            )
+        trial = study.ask()
+        rng2 = np.random.RandomState(6)
+        decisions = []
+        for step in range(10):
+            trial.report(float(rng2.uniform(0.5, 0.9)), step)  # clearly worse
+            decisions.append(trial.should_prune())
+        study.tell(trial, 0.7)
+        return decisions
+
+    got = run(optuna_tpu, optuna_tpu.pruners.WilcoxonPruner(p_threshold=0.1, n_startup_steps=2))
+    want = run(optuna_ref, optuna_ref.pruners.WilcoxonPruner(p_threshold=0.1, n_startup_steps=2))
+    assert got == want
+
+
+def test_hyperband_structurally_consistent(optuna_ref):
+    """Hyperband bracket assignment is implementation-defined (hash-based),
+    so decision parity is not required — but bracket count and per-bracket
+    pruner configuration must follow the reference's formula."""
+    ours = optuna_tpu.pruners.HyperbandPruner(
+        min_resource=1, max_resource=27, reduction_factor=3
+    )
+    theirs = optuna_ref.pruners.HyperbandPruner(
+        min_resource=1, max_resource=27, reduction_factor=3
+    )
+    study = optuna_tpu.create_study(pruner=ours)
+    study.optimize(
+        lambda t: (t.suggest_float("x", 0, 1), [t.report(t.params["x"] + s, s) or
+                   (t.should_prune() and None) for s in range(5)])[0],
+        n_trials=12,
+    )
+    # The reference computes its bracket count lazily on the first prune
+    # query, so drive one reporting trial through it.
+    ref_study = optuna_ref.create_study(pruner=theirs)
+
+    def ref_objective(t):
+        x = t.suggest_float("x", 0, 1)
+        t.report(x, 0)
+        t.should_prune()
+        return x
+
+    ref_study.optimize(ref_objective, n_trials=2)
+    assert ours._n_brackets == theirs._n_brackets

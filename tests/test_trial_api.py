@@ -1,0 +1,238 @@
+"""Trial/Study API edge behavior: suggest caching and validation, report
+rules, FixedTrial, tell variants, metric names, and heartbeat liveness
+races — the behavioral fine print beyond the storage/sampler contracts."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import TrialPruned, create_study
+from optuna_tpu.distributions import FloatDistribution, IntDistribution
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.trial import FixedTrial, TrialState
+
+
+# ------------------------------------------------------------------- suggest
+
+
+def test_suggest_same_name_is_cached_within_trial():
+    study = create_study(sampler=RandomSampler(seed=0))
+    values = []
+
+    def objective(trial):
+        a = trial.suggest_float("x", 0, 1)
+        b = trial.suggest_float("x", 0, 1)
+        values.append((a, b))
+        return a
+
+    study.optimize(objective, n_trials=3)
+    assert all(a == b for a, b in values)
+
+
+def test_suggest_same_name_incompatible_distribution_raises():
+    study = create_study()
+    t = study.ask()
+    t.suggest_float("x", 0, 1)
+    with pytest.raises(ValueError):
+        t.suggest_int("x", 0, 10)
+    study.tell(t, 0.0)
+
+
+def test_suggest_invalid_ranges():
+    study = create_study()
+    t = study.ask()
+    with pytest.raises(ValueError):
+        t.suggest_float("a", 1.0, 0.0)  # low > high
+    with pytest.raises(ValueError):
+        t.suggest_int("b", 5, 1)
+    with pytest.raises(ValueError):
+        t.suggest_float("c", -1.0, 1.0, log=True)  # log needs positive low
+    study.tell(t, 0.0)
+
+
+def test_suggest_step_and_log_are_exclusive():
+    study = create_study()
+    t = study.ask()
+    with pytest.raises(ValueError):
+        t.suggest_float("x", 0.1, 1.0, step=0.1, log=True)
+    study.tell(t, 0.0)
+
+
+# -------------------------------------------------------------------- report
+
+
+def test_report_on_multi_objective_raises():
+    study = create_study(directions=["minimize", "minimize"])
+    t = study.ask()
+    t.suggest_float("x", 0, 1)
+    with pytest.raises(NotImplementedError):
+        t.report(1.0, 0)
+    study.tell(t, [0.0, 0.0])
+
+
+def test_report_same_step_keeps_first_value():
+    study = create_study()
+    t = study.ask()
+    t.report(1.0, 0)
+    t.report(9.0, 0)  # reference ignores re-reports of the same step
+    study.tell(t, 1.0)
+    frozen = study.trials[0]
+    assert frozen.intermediate_values[0] == 1.0
+
+
+def test_should_prune_without_reports_is_false():
+    study = create_study(pruner=optuna_tpu.pruners.MedianPruner(n_startup_trials=0))
+    t = study.ask()
+    assert t.should_prune() is False
+    study.tell(t, 0.0)
+
+
+# --------------------------------------------------------------- fixed trial
+
+
+def test_fixed_trial_returns_pinned_values():
+    t = FixedTrial({"x": 0.25, "k": 3, "c": "b"})
+    assert t.suggest_float("x", 0, 1) == 0.25
+    assert t.suggest_int("k", 0, 10) == 3
+    assert t.suggest_categorical("c", ["a", "b"]) == "b"
+    assert t.params == {"x": 0.25, "k": 3, "c": "b"}
+
+
+def test_fixed_trial_missing_param_raises():
+    t = FixedTrial({"x": 0.25})
+    with pytest.raises(ValueError):
+        t.suggest_float("y", 0, 1)
+
+
+def test_fixed_trial_runs_objective():
+    def objective(trial):
+        return trial.suggest_float("x", 0, 1) ** 2
+
+    assert objective(FixedTrial({"x": 0.5})) == 0.25
+
+
+# ---------------------------------------------------------------------- tell
+
+
+def test_tell_by_trial_number_and_skip_if_finished():
+    study = create_study()
+    t = study.ask()
+    t.suggest_float("x", 0, 1)
+    study.tell(t.number, 1.5)
+    assert study.trials[0].value == 1.5
+    # Re-telling a finished trial raises unless skipped.
+    with pytest.raises(Exception):
+        study.tell(t.number, 2.0)
+    study.tell(t.number, 2.0, skip_if_finished=True)  # no-op
+    assert study.trials[0].value == 1.5
+
+
+def test_tell_pruned_uses_last_intermediate():
+    study = create_study()
+    t = study.ask()
+    t.report(3.5, 0)
+    study.tell(t, state=TrialState.PRUNED)
+    frozen = study.trials[0]
+    assert frozen.state == TrialState.PRUNED
+    assert frozen.value == 3.5  # pruned-value promotion
+
+
+def test_tell_wrong_number_of_values_fails_trial():
+    study = create_study(directions=["minimize", "minimize"])
+    t = study.ask()
+    frozen = study.tell(t, [1.0])  # one value for a 2-objective study
+    assert frozen.state == TrialState.FAIL
+
+
+# ----------------------------------------------------------- study surface
+
+
+def test_metric_names_round_trip_and_dataframe():
+    study = create_study(directions=["minimize", "minimize"])
+    study.set_metric_names(["loss", "latency"])
+    assert study.metric_names == ["loss", "latency"]
+    study.optimize(
+        lambda t: (t.suggest_float("x", 0, 1), 1.0 - t.params["x"]), n_trials=4
+    )
+    df = study.trials_dataframe()
+    cols = set(map(str, df.columns))
+    assert any("loss" in c for c in cols)
+    assert len(df) == 4
+
+
+def test_enqueue_partial_params_fills_rest():
+    study = create_study(sampler=RandomSampler(seed=0))
+    study.enqueue_trial({"x": 0.125})
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0, 1)
+        y = trial.suggest_float("y", 0, 1)
+        return x + y
+
+    study.optimize(objective, n_trials=2)
+    assert study.trials[0].params["x"] == 0.125
+    assert 0 <= study.trials[0].params["y"] <= 1
+
+
+def test_best_trial_ignores_failed_and_pruned():
+    study = create_study()
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0, 1)
+        if trial.number == 0:
+            raise ValueError()
+        if trial.number == 1:
+            raise TrialPruned()
+        return x
+
+    study.optimize(objective, n_trials=5, catch=(ValueError,))
+    assert study.best_trial.number >= 2
+
+
+def test_trial_duration_and_datetimes():
+    study = create_study()
+    study.optimize(lambda t: time.sleep(0.05) or t.suggest_float("x", 0, 1), n_trials=1)
+    frozen = study.trials[0]
+    assert frozen.duration is not None
+    assert frozen.duration.total_seconds() >= 0.04
+    assert frozen.datetime_start <= frozen.datetime_complete
+
+
+# ------------------------------------------------------------ heartbeat race
+
+
+def test_heartbeat_keeps_live_trial_alive(tmp_path):
+    from optuna_tpu.storages._heartbeat import fail_stale_trials, get_heartbeat_thread
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path / 'hb.db'}", heartbeat_interval=1, grace_period=2
+    )
+    study = optuna_tpu.create_study(storage=storage)
+    trial = study.ask()
+
+    stop = threading.Event()
+    started = threading.Event()
+
+    def worker():
+        with get_heartbeat_thread(trial._trial_id, storage):
+            started.set()
+            stop.wait(6.0)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    started.wait(5.0)
+    time.sleep(2.5)  # beyond the grace period, but heartbeats keep landing
+    fail_stale_trials(study)
+    assert storage.get_trial(trial._trial_id).state == TrialState.RUNNING
+    stop.set()
+    th.join()
+    # After the worker dies, the trial goes stale and is failed.
+    time.sleep(2.5)
+    fail_stale_trials(study)
+    assert storage.get_trial(trial._trial_id).state == TrialState.FAIL
