@@ -62,12 +62,20 @@ __all__ = [
 ]
 
 
+# Heavy/optional subpackages load lazily (reference uses _LazyImport,
+# ``optuna/_imports.py:111``).
+_LAZY_SUBPACKAGES = frozenset(
+    {"artifacts", "cli", "integration", "progress_bar", "terminator", "visualization"}
+)
+
+
 def __getattr__(name: str):
-    # Heavy/optional subpackages load lazily (reference uses _LazyImport,
-    # ``optuna/_imports.py:111``).
-    _lazy_subpackages = {"artifacts", "cli", "integration", "terminator", "visualization"}
-    if name in _lazy_subpackages:
+    if name in _LAZY_SUBPACKAGES:
         import importlib
 
         return importlib.import_module(f"optuna_tpu.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | _LAZY_SUBPACKAGES)

@@ -209,20 +209,30 @@ def _adjust_discrete_uniform_high(low: float, high: float, step: float) -> float
 
 
 def distribution_to_json(dist: BaseDistribution) -> str:
-    """Serialize a distribution for the storage layer (reference distributions.py:583)."""
-    for name, cls in _CLASSES.items():
+    """Serialize a distribution for the storage layer (reference distributions.py:583).
+
+    The *exact* class name is written — legacy alias classes round-trip as
+    themselves, so ``==`` and compatibility checks hold across storage."""
+    name = type(dist).__name__
+    if name in _LEGACY_ENCODERS:
+        return json.dumps({"name": name, "attributes": _LEGACY_ENCODERS[name](dist)})
+    for cname, cls in _CLASSES.items():
         if isinstance(dist, cls):
-            return json.dumps({"name": name, "attributes": dist._asdict()})
+            return json.dumps({"name": cname, "attributes": dist._asdict()})
     raise ValueError(f"Unknown distribution class: {type(dist)}")
 
 
 def json_to_distribution(json_str: str) -> BaseDistribution:
-    """Deserialize a distribution (reference distributions.py:605)."""
+    """Deserialize a distribution (reference distributions.py:605), including
+    studies written under the reference's pre-v3 legacy class names."""
     loaded = json.loads(json_str)
     name = loaded["name"]
     attributes = loaded["attributes"]
     if name == _categorical_distribution_key:
         return CategoricalDistribution(choices=tuple(attributes["choices"]))
+    legacy = _LEGACY_DECODERS.get(name)
+    if legacy is not None:
+        return legacy(attributes)
     cls = _CLASSES.get(name)
     if cls is None:
         raise ValueError(f"Unknown distribution name: {name}")
@@ -251,3 +261,85 @@ def check_distribution_compatibility(
                 + " does not support dynamic value space: "
                 f"{dist_old.choices} != {dist_new.choices}."
             )
+
+
+# ------------------------------------------------------- deprecated aliases
+# Drop-in names from the reference's pre-v3 API (``optuna/distributions.py:
+# 196-330``): thin constructors over the three canonical distributions, kept
+# so studies/configs written against the old names keep working.
+
+
+class UniformDistribution(FloatDistribution):
+    """Deprecated: use ``FloatDistribution(low, high)``."""
+
+    def __init__(self, low: float, high: float) -> None:
+        super().__init__(low=low, high=high, log=False, step=None)
+
+
+class LogUniformDistribution(FloatDistribution):
+    """Deprecated: use ``FloatDistribution(low, high, log=True)``."""
+
+    def __init__(self, low: float, high: float) -> None:
+        super().__init__(low=low, high=high, log=True, step=None)
+
+
+class DiscreteUniformDistribution(FloatDistribution):
+    """Deprecated: use ``FloatDistribution(low, high, step=q)``."""
+
+    def __init__(self, low: float, high: float, q: float) -> None:
+        super().__init__(low=low, high=high, log=False, step=q)
+
+    @property
+    def q(self) -> float:
+        assert self.step is not None
+        return self.step
+
+
+class IntUniformDistribution(IntDistribution):
+    """Deprecated: use ``IntDistribution(low, high, step=step)``."""
+
+    def __init__(self, low: int, high: int, step: int = 1) -> None:
+        super().__init__(low=low, high=high, log=False, step=step)
+
+
+class IntLogUniformDistribution(IntDistribution):
+    """Deprecated: use ``IntDistribution(low, high, log=True)``."""
+
+    def __init__(self, low: int, high: int, step: int = 1) -> None:
+        super().__init__(low=low, high=high, log=True, step=step)
+
+
+DISTRIBUTION_CLASSES = (
+    IntDistribution,
+    IntLogUniformDistribution,
+    IntUniformDistribution,
+    FloatDistribution,
+    DiscreteUniformDistribution,
+    LogUniformDistribution,
+    UniformDistribution,
+    CategoricalDistribution,
+)
+
+# JSON round-trip for the legacy names, mirroring each alias' constructor
+# signature so stored studies written under either API load as the exact
+# class they were saved with.
+_LEGACY_ENCODERS = {
+    "UniformDistribution": lambda d: {"low": d.low, "high": d.high},
+    "LogUniformDistribution": lambda d: {"low": d.low, "high": d.high},
+    "DiscreteUniformDistribution": lambda d: {"low": d.low, "high": d.high, "q": d.step},
+    "IntUniformDistribution": lambda d: {"low": d.low, "high": d.high, "step": d.step},
+    "IntLogUniformDistribution": lambda d: {"low": d.low, "high": d.high, "step": d.step},
+}
+_LEGACY_DECODERS = {
+    "UniformDistribution": lambda a: UniformDistribution(a["low"], a["high"]),
+    "LogUniformDistribution": lambda a: LogUniformDistribution(a["low"], a["high"]),
+    "DiscreteUniformDistribution": lambda a: DiscreteUniformDistribution(
+        a["low"], a["high"], a["q"]
+    ),
+    "IntUniformDistribution": lambda a: IntUniformDistribution(
+        a["low"], a["high"], a.get("step", 1)
+    ),
+    "IntLogUniformDistribution": lambda a: IntLogUniformDistribution(
+        a["low"], a["high"], a.get("step", 1)
+    ),
+}

@@ -36,16 +36,22 @@ def _filter_study(study: "Study", trial: FrozenTrial) -> "Study":
     return study
 
 
-def __getattr__(name: str):  # lazily expose pruners implemented in later stages
-    _lazy = {
-        "PatientPruner": "optuna_tpu.pruners._patient",
-        "ThresholdPruner": "optuna_tpu.pruners._threshold",
-        "SuccessiveHalvingPruner": "optuna_tpu.pruners._successive_halving",
-        "HyperbandPruner": "optuna_tpu.pruners._hyperband",
-        "WilcoxonPruner": "optuna_tpu.pruners._wilcoxon",
-    }
-    if name in _lazy:
+_LAZY = {
+    "PatientPruner": "optuna_tpu.pruners._patient",
+    "ThresholdPruner": "optuna_tpu.pruners._threshold",
+    "SuccessiveHalvingPruner": "optuna_tpu.pruners._successive_halving",
+    "HyperbandPruner": "optuna_tpu.pruners._hyperband",
+    "WilcoxonPruner": "optuna_tpu.pruners._wilcoxon",
+}
+
+
+def __getattr__(name: str):  # lazily expose the heavier pruners
+    if name in _LAZY:
         import importlib
 
-        return getattr(importlib.import_module(_lazy[name]), name)
+        return getattr(importlib.import_module(_LAZY[name]), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_LAZY))

@@ -11,6 +11,7 @@ from optuna_tpu.samplers._lazy_random_state import LazyRandomState
 from optuna_tpu.samplers._random import RandomSampler
 
 __all__ = [
+    "BaseGASampler",
     "BaseSampler",
     "BruteForceSampler",
     "CmaEsSampler",
@@ -27,6 +28,8 @@ __all__ = [
 ]
 
 _LAZY = {
+    "BaseGASampler": ("optuna_tpu.samplers._ga._base", "BaseGASampler"),
+    "nsgaii": ("optuna_tpu.samplers.nsgaii", None),
     "MOTPESampler": ("optuna_tpu.samplers._tpe.sampler", "MOTPESampler"),
     "TPESampler": ("optuna_tpu.samplers._tpe.sampler", "TPESampler"),
     "GPSampler": ("optuna_tpu.samplers._gp.sampler", "GPSampler"),
@@ -45,5 +48,10 @@ def __getattr__(name: str):
         import importlib
 
         module, attr = _LAZY[name]
-        return getattr(importlib.import_module(module), attr)
+        mod = importlib.import_module(module)
+        return mod if attr is None else getattr(mod, attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_LAZY))

@@ -22,11 +22,23 @@ __all__ = [
     "RetryFailedTrialCallback",
     "RetryHeartbeatStaleTrialCallback",
     "fail_stale_trials",
+    "BaseJournalLogStorage",
+    "JournalFileStorage",
+    "JournalRedisStorage",
+    "JournalFileOpenLock",
+    "JournalFileSymlinkLock",
     "get_storage",
     "run_grpc_proxy_server",
 ]
 
 _LAZY = {
+    # Deprecated drop-in names from the reference (pre-journal-package API).
+    "BaseJournalLogStorage": ("optuna_tpu.storages.journal._base", "BaseJournalBackend"),
+    "JournalFileStorage": ("optuna_tpu.storages.journal._file", "JournalFileBackend"),
+    "JournalRedisStorage": ("optuna_tpu.storages.journal._redis", "JournalRedisBackend"),
+    "JournalFileOpenLock": ("optuna_tpu.storages.journal._file", "JournalFileOpenLock"),
+    "JournalFileSymlinkLock": ("optuna_tpu.storages.journal._file", "JournalFileSymlinkLock"),
+    "journal": ("optuna_tpu.storages.journal", None),
     "RDBStorage": ("optuna_tpu.storages._rdb.storage", "RDBStorage"),
     "JournalStorage": ("optuna_tpu.storages.journal", "JournalStorage"),
     "JournalFileBackend": ("optuna_tpu.storages.journal", "JournalFileBackend"),
@@ -41,7 +53,8 @@ def __getattr__(name: str):
         import importlib
 
         module, attr = _LAZY[name]
-        return getattr(importlib.import_module(module), attr)
+        mod = importlib.import_module(module)
+        return mod if attr is None else getattr(mod, attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -74,3 +87,7 @@ def get_storage(storage: Union[None, str, BaseStorage]) -> BaseStorage:
     if isinstance(storage, BaseStorage):
         return storage
     raise ValueError(f"Unsupported storage type: {type(storage)!r}")
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,131 @@
+"""Drop-in API-surface parity: names code written against the reference
+imports must resolve here — deprecated distribution classes, legacy journal
+storage names, BaseTrial, lazy submodules discoverable via dir()."""
+
+from __future__ import annotations
+
+import pytest
+
+import optuna_tpu
+
+
+def test_deprecated_distribution_aliases_construct_canonical_forms():
+    from optuna_tpu.distributions import (
+        DISTRIBUTION_CLASSES,
+        DiscreteUniformDistribution,
+        FloatDistribution,
+        IntDistribution,
+        IntLogUniformDistribution,
+        IntUniformDistribution,
+        LogUniformDistribution,
+        UniformDistribution,
+    )
+
+    assert isinstance(UniformDistribution(0.0, 1.0), FloatDistribution)
+    assert LogUniformDistribution(1e-3, 1.0).log is True
+    d = DiscreteUniformDistribution(0.0, 1.0, 0.25)
+    assert d.step == 0.25 and d.q == 0.25
+    assert IntUniformDistribution(0, 10, 2).step == 2
+    assert IntLogUniformDistribution(1, 64).log is True
+    assert FloatDistribution in DISTRIBUTION_CLASSES
+    assert len(DISTRIBUTION_CLASSES) == 8
+
+
+def test_legacy_distribution_json_round_trip():
+    """Studies stored under the reference's pre-v3 class names must load,
+    and alias instances must survive a storage round-trip as themselves."""
+    import json
+
+    from optuna_tpu.distributions import (
+        DiscreteUniformDistribution,
+        IntLogUniformDistribution,
+        UniformDistribution,
+        distribution_to_json,
+        json_to_distribution,
+    )
+
+    for dist in (
+        UniformDistribution(0.0, 2.0),
+        DiscreteUniformDistribution(0.0, 1.0, 0.25),
+        IntLogUniformDistribution(1, 64),
+    ):
+        blob = distribution_to_json(dist)
+        assert json.loads(blob)["name"] == type(dist).__name__
+        back = json_to_distribution(blob)
+        assert type(back) is type(dist)
+        assert back == dist
+
+    # A blob written by reference code with the legacy name loads too.
+    legacy_blob = json.dumps(
+        {"name": "UniformDistribution", "attributes": {"low": 0.0, "high": 1.0}}
+    )
+    loaded = json_to_distribution(legacy_blob)
+    assert loaded == UniformDistribution(0.0, 1.0)
+
+
+def test_legacy_distribution_survives_rdb_storage(tmp_path):
+    from optuna_tpu.distributions import UniformDistribution
+    from optuna_tpu.storages import RDBStorage
+
+    storage = RDBStorage(f"sqlite:///{tmp_path / 'legacy_dist.db'}")
+    study = optuna_tpu.create_study(storage=storage)
+    t = study.ask(fixed_distributions={"x": UniformDistribution(0.0, 1.0)})
+    study.tell(t, 0.5)
+    reloaded = storage.get_trial(t._trial_id)
+    assert type(reloaded.distributions["x"]) is UniformDistribution
+
+
+def test_legacy_journal_storage_names():
+    from optuna_tpu.storages import (
+        BaseJournalLogStorage,
+        JournalFileOpenLock,
+        JournalFileStorage,
+        JournalFileSymlinkLock,
+    )
+    from optuna_tpu.storages.journal import JournalFileBackend
+
+    assert JournalFileStorage is JournalFileBackend
+    assert JournalFileOpenLock is not None and JournalFileSymlinkLock is not None
+    assert BaseJournalLogStorage is not None
+
+
+def test_base_trial_covers_all_trial_flavours():
+    from optuna_tpu.trial import BaseTrial, FixedTrial, FrozenTrial, Trial
+
+    study = optuna_tpu.create_study()
+    t = study.ask()
+    assert isinstance(t, BaseTrial)
+    assert isinstance(FixedTrial({"x": 1.0}), BaseTrial)
+    study.tell(t, 0.0)
+    assert isinstance(study.trials[0], BaseTrial)
+    assert issubclass(Trial, object)
+
+
+def test_lazy_names_appear_in_dir():
+    assert "TPESampler" in dir(optuna_tpu.samplers)
+    assert "GPSampler" in dir(optuna_tpu.samplers)
+    assert "HyperbandPruner" in dir(optuna_tpu.pruners)
+    assert "RDBStorage" in dir(optuna_tpu.storages)
+    assert "visualization" in dir(optuna_tpu)
+    assert "progress_bar" in dir(optuna_tpu)
+
+
+def test_lazy_submodules_resolve():
+    import optuna_tpu.samplers as samplers
+
+    assert samplers.nsgaii is not None
+    assert optuna_tpu.storages.journal is not None
+    assert optuna_tpu.progress_bar is not None
+
+
+def test_samplers_base_ga_exposed():
+    from optuna_tpu.samplers import BaseGASampler, NSGAIISampler
+
+    assert issubclass(NSGAIISampler, BaseGASampler)
+
+
+def test_unknown_lazy_name_raises_attribute_error():
+    with pytest.raises(AttributeError):
+        optuna_tpu.samplers.NoSuchSampler  # noqa: B018
+    with pytest.raises(AttributeError):
+        optuna_tpu.storages.NoSuchStorage  # noqa: B018
