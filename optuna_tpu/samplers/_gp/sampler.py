@@ -20,7 +20,6 @@ from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.logging import get_logger
 from optuna_tpu.samplers._base import (
     BaseSampler,
-    _CONSTRAINTS_KEY,
     _process_constraints_after_trial,
 )
 from optuna_tpu.samplers._lazy_random_state import LazyRandomState
@@ -486,7 +485,9 @@ class GPSampler(BaseSampler):
         from optuna_tpu.gp.acqf import ConstrainedData
         from optuna_tpu.gp.gp import fit_gp
 
-        constraint_rows = [t.system_attrs.get(_CONSTRAINTS_KEY) for t in trials]
+        from optuna_tpu.study._constrained_optimization import _constraints_list
+
+        constraint_rows = [_constraints_list(t.system_attrs) for t in trials]
         if any(c is None for c in constraint_rows):
             return acqf_name, data
         cons = np.asarray(constraint_rows, dtype=np.float64)  # (n, C)

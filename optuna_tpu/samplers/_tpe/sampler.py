@@ -23,7 +23,6 @@ from optuna_tpu.distributions import BaseDistribution, CategoricalDistribution
 from optuna_tpu.logging import get_logger
 from optuna_tpu.samplers._base import (
     BaseSampler,
-    _CONSTRAINTS_KEY,
     _process_constraints_after_trial,
 )
 from optuna_tpu.samplers._lazy_random_state import LazyRandomState
@@ -496,7 +495,9 @@ def _hv_reference_point(worst_point: np.ndarray) -> np.ndarray:
 
 
 def _get_infeasible_trial_score(trial: FrozenTrial) -> tuple[bool, float]:
-    constraint = trial.system_attrs.get(_CONSTRAINTS_KEY)
+    from optuna_tpu.study._constrained_optimization import _constraints_list
+
+    constraint = _constraints_list(trial.system_attrs)
     if constraint is None:
         return True, float("inf")
     violation = sum(v for v in constraint if v > 0)

@@ -11,7 +11,6 @@ from typing import TYPE_CHECKING, Sequence
 
 import numpy as np
 
-from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
 from optuna_tpu.study._multi_objective import _fast_non_domination_rank, _normalize_values
 from optuna_tpu.trial._frozen import FrozenTrial
 
@@ -21,11 +20,13 @@ if TYPE_CHECKING:
 
 def _constraint_penalty(trials: Sequence[FrozenTrial]) -> np.ndarray | None:
     """Total violation per trial, or None when no trial carries constraints."""
-    if not any(_CONSTRAINTS_KEY in t.system_attrs for t in trials):
+    from optuna_tpu.study._constrained_optimization import _constraints_list
+
+    rows = [_constraints_list(t.system_attrs) for t in trials]
+    if all(r is None for r in rows):
         return None
     penalty = np.empty(len(trials))
-    for i, t in enumerate(trials):
-        constraints = t.system_attrs.get(_CONSTRAINTS_KEY)
+    for i, constraints in enumerate(rows):
         if constraints is None:
             penalty[i] = np.nan  # missing constraints rank behind infeasible
         else:

@@ -39,10 +39,10 @@ def _constrained_dominates(t0: FrozenTrial, t1: FrozenTrial, directions) -> bool
     """Deb's constrained domination: feasible beats infeasible, less-violating
     beats more-violating, otherwise plain domination
     (reference ``nsgaii/_constraints_evaluation.py:19``)."""
-    from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
+    from optuna_tpu.study._constrained_optimization import _constraints_list
 
     def violation(t: FrozenTrial) -> float:
-        constraints = t.system_attrs.get(_CONSTRAINTS_KEY)
+        constraints = _constraints_list(t.system_attrs)
         if constraints is None:
             return float("inf")  # missing constraints rank behind everything
         return sum(max(c, 0.0) for c in constraints)

@@ -177,16 +177,11 @@ def _get_pareto_front_trials_by_trials(
     directions: Sequence[StudyDirection],
     consider_constraint: bool = False,
 ) -> list[FrozenTrial]:
-    from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
+    from optuna_tpu.study._constrained_optimization import _is_feasible
 
     complete = [t for t in trials if t.state == TrialState.COMPLETE]
     if consider_constraint:
-
-        def _feasible(t: FrozenTrial) -> bool:
-            constraints = t.system_attrs.get(_CONSTRAINTS_KEY)
-            return constraints is None or all(c <= 0.0 for c in constraints)
-
-        complete = [t for t in complete if _feasible(t)]
+        complete = [t for t in complete if _is_feasible(t.system_attrs)]
     if len(complete) == 0:
         return []
     values = _normalize_values(

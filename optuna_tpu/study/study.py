@@ -95,12 +95,13 @@ class Study:
                 "Consider using Study.best_trials to retrieve a list containing the best trials."
             )
         best_trial = self._storage.get_best_trial(self._study_id)
-        # Filter infeasible trials if a constraints function was in play.
-        from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
-        from optuna_tpu.study._constrained_optimization import _get_feasible_trials
+        # Filter infeasible trials if constraints (listed or named) are in play.
+        from optuna_tpu.study._constrained_optimization import (
+            _get_feasible_trials,
+            _is_feasible,
+        )
 
-        constraints = best_trial.system_attrs.get(_CONSTRAINTS_KEY)
-        if constraints is not None and not all(c <= 0.0 for c in constraints):
+        if not _is_feasible(best_trial.system_attrs):
             complete = self._get_trials(deepcopy=False, states=(TrialState.COMPLETE,))
             feasible = _get_feasible_trials(complete)
             if len(feasible) == 0:

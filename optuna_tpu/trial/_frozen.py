@@ -15,6 +15,17 @@ from optuna_tpu.distributions import BaseDistribution, check_distribution_compat
 from optuna_tpu.trial._state import TrialState
 
 
+def _check_float(value: Any, *, arg: str = "value") -> float:
+    """Coerce to float or raise the storage-layer TypeError message."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        raise TypeError(
+            f"The `{arg}` argument is of type '{type(value).__name__}' "
+            "but supposed to be a float."
+        ) from None
+
+
 class FrozenTrial:
     """A finished (or snapshot of a live) trial.
 
@@ -115,6 +126,28 @@ class FrozenTrial:
         if self.datetime_start is not None and self.datetime_complete is not None:
             return self.datetime_complete - self.datetime_start
         return None
+
+    @property
+    def constraints(self) -> dict[str, float]:
+        """Named constraint values; feasible iff every value <= 0
+        (reference ``_frozen.py:485``)."""
+        from optuna_tpu.study._constrained_optimization import (
+            _get_constraints_from_system_attrs,
+        )
+
+        return _get_constraints_from_system_attrs(self.system_attrs)
+
+    def set_constraint(self, key: str, value: float) -> None:
+        """Attach a named constraint value (reference ``_frozen.py:496``)."""
+        from optuna_tpu.study._constrained_optimization import _CONSTRAINTS_KEY
+
+        self.system_attrs[f"{_CONSTRAINTS_KEY}:{key}"] = _check_float(value)
+
+    def set_user_attr(self, key: str, value: Any) -> None:
+        self.user_attrs[key] = value
+
+    def set_system_attr(self, key: str, value: Any) -> None:
+        self.system_attrs[key] = value
 
     def __eq__(self, other: Any) -> bool:
         if not isinstance(other, FrozenTrial):

@@ -34,7 +34,6 @@ if TYPE_CHECKING:
 
 _SUGGESTED_STATES = (TrialState.COMPLETE, TrialState.PRUNED)
 _FIXED_PARAMS_KEY = "fixed_params"
-_CONSTRAINTS_KEY = "constraints"
 
 
 class Trial:
@@ -222,17 +221,35 @@ class Trial:
             key: value,
         }
 
+    @property
+    def constraints(self) -> dict[str, float]:
+        """Named constraint values; feasible iff every value <= 0
+        (reference ``_trial.py:773``)."""
+        from optuna_tpu.study._constrained_optimization import (
+            _get_constraints_from_system_attrs,
+        )
+
+        return _get_constraints_from_system_attrs(
+            self.storage.get_trial(self._trial_id).system_attrs
+        )
+
+    def set_constraint(self, key: str, value: float) -> None:
+        """Attach a named constraint value (reference ``_trial.py:785``).
+        Constraint-aware samplers and the Pareto-front plot treat the trial
+        as infeasible when any value is positive."""
+        from optuna_tpu.study._constrained_optimization import _CONSTRAINTS_KEY
+        from optuna_tpu.trial._frozen import _check_float
+
+        self.storage.set_trial_system_attr(
+            self._trial_id, f"{_CONSTRAINTS_KEY}:{key}", _check_float(value)
+        )
+
     def set_system_attr(self, key: str, value: Any) -> None:
         self.storage.set_trial_system_attr(self._trial_id, key, value)
         self._cached_frozen_trial.system_attrs = {
             **self._cached_frozen_trial.system_attrs,
             key: value,
         }
-
-    def set_constraint(self, constraints: Sequence[float]) -> None:
-        """Directly record constraint values (<=0 feasible) without a
-        ``constraints_func`` round-trip (reference ``_trial.py:785``)."""
-        self.set_system_attr(_CONSTRAINTS_KEY, tuple(float(c) for c in constraints))
 
     # ------------------------------------------------------------- properties
 

@@ -25,7 +25,6 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from optuna_tpu.distributions import CategoricalDistribution
-from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
 from optuna_tpu.study._multi_objective import _get_pareto_front_trials
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -72,8 +71,9 @@ def _is_numerical(trials: list[FrozenTrial], param: str) -> bool:
 
 
 def _feasible(trial: FrozenTrial) -> bool:
-    cons = trial.system_attrs.get(_CONSTRAINTS_KEY)
-    return cons is None or all(c <= 0.0 for c in cons)
+    from optuna_tpu.study._constrained_optimization import _is_feasible
+
+    return _is_feasible(trial.system_attrs)
 
 
 # ------------------------------------------------------- optimization history

@@ -236,3 +236,55 @@ def test_heartbeat_keeps_live_trial_alive(tmp_path):
     time.sleep(2.5)
     fail_stale_trials(study)
     assert storage.get_trial(trial._trial_id).state == TrialState.FAIL
+
+
+# ---------------------------------------------------------- named constraints
+
+
+def test_named_constraints_round_trip():
+    study = create_study()
+    t = study.ask()
+    t.suggest_float("x", 0, 1)
+    t.set_constraint("memory", 0.5)
+    t.set_constraint("latency", -1.0)
+    assert t.constraints == {"memory": 0.5, "latency": -1.0}
+    study.tell(t, 1.0)
+    frozen = study.trials[0]
+    assert frozen.constraints == {"memory": 0.5, "latency": -1.0}
+    with pytest.raises(TypeError):
+        t.set_constraint("bad", "not-a-float")
+
+
+def test_named_and_listed_constraints_merge():
+    from optuna_tpu.study._constrained_optimization import (
+        _get_constraints_from_system_attrs,
+        _get_feasible_trials,
+    )
+
+    attrs = {"constraints": [0.2, -0.1], "constraints:mem": -3.0}
+    merged = _get_constraints_from_system_attrs(attrs)
+    assert merged == {"0": 0.2, "1": -0.1, "mem": -3.0}
+
+    study = create_study()
+    t = study.ask()
+    t.suggest_float("x", 0, 1)
+    t.set_constraint("mem", 1.0)  # infeasible
+    study.tell(t, 0.0)
+    t2 = study.ask()
+    t2.suggest_float("x", 0, 1)
+    t2.set_constraint("mem", -1.0)  # feasible
+    study.tell(t2, 0.0)
+    feasible = _get_feasible_trials(study.trials)
+    assert [f.number for f in feasible] == [1]
+
+
+def test_frozen_trial_local_attr_setters():
+    from optuna_tpu.trial import create_trial
+
+    frozen = create_trial(value=1.0)
+    frozen.set_user_attr("k", "v")
+    frozen.set_system_attr("s", 2)
+    frozen.set_constraint("c", 0.0)
+    assert frozen.user_attrs == {"k": "v"}
+    assert frozen.system_attrs["s"] == 2
+    assert frozen.constraints == {"c": 0.0}
