@@ -8,10 +8,13 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Callable
 
+from optuna_tpu.importance._base import BaseImportanceEvaluator
+
 if TYPE_CHECKING:
     from optuna_tpu.study.study import Study
 
 __all__ = [
+    "BaseImportanceEvaluator",
     "get_param_importances",
     "FanovaImportanceEvaluator",
     "PedAnovaImportanceEvaluator",
@@ -51,3 +54,7 @@ def get_param_importances(
     return _get_param_importances(
         study, evaluator=evaluator, params=params, target=target, normalize=normalize
     )
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_LAZY))

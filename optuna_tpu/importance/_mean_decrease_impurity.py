@@ -8,6 +8,7 @@ from typing import TYPE_CHECKING, Callable
 
 import numpy as np
 
+from optuna_tpu.importance._base import BaseImportanceEvaluator
 from optuna_tpu.importance._evaluate import _get_filtered_trials, _target_values
 from optuna_tpu.transform import SearchSpaceTransform
 
@@ -15,7 +16,7 @@ if TYPE_CHECKING:
     from optuna_tpu.study.study import Study
 
 
-class MeanDecreaseImpurityImportanceEvaluator:
+class MeanDecreaseImpurityImportanceEvaluator(BaseImportanceEvaluator):
     def __init__(self, *, n_trees: int = 64, max_depth: int = 64, seed: int | None = None) -> None:
         self._n_trees = n_trees
         self._max_depth = max_depth

@@ -21,6 +21,7 @@ from typing import TYPE_CHECKING, Callable
 
 import numpy as np
 
+from optuna_tpu.importance._base import BaseImportanceEvaluator
 from optuna_tpu.distributions import (
     BaseDistribution,
     CategoricalDistribution,
@@ -149,7 +150,7 @@ def _pearson_divergence(
     return float(pdf_region @ ((pdf_top / pdf_region - 1.0) ** 2))
 
 
-class PedAnovaImportanceEvaluator:
+class PedAnovaImportanceEvaluator(BaseImportanceEvaluator):
     """Importance of each parameter for reaching the top-quantile outcomes.
 
     API parity: reference ``PedAnovaImportanceEvaluator(target_quantile=0.1,

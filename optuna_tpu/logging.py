@@ -47,6 +47,14 @@ class _ColorFormatter(logging.Formatter):
         return out
 
 
+def create_default_formatter() -> logging.Formatter:
+    """The library's default log formatter, color-aware exactly when the
+    default handler would be (reference ``logging.py:31``) — public so users
+    can mirror the format on their own handlers."""
+    use_color = hasattr(sys.stderr, "isatty") and sys.stderr.isatty() and os.name != "nt"
+    return _ColorFormatter(use_color)
+
+
 def _get_library_name() -> str:
     return __name__.split(".")[0]
 

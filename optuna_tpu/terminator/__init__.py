@@ -18,9 +18,10 @@ from optuna_tpu.terminator._evaluators import (
     StaticErrorEvaluator,
     report_cross_validation_scores,
 )
-from optuna_tpu.terminator._terminator import Terminator, TerminatorCallback
+from optuna_tpu.terminator._terminator import BaseTerminator, Terminator, TerminatorCallback
 
 __all__ = [
+    "BaseTerminator",
     "BaseErrorEvaluator",
     "BaseImprovementEvaluator",
     "BestValueStagnationEvaluator",

@@ -24,7 +24,15 @@ if TYPE_CHECKING:
 _logger = get_logger(__name__)
 
 
-class Terminator:
+class BaseTerminator:
+    """Terminator protocol (reference ``terminator/terminator.py:25``):
+    ``should_terminate(study) -> bool``."""
+
+    def should_terminate(self, study) -> bool:
+        raise NotImplementedError
+
+
+class Terminator(BaseTerminator):
     """should_terminate(study) == improvement_bound < error_estimate."""
 
     def __init__(
@@ -58,7 +66,7 @@ class Terminator:
 class TerminatorCallback:
     """optimize() callback that stops the study once the terminator fires."""
 
-    def __init__(self, terminator: Terminator | None = None) -> None:
+    def __init__(self, terminator: BaseTerminator | None = None) -> None:
         self._terminator = terminator or Terminator(
             improvement_evaluator=RegretBoundEvaluator(),
             error_evaluator=MedianErrorEvaluator(),
