@@ -108,6 +108,11 @@ def run_ours_tpe(n_warmup: int, n_timed: int) -> tuple[float, float]:
     from optuna_tpu.samplers import TPESampler
 
     _silence()
+    # Throwaway study visits every history bucket the timed window will touch,
+    # so the measurement excludes XLA compile time (same policy as the GP
+    # prewarm; in-bucket TPE runs at reference-parity rates).
+    warm = optuna_tpu.create_study(sampler=TPESampler(seed=1))
+    warm.optimize(branin, n_trials=n_warmup + n_timed)
     study = optuna_tpu.create_study(sampler=TPESampler(seed=0))
     study.optimize(branin, n_trials=n_warmup)
     t0 = time.time()
@@ -122,6 +127,8 @@ def run_ours_cmaes(n_warmup: int, n_timed: int) -> tuple[float, float]:
     from optuna_tpu.samplers import CmaEsSampler
 
     _silence()
+    warm = optuna_tpu.create_study(sampler=CmaEsSampler(seed=1, popsize=40))
+    warm.optimize(lambda t: rastrigin(t, dim=50), n_trials=120)  # compile gens
     study = optuna_tpu.create_study(sampler=CmaEsSampler(seed=0, popsize=40))
     study.optimize(lambda t: rastrigin(t, dim=50), n_trials=n_warmup)
     t0 = time.time()
@@ -227,13 +234,17 @@ def _import_reference():
     return optuna
 
 
-def run_baseline_gp(n_timed: int) -> tuple[float, float] | None:
+def run_baseline_gp(n_warmup: int, n_timed: int) -> tuple[float, float] | None:
+    """Reference GPSampler timed over the SAME trial window as ours
+    (``n_warmup`` untimed trials first, incl. its 10-trial random startup) —
+    the GP's cost grows with history size, so mismatched windows would skew
+    the ratio either way."""
     try:
         optuna = _import_reference()
         from optuna_tpu.models.benchmarks import hartmann20
 
         study = optuna.create_study(sampler=optuna.samplers.GPSampler(seed=0))
-        study.optimize(hartmann20, n_trials=10)  # startup phase
+        study.optimize(hartmann20, n_trials=n_warmup)
         t0 = time.time()
         study.optimize(hartmann20, n_trials=n_timed)
         dt = time.time() - t0
@@ -243,13 +254,13 @@ def run_baseline_gp(n_timed: int) -> tuple[float, float] | None:
         return None
 
 
-def run_baseline_tpe(n_timed: int) -> tuple[float, float] | None:
+def run_baseline_tpe(n_warmup: int, n_timed: int) -> tuple[float, float] | None:
     try:
         optuna = _import_reference()
         from optuna_tpu.models.benchmarks import branin
 
         study = optuna.create_study(sampler=optuna.samplers.TPESampler(seed=0))
-        study.optimize(branin, n_trials=10)
+        study.optimize(branin, n_trials=n_warmup)
         t0 = time.time()
         study.optimize(branin, n_trials=n_timed)
         dt = time.time() - t0
@@ -259,7 +270,7 @@ def run_baseline_tpe(n_timed: int) -> tuple[float, float] | None:
         return None
 
 
-def run_baseline_nsga2(n_timed: int) -> tuple[float, float] | None:
+def run_baseline_nsga2(n_warmup: int, n_timed: int) -> tuple[float, float] | None:
     try:
         optuna = _import_reference()
         from optuna_tpu.models.benchmarks import zdt1
@@ -268,7 +279,7 @@ def run_baseline_nsga2(n_timed: int) -> tuple[float, float] | None:
             directions=["minimize", "minimize"],
             sampler=optuna.samplers.NSGAIISampler(seed=0, population_size=50),
         )
-        study.optimize(zdt1, n_trials=10)
+        study.optimize(zdt1, n_trials=n_warmup)
         t0 = time.time()
         study.optimize(zdt1, n_trials=n_timed)
         dt = time.time() - t0
@@ -276,6 +287,29 @@ def run_baseline_nsga2(n_timed: int) -> tuple[float, float] | None:
     except Exception as e:  # pragma: no cover
         _log(f"baseline failed: {e!r}")
         return None
+
+
+def _log_probe_event(event: str) -> None:
+    """Append a timestamped probe event to the watchdog log so a dead tunnel
+    leaves evidence (VERDICT r2: 'log probe timestamps to a file')."""
+    try:
+        path = os.environ.get(
+            "OPTUNA_TPU_PROBE_LOG",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_probe_log.jsonl"),
+        )
+        with open(path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                        "unix": round(time.time(), 1),
+                        "event": event,
+                    }
+                )
+                + "\n"
+            )
+    except OSError:
+        pass
 
 
 def _probe_backend_once(timeout_s: int) -> tuple[bool, str]:
@@ -323,13 +357,17 @@ def _ensure_responsive_backend() -> None:
         return
     retries = max(1, int(os.environ.get("OPTUNA_TPU_BENCH_PROBE_RETRIES", "3")))
     for attempt in range(retries):
+        _log_probe_event(f"probe_start attempt={attempt + 1}/{retries}")
         ok, detail = _probe_backend_once(timeout_s=180)
         if ok:
+            _log_probe_event("probe_ok")
             return  # backend answers; proceed normally
         _log(f"accelerator probe {attempt + 1}/{retries} failed: {detail}")
+        _log_probe_event(f"probe_fail {detail[:200]}")
         if attempt + 1 < retries:
             time.sleep(20.0)  # let a restarting tunnel come back
     _log("accelerator backend unresponsive after retries; falling back to CPU")
+    _log_probe_event("fallback_to_cpu")
     env = dict(os.environ)
     env["OPTUNA_TPU_BENCH_CPU_FALLBACK"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
@@ -349,25 +387,25 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.config == "gp":
-        n_warm, n_timed = (12, 24) if args.quick else (20, 48)
+        n_warm, n_timed = (12, 24) if args.quick else (50, 100)
         _log("running ours (GPSampler / 20D Hartmann, ask-ahead chain=8)...")
         ours_rate, ours_best = run_ours_gp(n_warm, n_timed, chain=8)
         _log(f"ours: {ours_rate:.3f} trials/s (best {ours_best:.4f}); running baseline...")
-        base = run_baseline_gp(n_timed)
+        base = run_baseline_gp(n_warm, n_timed)
         metric = "gp_sampler_trials_per_sec_hartmann20d"
     elif args.config == "gp_batch":
         n_warm, n_timed = (16, 32) if args.quick else (32, 64)
         _log("running ours (GPSampler / 20D Hartmann, q=16 batch ask)...")
         ours_rate, ours_best = run_ours_gp(n_warm, n_timed, chain=16)
         _log(f"ours: {ours_rate:.3f} trials/s (best {ours_best:.4f}); running baseline...")
-        base = run_baseline_gp(n_timed)
+        base = run_baseline_gp(n_warm, n_timed)
         metric = "gp_batch_trials_per_sec_hartmann20d"
     elif args.config == "tpe":
         n_warm, n_timed = (30, 100) if args.quick else (50, 300)
         _log("running ours (TPESampler / Branin)...")
         ours_rate, ours_best = run_ours_tpe(n_warm, n_timed)
         _log(f"ours: {ours_rate:.3f} trials/s; running baseline...")
-        base = run_baseline_tpe(n_timed)
+        base = run_baseline_tpe(n_warm, n_timed)
         metric = "tpe_sampler_trials_per_sec_branin"
     elif args.config == "cmaes":
         n_warm, n_timed = (100, 400) if args.quick else (500, 2000)
@@ -382,7 +420,7 @@ def main() -> None:
     else:
         n_warm, n_timed = (60, 100) if args.quick else (100, 300)
         ours_rate, ours_best = run_ours_nsga2(n_warm, n_timed)
-        base = run_baseline_nsga2(n_timed)
+        base = run_baseline_nsga2(n_warm, n_timed)
         metric = "nsga2_trials_per_sec_zdt1"
 
     if base is not None:

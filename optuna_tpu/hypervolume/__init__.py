@@ -3,9 +3,68 @@
 Parity target: ``optuna/_hypervolume/`` (2D O(N log N) scan and 3D O(N^2)
 cummin trick ``wfg.py:8-39``, ND WFG recursion ``wfg.py:41-107``, greedy HSSP
 ``hssp.py:45,143``, box decomposition for EHVI ``box_decomposition.py``).
+
+Dispatch: the host NumPy implementations are authoritative for small inputs
+(one device round trip costs more than the whole computation there); large
+fronts at M >= 3 route to the fixed-shape device kernels in
+:mod:`optuna_tpu.ops.hypervolume`, where the branch-free slicing pipeline
+beats the host recursion by orders of magnitude.
 """
 
-from optuna_tpu.hypervolume.hssp import solve_hssp
-from optuna_tpu.hypervolume.wfg import compute_hypervolume
+from __future__ import annotations
+
+import numpy as np
+
+from optuna_tpu.hypervolume.hssp import solve_hssp as _solve_hssp_host
+from optuna_tpu.hypervolume.wfg import _pareto_filter
+from optuna_tpu.hypervolume.wfg import compute_hypervolume as _compute_hypervolume_host
+
+# Device routing thresholds, set so the device path wins even across a
+# tunneled (~100 ms/dispatch) TPU: the host recursion is O(front^2)-ish at
+# M=3 but blows up combinatorially at M=4 (measured: 2.4 s for a 256-point
+# 4D front vs 73 ms on device). M >= 5 stays on host: the slicing pipeline's
+# deterministic O(N^{M-1}) is unmeasured there and would dwarf the host
+# recursion's pruned average case.
+_DEVICE_MIN_FRONT = {3: 1024, 4: 128}
+
+
+def compute_hypervolume(
+    loss_vals: np.ndarray, reference_point: np.ndarray, assume_pareto: bool = False
+) -> float:
+    """Hypervolume dominated by ``loss_vals`` w.r.t. ``reference_point``.
+
+    Routed entry (reference ``optuna/_hypervolume/wfg.py:110``): host NumPy
+    below the thresholds, device slicing kernel above them.
+    """
+    loss_vals = np.asarray(loss_vals, dtype=np.float64)
+    reference_point = np.asarray(reference_point, dtype=np.float64)
+    m = loss_vals.shape[1] if loss_vals.ndim == 2 else 0
+    threshold = _DEVICE_MIN_FRONT.get(m)
+    if threshold is not None and len(loss_vals) >= threshold:
+        if np.any(np.isnan(loss_vals)):
+            raise ValueError("loss_vals must not contain NaN.")
+        inside = np.all(loss_vals < reference_point, axis=1)
+        front = loss_vals[inside] if assume_pareto else _pareto_filter(loss_vals[inside])
+        if len(front) >= threshold:
+            from optuna_tpu.ops.hypervolume import hypervolume_nd
+
+            return hypervolume_nd(front, reference_point)
+        return _compute_hypervolume_host(front, reference_point, assume_pareto=True)
+    return _compute_hypervolume_host(loss_vals, reference_point, assume_pareto)
+
+
+def solve_hssp(
+    rank_i_loss_vals: np.ndarray, reference_point: np.ndarray, subset_size: int
+) -> np.ndarray:
+    """Greedy hypervolume subset selection, routed like
+    :func:`compute_hypervolume` (reference ``optuna/_hypervolume/hssp.py:45``)."""
+    rank_i_loss_vals = np.asarray(rank_i_loss_vals, dtype=np.float64)
+    m = rank_i_loss_vals.shape[1] if rank_i_loss_vals.ndim == 2 else 0
+    if m in (3, 4) and len(rank_i_loss_vals) >= 128 and subset_size < len(rank_i_loss_vals):
+        from optuna_tpu.ops.hypervolume import solve_hssp_device
+
+        return solve_hssp_device(rank_i_loss_vals, reference_point, subset_size)
+    return _solve_hssp_host(rank_i_loss_vals, reference_point, subset_size)
+
 
 __all__ = ["compute_hypervolume", "solve_hssp"]

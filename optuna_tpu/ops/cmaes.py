@@ -362,4 +362,29 @@ def state_from_bytes(data: bytes) -> tuple[CmaState, dict[str, np.ndarray]]:
     with np.load(io.BytesIO(data)) as z:
         leaves = [z[f"f{i}"] for i in range(len(CmaState._fields))]
         extra = {k[2:]: z[k] for k in z.files if k.startswith("x_")}
-    return CmaState(*[jnp.asarray(a) for a in leaves]), extra
+    with _device_policy.small_kernel_scope():
+        return CmaState(*[jnp.asarray(a) for a in leaves]), extra
+
+
+# CMA updates at HPO-typical sizes (d <= a few hundred, popsize <= 100s) are
+# dispatch-latency-bound: route them to the host CPU backend when the default
+# backend is remote (~70 ms/round-trip on the axon tunnel — the difference
+# between 25 and hundreds of trials/s). On a local backend this is a no-op.
+from optuna_tpu import _device_policy  # noqa: E402  (import-cycle-safe tail import)
+import functools as _functools  # noqa: E402
+
+
+def _latency_scoped(fn):
+    @_functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _device_policy.small_kernel_scope():
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+cma_init = _latency_scoped(cma_init)
+cma_ask = _latency_scoped(cma_ask)
+cma_tell = _latency_scoped(cma_tell)
+cma_tell_and_ask = _latency_scoped(cma_tell_and_ask)
+apply_margin = _latency_scoped(apply_margin)

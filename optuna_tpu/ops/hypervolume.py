@@ -1,15 +1,37 @@
-"""Device-side hypervolume kernels for the 2-objective hot paths.
+"""Device-side exact hypervolume kernels (2D fast paths + general N-D).
 
-The exact general-dimension WFG recursion stays on host
-(:mod:`optuna_tpu.hypervolume.wfg`); the 2D case — which covers ZDT-style
-benchmarks, MOTPE's HSSP weights and NSGA's indicator logging — vectorizes
-fully: after sorting by the first objective, the dominated area is a prefix
-scan, and every point's exclusive contribution is a closed-form box. Both
-compile to single XLA programs and are cross-checked against the host WFG in
-tests.
+Parity target: the reference's exact hypervolume stack
+(``optuna/_hypervolume/wfg.py:8-110``, ``hssp.py:45,143``). The reference
+computes N-D hypervolume with the WFG *recursion* — data-dependent branching
+over shrinking Pareto-filtered subsets — which cannot compile to a fixed
+XLA program. Instead of translating it, the N-D kernel here uses an
+**objective-sweep slicing decomposition with masked prefix scans**:
+
+* sort once per level by the leading objective (full set, mask-independent);
+* the M-D volume is ``sum_i (ref_0 - v_i0) * (A_i - A_{i-1})`` by Abel
+  summation of the slab integral, where ``A_i`` is the (M-1)-D hypervolume
+  of the i-prefix — every prefix is just a *mask*, so all N subproblems
+  share one sorted layout and evaluate as a ``vmap``/``lax.map`` batch;
+* the 2-D base case is an O(N) cummin scan that tolerates masked-out rows
+  pushed to the reference point (they contribute zero width and cannot
+  lower the running minimum), so no per-mask re-sort is ever needed.
+
+Cost is a deterministic O(N^{M-1}) elementwise pipeline — bigger than WFG's
+best case, but branch-free, fixed-shape, and entirely on the VPU; at real
+archive sizes (N >= 256 fronts, M in {3, 4}) it beats the host recursion by
+orders of magnitude (see ``tests/test_hypervolume.py``). Dominated points,
+duplicates, and points beyond the reference contribute zero natively — no
+Pareto pre-filtering required.
+
+The same masked kernel powers greedy HSSP subset selection: each greedy step
+scores every candidate's joint hypervolume with the current selection in one
+``vmap`` over (N, k+1, M) boxes — the device replacement for the reference's
+sequential lazy-contribution heap.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -81,3 +103,190 @@ def hypervolume_2d_contributions(
 
     contrib_sorted = jax.vmap(one)(jnp.arange(n))
     return jnp.zeros(n, pts.dtype).at[order].set(contrib_sorted)
+
+
+# ------------------------------------------------------------------ N-D exact
+
+
+def _hv2_scan(a, b, ref_a, ref_b, m):
+    """Masked 2D hypervolume given ``a`` ascending-sorted over the FULL set.
+
+    Masked-out rows are pushed to the reference point: zero width, and their
+    second coordinate (== ref_b) can never lower the running minimum, so the
+    interleaving leaves the scan exact for the masked-in subsequence.
+    """
+    x = jnp.where(m, a, ref_a)
+    y = jnp.where(m, b, ref_b)
+    y_cummin_prev = jnp.concatenate([ref_b[None], jax.lax.cummin(y)[:-1]])
+    height = y_cummin_prev - jnp.minimum(y, y_cummin_prev)
+    width = jnp.maximum(ref_a - x, 0.0)
+    return jnp.sum(width * height)
+
+
+def _hv_sliced(points, ref, m, d):
+    """Exact hypervolume of masked rows over objectives ``d..M-1``.
+
+    Abel-summed slab decomposition: with rows sorted by objective ``d`` and
+    ``A_i`` the (M-1)-D hypervolume of the masked i-prefix,
+    ``HV = sum_i masked_i * (ref_d - v_id) * (A_i - A_{i-1})``. Unmasked rows
+    have ``A_i == A_{i-1}`` and drop out; ties in objective ``d`` telescope.
+    """
+    n, total_m = points.shape
+    rem = total_m - d
+    if rem == 1:
+        vals = jnp.where(m, points[:, d], ref[d])
+        return jnp.maximum(ref[d] - jnp.min(vals), 0.0)
+    if rem == 2:
+        order = jnp.argsort(points[:, d])
+        return _hv2_scan(
+            points[order, d], points[order, d + 1], ref[d], ref[d + 1], m[order]
+        )
+    order = jnp.argsort(points[:, d])
+    ps, ms = points[order], m[order]
+    prefix = jnp.tril(jnp.ones((n, n), bool)) & ms[None, :]
+    if rem == 3:
+        # One shared sort by the next objective; every prefix is a mask.
+        sub_order = jnp.argsort(ps[:, d + 1])
+        a = ps[sub_order, d + 1]
+        b = ps[sub_order, d + 2]
+        sub = jax.vmap(lambda mk: _hv2_scan(a, b, ref[d + 1], ref[d + 2], mk[sub_order]))(
+            prefix
+        )
+    else:
+        # Sequential map bounds peak memory at O(N^2) per level.
+        sub = jax.lax.map(lambda mk: _hv_sliced(ps, ref, mk, d + 1), prefix)
+    sub_prev = jnp.concatenate([jnp.zeros((1,), sub.dtype), sub[:-1]])
+    width = jnp.maximum(ref[d] - ps[:, d], 0.0)
+    return jnp.sum(jnp.where(ms, width * (sub - sub_prev), 0.0))
+
+
+@jax.jit
+def hypervolume_masked(points: jnp.ndarray, reference_point: jnp.ndarray, mask: jnp.ndarray):
+    """Exact hypervolume (minimization) of masked rows of (N, M) ``points``.
+
+    Fixed-shape: dominated rows, duplicates, and rows outside the reference
+    point contribute zero without any pre-filtering, so callers can pad
+    freely. Matches the host WFG (``optuna_tpu.hypervolume.wfg``) to
+    float32 accuracy for any M >= 1.
+    """
+    inside = jnp.all(points < reference_point[None, :], axis=1)
+    return _hv_sliced(points, reference_point, mask & inside, 0)
+
+
+@jax.jit
+def hypervolume_loo_contributions(
+    points: jnp.ndarray, reference_point: jnp.ndarray, mask: jnp.ndarray
+):
+    """Exclusive (leave-one-out) contribution of every masked row, (N,).
+
+    ``contrib_i = HV(S) - HV(S \\ {i})`` evaluated as a batch of masked
+    kernels — the device replacement for N sequential host WFG calls in
+    MOTPE's weight computation (reference ``_tpe/sampler.py:873``).
+    """
+    n = points.shape[0]
+    total = hypervolume_masked(points, reference_point, mask)
+    eye = jnp.eye(n, dtype=bool)
+    loo = jax.lax.map(
+        lambda drop: _hv_sliced(
+            points,
+            reference_point,
+            mask
+            & ~drop
+            & jnp.all(points < reference_point[None, :], axis=1),
+            0,
+        ),
+        eye,
+    )
+    return jnp.where(mask, jnp.maximum(total - loo, 0.0), 0.0)
+
+
+@partial(jax.jit, static_argnames=("k_pad",))
+def _hssp_greedy(points, reference_point, mask, k, k_pad):
+    """Greedy HSSP on device: ``k`` steps, each scoring all N candidates'
+    joint hypervolume with the current selection in one vmapped batch.
+
+    Plain greedy — identical selections to the reference's lazy-greedy heap
+    (``optuna/_hypervolume/hssp.py:45``; laziness only reorders evaluations).
+    ``k_pad`` bounds the selection buffer so the compiled program is reused
+    across nearby subset sizes; unused rows sit at the reference point and
+    contribute nothing.
+    """
+    n, m_dim = points.shape
+    sel = jnp.broadcast_to(reference_point, (k_pad, m_dim))
+    chosen = jnp.full((k_pad,), -1, jnp.int32)
+    all_true = jnp.ones((k_pad + 1,), bool)
+
+    def body(step, state):
+        sel, avail, chosen, hv_sel = state
+        cand = jnp.concatenate(
+            [jnp.broadcast_to(sel[None], (n, k_pad, m_dim)), points[:, None, :]], axis=1
+        )
+        hvs = jax.vmap(lambda s: hypervolume_masked(s, reference_point, all_true))(cand)
+        gains = jnp.where(avail, hvs - hv_sel, -jnp.inf)
+        i = jnp.argmax(gains)
+        return (
+            sel.at[step].set(points[i]),
+            avail.at[i].set(False),
+            chosen.at[step].set(i),
+            jnp.maximum(hvs[i], hv_sel),
+        )
+
+    sel, _, chosen, _ = jax.lax.fori_loop(
+        0, k, body, (sel, mask, chosen, jnp.zeros((), points.dtype))
+    )
+    return chosen
+
+
+def solve_hssp_device(
+    points: np.ndarray, reference_point: np.ndarray, subset_size: int
+) -> np.ndarray:
+    """Host entry for device greedy HSSP; returns selected indices (k,)."""
+    n = len(points)
+    k = int(min(subset_size, n))
+    if k <= 0:
+        return np.arange(0)
+    if k >= n:
+        return np.arange(n)
+    k_pad = 1 << max(0, (k - 1)).bit_length()  # power-of-two jit bucket
+    pts, mask = _padded(points, reference_point)
+    chosen = _hssp_greedy(
+        pts,
+        jnp.asarray(reference_point, jnp.float32),
+        mask,
+        k,
+        k_pad,
+    )
+    return np.asarray(chosen)[:k].astype(np.int64)
+
+
+def _pad_bucket(n: int) -> int:
+    """Power-of-two N bucket (min 32) so growing fronts reuse compiled
+    programs instead of retracing the O(N^2)-shaped pipeline every call."""
+    return max(32, 1 << max(0, (n - 1)).bit_length())
+
+
+def _padded(points: np.ndarray, reference_point: np.ndarray):
+    n = len(points)
+    n_pad = _pad_bucket(n)
+    pts = np.full((n_pad, points.shape[1]), np.asarray(reference_point), np.float32)
+    pts[:n] = points
+    mask = np.zeros(n_pad, bool)
+    mask[:n] = True
+    return jnp.asarray(pts), jnp.asarray(mask)
+
+
+def hypervolume_nd(points: np.ndarray, reference_point: np.ndarray) -> float:
+    """Host entry: exact N-D hypervolume on device (N bucketed, any M)."""
+    pts, mask = _padded(points, reference_point)
+    return float(
+        hypervolume_masked(pts, jnp.asarray(reference_point, jnp.float32), mask)
+    )
+
+
+def hypervolume_loo_nd(points: np.ndarray, reference_point: np.ndarray) -> np.ndarray:
+    """Host entry: leave-one-out contributions, (len(points),), N bucketed."""
+    pts, mask = _padded(points, reference_point)
+    out = hypervolume_loo_contributions(
+        pts, jnp.asarray(reference_point, jnp.float32), mask
+    )
+    return np.asarray(out)[: len(points)]

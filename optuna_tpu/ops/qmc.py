@@ -2,39 +2,62 @@
 
 The reference uses SciPy's compiled Sobol (``optuna/_gp/search_space.py:184``,
 ``samplers/_qmc.py:303``) and torch's SobolEngine + erfinv for normal QMC
-(``optuna/_gp/qmc.py:18``). Candidate generation is a once-per-trial, host-side
-operation with dynamic n, so we keep SciPy's scrambled Sobol on host and ship
-the points to the device as one array; the *transformations* (normal inverse
-CDF etc.) run on device.
+(``optuna/_gp/qmc.py:18``). Two tiers here:
+
+* **Host tier** (``sobol_sample`` / ``halton_sample``): SciPy engines for
+  once-per-trial candidate generation with dynamic n. Only engine
+  *construction* is serialized (SciPy lazily populates module-global
+  direction-number tables on first use); generation on independent engines
+  runs lock-free, so concurrent samplers (``n_jobs>1`` QMCSampler threads)
+  no longer contend.
+* **Device tier** (``sobol_sample_device``): native XLA Sobol — direction
+  numbers are extracted once per dimension on host (precomputed constants,
+  as the native-backend ledger prescribes) and the points are produced on
+  device by a Gray-code XOR pipeline with optional digital-shift
+  scrambling. This generates e.g. the GP sampler's candidate pool directly
+  in HBM with zero host->device payload.
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 
 import numpy as np
 
-_sobol_lock = threading.Lock()  # SciPy Sobol engines are not thread-safe
+_sobol_init_lock = threading.Lock()  # guards SciPy's lazy direction-table init
+_tables_ready: set[str] = set()  # engine kinds whose lazy init has completed
+
+_MAXBIT = 30  # SciPy direction numbers are scaled to 2^30
+_direction_cache: dict[int, np.ndarray] = {}
+
+
+def _make_engine(kind: str, dim: int, seed: int | None):
+    """Construct a SciPy QMC engine; first-ever construction is locked while
+    SciPy fills its module-level tables, later ones are thread-safe."""
+    from scipy.stats import qmc
+
+    cls = qmc.Sobol if kind == "sobol" else qmc.Halton
+    kwargs = {"d": dim, "scramble": True, "seed": seed}
+    if kind not in _tables_ready:
+        with _sobol_init_lock:
+            engine = cls(**kwargs)
+            _tables_ready.add(kind)
+            return engine
+    return cls(**kwargs)
 
 
 def sobol_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
     """n scrambled-Sobol points in [0, 1)^dim (n need not be a power of two)."""
-    from scipy.stats import qmc
-
-    with _sobol_lock:
-        engine = qmc.Sobol(d=dim, scramble=True, seed=seed)
-        # Sobol balance prefers powers of two; round up then truncate.
-        m = int(np.ceil(np.log2(max(n, 1))))
-        pts = engine.random_base2(m=m) if n > 1 else engine.random(1)
+    engine = _make_engine("sobol", dim, seed)
+    # Sobol balance prefers powers of two; round up then truncate.
+    m = int(np.ceil(np.log2(max(n, 1))))
+    pts = engine.random_base2(m=m) if n > 1 else engine.random(1)
     return pts[:n]
 
 
 def halton_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
-    from scipy.stats import qmc
-
-    with _sobol_lock:
-        engine = qmc.Halton(d=dim, scramble=True, seed=seed)
-        return engine.random(n)
+    return _make_engine("halton", dim, seed).random(n)
 
 
 def normal_qmc_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
@@ -45,3 +68,60 @@ def normal_qmc_sample(n: int, dim: int, seed: int | None = None) -> np.ndarray:
     # Keep strictly inside (0, 1) so ndtri stays finite.
     eps = np.finfo(np.float64).eps
     return ndtri(np.clip(u, eps, 1 - eps))
+
+
+# ------------------------------------------------------------- device tier
+
+
+def _direction_numbers(dim: int) -> np.ndarray:
+    """(dim, 30) uint32 Sobol direction vectors (Joe-Kuo via SciPy), cached."""
+    cached = _direction_cache.get(dim)
+    if cached is None:
+        from scipy.stats import qmc
+
+        with _sobol_init_lock:
+            cached = np.ascontiguousarray(
+                qmc.Sobol(d=dim, scramble=False)._sv[:, :_MAXBIT].astype(np.uint32)
+            )
+        _direction_cache[dim] = cached
+    return cached
+
+
+def _sobol_device_kernel(sv, shift, n: int):
+    import jax.numpy as jnp
+
+    i = jnp.arange(n, dtype=jnp.uint32)
+    gray = i ^ (i >> 1)
+    acc = jnp.zeros((n, sv.shape[0]), dtype=jnp.uint32)
+    for b in range(_MAXBIT):  # unrolled XOR pipeline; XLA fuses it flat
+        bit = ((gray >> np.uint32(b)) & np.uint32(1)).astype(jnp.uint32)
+        acc = acc ^ (bit[:, None] * sv[None, :, b])
+    acc = acc ^ shift[None, :]
+    return acc.astype(jnp.float32) * np.float32(2.0**-_MAXBIT)
+
+
+def sobol_sample_device(n: int, dim: int, key=None):
+    """n Sobol points in [0, 1)^dim generated ON DEVICE, (n, dim) float32.
+
+    ``key`` (a ``jax.random`` key) applies a digital-shift scramble; None
+    yields the raw sequence (first point at the origin), matching SciPy's
+    ``scramble=False`` stream bit-for-bit.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sv = jnp.asarray(_direction_numbers(dim))
+    if key is None:
+        shift = jnp.zeros((dim,), jnp.uint32)
+    else:
+        shift = jax.random.randint(
+            key, (dim,), 0, np.int64(1) << _MAXBIT, dtype=jnp.uint32
+        )
+    return _sobol_jit()(sv, shift, n)
+
+
+@functools.lru_cache(maxsize=None)
+def _sobol_jit():
+    import jax
+
+    return jax.jit(_sobol_device_kernel, static_argnames=("n",))

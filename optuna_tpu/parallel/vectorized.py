@@ -99,11 +99,16 @@ def optimize_vectorized(
     else:
         compiled = jax.jit(objective.fn)
 
+    n_dev = len(mesh.devices.flat) if mesh is not None else 1
     done = 0
     while done < n_trials:
         b = min(batch_size, n_trials - done)
-        if mesh is not None and b < batch_size:
-            b_eval = batch_size  # keep the sharded shape; extra slots are waste
+        if mesh is not None and b % n_dev != 0:
+            # Ragged tail: pad only to the next device-count multiple (the
+            # minimum SPMD-valid shape), not the full batch — a 257th trial
+            # costs at most n_dev-1 wasted evals, not batch_size-1. The tail
+            # shape jit-compiles once and is reused across studies.
+            b_eval = ((b + n_dev - 1) // n_dev) * n_dev
         else:
             b_eval = b
 
@@ -114,15 +119,14 @@ def optimize_vectorized(
             proposals = study.sampler.sample_relative_batch(
                 study, objective.search_space, b
             )
-        trials = []
-        for i in range(b):
-            t = study.ask()
+        # One storage commit creates the whole batch of trials.
+        trials = study.ask_batch(b)
+        for i, t in enumerate(trials):
             if proposals is not None:
                 t.relative_search_space = objective.search_space
                 t.relative_params = proposals[i]
             for name, dist in objective.search_space.items():
                 t._suggest(name, dist)
-            trials.append(t)
 
         packed = _pack_params(trials, objective.search_space)
         if b_eval > b:

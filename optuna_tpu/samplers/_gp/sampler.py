@@ -568,11 +568,25 @@ class _DeviceSpace:
         import jax.numpy as jnp
 
         from optuna_tpu.gp.optim_mixed import _sweep_tables, continuous_bounds
-        from optuna_tpu.ops.qmc import sobol_sample
+        from optuna_tpu.ops.qmc import sobol_sample_device
 
         d = space.dim
-        base = sobol_sample(n_preliminary, d, seed=0)
-        self.sobol_base = jnp.asarray(base, dtype=jnp.float32)
+        # Native device Sobol (digital-shift scrambled, deterministic key):
+        # the pool is born in HBM — no host generation, no upload. Direction
+        # numbers come from SciPy internals; if a SciPy release moves them,
+        # fall back to the host engine + one-time upload.
+        import jax
+
+        try:
+            self.sobol_base = sobol_sample_device(
+                n_preliminary, d, key=jax.random.PRNGKey(0)
+            ).astype(jnp.float32)
+        except AttributeError:  # pragma: no cover - scipy moved its internals
+            from optuna_tpu.ops.qmc import sobol_sample
+
+            self.sobol_base = jnp.asarray(
+                sobol_sample(n_preliminary, d, seed=0), dtype=jnp.float32
+            )
         self.cat_mask = jnp.asarray(np.asarray(space.is_categorical).astype(bool))
         cont_mask, lower, upper = continuous_bounds(space)
         self.cont_mask = jnp.asarray(cont_mask, dtype=jnp.float32)

@@ -281,12 +281,15 @@ class TPESampler(BaseSampler):
         below_pack = stack(below_est, ordered)
         above_pack = stack(above_est, ordered)
         seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
-        num_out, cat_out = _kernels.sample_and_score_univariate_batch(
-            seed,
-            {k: jnp.asarray(v) for k, v in below_pack.items()},
-            {k: jnp.asarray(v) for k, v in above_pack.items()},
-            self._n_ei_candidates,
-        )
+        from optuna_tpu._device_policy import small_kernel_scope
+
+        with small_kernel_scope():  # KDE kernels are dispatch-latency-bound
+            num_out, cat_out = _kernels.sample_and_score_univariate_batch(
+                seed,
+                {k: jnp.asarray(v) for k, v in below_pack.items()},
+                {k: jnp.asarray(v) for k, v in above_pack.items()},
+                self._n_ei_candidates,
+            )
         num_out, cat_out = jax.device_get((num_out, cat_out))
         num_out = np.asarray(num_out)
         cat_out = np.asarray(cat_out)
@@ -354,12 +357,15 @@ class TPESampler(BaseSampler):
         import jax.numpy as jnp
 
         seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
-        x_num, x_cat, _ = _kernels.sample_and_score(
-            seed,
-            {k: jnp.asarray(v) for k, v in below.pack().items()},
-            {k: jnp.asarray(v) for k, v in above.pack().items()},
-            self._n_ei_candidates,
-        )
+        from optuna_tpu._device_policy import small_kernel_scope
+
+        with small_kernel_scope():
+            x_num, x_cat, _ = _kernels.sample_and_score(
+                seed,
+                {k: jnp.asarray(v) for k, v in below.pack().items()},
+                {k: jnp.asarray(v) for k, v in above.pack().items()},
+                self._n_ei_candidates,
+            )
         x_num, x_cat = jax.device_get((x_num, x_cat))
         internal = below.decode(np.asarray(x_num), np.asarray(x_cat))
         return {
@@ -417,13 +423,16 @@ class TPESampler(BaseSampler):
         below = self._build_parzen(below_trials, study, search_space, below=True)
         above = self._build_parzen(above_trials, study, search_space, below=False)
         seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
-        x_num, x_cat = _kernels.sample_and_score_topk(
-            seed,
-            {k: jnp.asarray(v) for k, v in below.pack().items()},
-            {k: jnp.asarray(v) for k, v in above.pack().items()},
-            max(self._n_ei_candidates, 4 * n),
-            n,
-        )
+        from optuna_tpu._device_policy import small_kernel_scope
+
+        with small_kernel_scope():
+            x_num, x_cat = _kernels.sample_and_score_topk(
+                seed,
+                {k: jnp.asarray(v) for k, v in below.pack().items()},
+                {k: jnp.asarray(v) for k, v in above.pack().items()},
+                max(self._n_ei_candidates, 4 * n),
+                n,
+            )
         x_num, x_cat = jax.device_get((x_num, x_cat))
         out = []
         for i in range(n):
@@ -571,7 +580,7 @@ def _split_complete_trials_multi_objective(
     (reference ``_split_trials`` -> ``_solve_hssp``)."""
     if n_below == 0:
         return [], trials
-    from optuna_tpu.hypervolume.hssp import solve_hssp
+    from optuna_tpu.hypervolume import solve_hssp  # routed: device greedy at scale
     from optuna_tpu.study._multi_objective import (
         _fast_non_domination_rank,
         _normalize_values,
@@ -669,6 +678,13 @@ def _calculate_weights_below_for_multi_objective(
                 jnp.asarray(ref_point, dtype=jnp.float32),
             )
         )
+        contributions[finite_idx] = np.maximum(contrib, 0.0)
+    elif loss_vals.shape[1] in (3, 4) and len(finite_idx) >= 64:
+        # Large M in {3,4} sets: all leave-one-out contributions in one
+        # N-bucketed device program instead of n sequential host recursions.
+        from optuna_tpu.ops.hypervolume import hypervolume_loo_nd
+
+        contrib = hypervolume_loo_nd(loss_vals[finite], ref_point)
         contributions[finite_idx] = np.maximum(contrib, 0.0)
     else:
         hv_total = compute_hypervolume(loss_vals[finite], ref_point)

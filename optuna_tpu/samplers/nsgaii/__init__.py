@@ -7,12 +7,15 @@ from optuna_tpu.samplers.nsgaii._crossovers import (
     UniformCrossover,
     VSBXCrossover,
 )
+from optuna_tpu.samplers.nsgaii._mutations import BaseMutation, PolynomialMutation
 from optuna_tpu.samplers.nsgaii._sampler import NSGAIISampler
 
 __all__ = [
     "BLXAlphaCrossover",
     "BaseCrossover",
+    "BaseMutation",
     "NSGAIISampler",
+    "PolynomialMutation",
     "SBXCrossover",
     "SPXCrossover",
     "UNDXCrossover",

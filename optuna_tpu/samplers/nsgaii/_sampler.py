@@ -20,6 +20,7 @@ from optuna_tpu.samplers._lazy_random_state import LazyRandomState
 from optuna_tpu.samplers._random import RandomSampler
 from optuna_tpu.samplers.nsgaii._crossovers import BaseCrossover, UniformCrossover
 from optuna_tpu.samplers.nsgaii._elite import select_elite_population
+from optuna_tpu.samplers.nsgaii._mutations import BaseMutation, perform_mutation
 from optuna_tpu.search_space import IntersectionSearchSpace
 from optuna_tpu.transform import SearchSpaceTransform
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -63,6 +64,7 @@ class NSGAIISampler(BaseGASampler):
         self,
         *,
         population_size: int = 50,
+        mutation: BaseMutation | None = None,
         mutation_prob: float | None = None,
         crossover: BaseCrossover | None = None,
         crossover_prob: float = 0.9,
@@ -75,7 +77,10 @@ class NSGAIISampler(BaseGASampler):
     ) -> None:
         if population_size < 2:
             raise ValueError("`population_size` must be greater than or equal to 2.")
+        if mutation is not None and not isinstance(mutation, BaseMutation):
+            raise ValueError(f"'{mutation}' is not a valid mutation.")
         super().__init__(population_size=population_size)
+        self._mutation = mutation
         self._mutation_prob = mutation_prob
         self._crossover = crossover or UniformCrossover(swapping_prob)
         self._crossover_prob = crossover_prob
@@ -135,7 +140,11 @@ class NSGAIISampler(BaseGASampler):
                 name: p0.params[name] for name in search_space if name in p0.params
             }
 
-        # Mutation: resample each param uniformly with prob 1/d by default.
+        # Mutation: per-gene with prob 1/d by default; the pluggable operator
+        # perturbs numerical genes in transformed space, everything else (and
+        # the default) resamples uniformly — matching the reference's
+        # drop-then-independent-resample semantics
+        # (``nsgaii/_child_generation_strategy.py:104-122``).
         mutation_prob = (
             self._mutation_prob
             if self._mutation_prob is not None
@@ -143,9 +152,17 @@ class NSGAIISampler(BaseGASampler):
         )
         for name, dist in search_space.items():
             if name not in child_params or rng.rand() < mutation_prob:
-                child_params[name] = self._random_sampler.sample_independent(
-                    study, trial, name, dist
-                )
+                mutated = None
+                if self._mutation is not None and name in child_params:
+                    mutated = perform_mutation(
+                        self._mutation, rng, study, dist, child_params[name]
+                    )
+                if mutated is not None:
+                    child_params[name] = mutated
+                else:
+                    child_params[name] = self._random_sampler.sample_independent(
+                        study, trial, name, dist
+                    )
         return child_params
 
     def _tournament_select(

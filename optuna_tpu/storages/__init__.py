@@ -78,11 +78,16 @@ def get_storage(storage: Union[None, str, BaseStorage]) -> BaseStorage:
             path = storage[len("journal://"):] if storage.startswith("journal://") else storage
             return JournalStorage(JournalFileBackend(path))
         if storage.startswith("grpc://"):
+            from optuna_tpu.storages._cached_storage import _CachedStorage
             from optuna_tpu.storages._grpc.client import GrpcStorageProxy
 
             hostport = storage[len("grpc://"):]
             host, _, port = hostport.partition(":")
-            return GrpcStorageProxy(host=host or "localhost", port=int(port or 13000))
+            # Cached wrap: sampler history reads poll the proxy incrementally
+            # (_read_trials_partial) instead of shipping the full trial list.
+            return _CachedStorage(
+                GrpcStorageProxy(host=host or "localhost", port=int(port or 13000))
+            )
         raise ValueError(f"Unrecognized storage URL: {storage!r}")
     if isinstance(storage, BaseStorage):
         return storage

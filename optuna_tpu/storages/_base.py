@@ -17,6 +17,7 @@ import abc
 from typing import Any, Container, Sequence
 
 from optuna_tpu.distributions import BaseDistribution
+from optuna_tpu.exceptions import UpdateFinishedTrialError
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
 from optuna_tpu.trial._state import TrialState
@@ -166,6 +167,58 @@ class BaseStorage(abc.ABC):
         if directions[0] == StudyDirection.MAXIMIZE:
             return max(all_trials, key=lambda t: t.value)  # type: ignore[arg-type]
         return min(all_trials, key=lambda t: t.value)  # type: ignore[arg-type]
+
+    def create_new_trials(
+        self, study_id: int, n: int, template_trial: FrozenTrial | None = None
+    ) -> list[int]:
+        """Create ``n`` trials, returning their ids in creation order.
+
+        Batch-ask fast path for vectorized optimization: backends override to
+        amortize their commit cost (one lock/fsync/transaction for the whole
+        batch) while preserving per-trial id/number assignment semantics.
+        """
+        return [self.create_new_trial(study_id, template_trial) for _ in range(n)]
+
+    def _read_trials_partial(
+        self, study_id: int, max_known_trial_id: int, extra_ids: "Container[int] | set[int]"
+    ) -> list[FrozenTrial]:
+        """Incremental read: trials newer than ``max_known_trial_id`` plus the
+        explicitly listed (unfinished) ids.
+
+        The contract behind ``_CachedStorage``'s contiguous-watermark cache.
+        Backends override with an indexed query (RDB) or serve it remotely
+        (gRPC — keeping per-poll wire traffic proportional to *new* trials,
+        not study size); this generic version filters a full read.
+        """
+        extra = set(extra_ids)
+        return [
+            t
+            for t in self.get_all_trials(study_id, deepcopy=False)
+            if t._trial_id > max_known_trial_id or t._trial_id in extra
+        ]
+
+    # ------------------------------------------------- convenience accessors
+
+    def get_trial_params(self, trial_id: int) -> dict[str, Any]:
+        """Parameter dict (external repr) of a trial (reference ``_base.py:550``)."""
+        return self.get_trial(trial_id).params
+
+    def get_trial_user_attrs(self, trial_id: int) -> dict[str, Any]:
+        """User attributes of a trial (reference ``_base.py:566``)."""
+        return self.get_trial(trial_id).user_attrs
+
+    def get_trial_system_attrs(self, trial_id: int) -> dict[str, Any]:
+        """Framework-internal attributes of a trial (reference ``_base.py:583``)."""
+        return self.get_trial(trial_id).system_attrs
+
+    def check_trial_is_updatable(self, trial_id: int, trial_state: TrialState) -> None:
+        """Raise :exc:`UpdateFinishedTrialError` for finished trials
+        (reference ``_base.py:603``)."""
+        if trial_state.is_finished():
+            trial = self.get_trial(trial_id)
+            raise UpdateFinishedTrialError(
+                f"Trial#{trial.number} has already finished and can not be updated."
+            )
 
     # -------------------------------------------------------------- lifecycle
 

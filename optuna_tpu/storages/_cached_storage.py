@@ -1,8 +1,10 @@
-"""Per-process read cache over RDB storage.
+"""Per-process read cache over a remote-ish storage (RDB or gRPC proxy).
 
 Parity target: ``optuna/storages/_cached_storage.py:22-36`` — finished trials
 are immutable, so they are cached forever; unfinished trial ids are tracked
-and re-read on access; all writes delegate to the backend.
+and re-read on access; all writes delegate to the backend. Reads go through
+the backend's ``_read_trials_partial`` watermark API, so a wrapped gRPC
+proxy polls only *new* trials over the wire.
 """
 
 from __future__ import annotations
@@ -14,7 +16,6 @@ from typing import Any, Callable, Container, Sequence
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._heartbeat import BaseHeartbeat
-from optuna_tpu.storages._rdb.storage import RDBStorage
 from optuna_tpu.study._frozen import FrozenStudy
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -28,7 +29,7 @@ class _StudyCache:
 
 
 class _CachedStorage(BaseStorage, BaseHeartbeat):
-    def __init__(self, backend: RDBStorage) -> None:
+    def __init__(self, backend: BaseStorage) -> None:
         self._backend = backend
         self._studies: dict[int, _StudyCache] = {}
         self._lock = threading.Lock()
@@ -114,6 +115,17 @@ class _CachedStorage(BaseStorage, BaseHeartbeat):
 
     def set_trial_system_attr(self, trial_id: int, key: str, value: Any) -> None:
         self._backend.set_trial_system_attr(trial_id, key, value)
+
+    def create_new_trials(
+        self, study_id: int, n: int, template_trial: FrozenTrial | None = None
+    ) -> list[int]:
+        trial_ids = self._backend.create_new_trials(study_id, n, template_trial)
+        # Same cache registration as the single-create path: track as
+        # unfinished so refresh reads include them regardless of watermark.
+        with self._lock:
+            cache = self._studies.setdefault(study_id, _StudyCache())
+            cache.unfinished_trial_ids.update(trial_ids)
+        return trial_ids
 
     def get_trial(self, trial_id: int) -> FrozenTrial:
         with self._lock:

@@ -45,6 +45,7 @@ METHODS = (
     "get_study_system_attrs",
     "get_all_studies",
     "create_new_trial",
+    "create_new_trials",
     "set_trial_param",
     "get_trial_id_from_study_id_trial_number",
     "get_trial_number_from_id",
@@ -54,7 +55,11 @@ METHODS = (
     "set_trial_user_attr",
     "set_trial_system_attr",
     "get_trial",
+    "get_trial_params",
+    "get_trial_user_attrs",
+    "get_trial_system_attrs",
     "get_all_trials",
+    "_read_trials_partial",
     "get_n_trials",
     "get_best_trial",
     "record_heartbeat",

@@ -99,6 +99,12 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
     def create_new_trial(self, study_id: int, template_trial: FrozenTrial | None = None) -> int:
         return self._call("create_new_trial", study_id, template_trial)
 
+    def create_new_trials(
+        self, study_id: int, n: int, template_trial: FrozenTrial | None = None
+    ) -> list[int]:
+        # One RPC creates the whole batch server-side.
+        return self._call("create_new_trials", study_id, n, template_trial)
+
     def set_trial_param(
         self,
         trial_id: int,
@@ -130,6 +136,16 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
     def get_trial(self, trial_id: int) -> FrozenTrial:
         return self._call("get_trial", trial_id)
 
+    def get_trial_params(self, trial_id: int) -> dict[str, Any]:
+        # Attr-only wire fetch: smaller payload than shipping the FrozenTrial.
+        return self._call("get_trial_params", trial_id)
+
+    def get_trial_user_attrs(self, trial_id: int) -> dict[str, Any]:
+        return self._call("get_trial_user_attrs", trial_id)
+
+    def get_trial_system_attrs(self, trial_id: int) -> dict[str, Any]:
+        return self._call("get_trial_system_attrs", trial_id)
+
     def get_all_trials(
         self,
         study_id: int,
@@ -137,6 +153,16 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
         states: Container[TrialState] | None = None,
     ) -> list[FrozenTrial]:
         return self._call("get_all_trials", study_id, deepcopy, states)
+
+    def _read_trials_partial(
+        self, study_id: int, max_known_trial_id: int, extra_ids: Container[int]
+    ) -> list[FrozenTrial]:
+        # Incremental poll: the server filters, so the wire carries only new
+        # trials — wrap this proxy in _CachedStorage (get_storage does) and a
+        # 10k-trial study no longer ships megabytes per sampler read.
+        return self._call(
+            "_read_trials_partial", study_id, max_known_trial_id, sorted(set(extra_ids))
+        )
 
     # -------------------------------------------------------------- heartbeat
 

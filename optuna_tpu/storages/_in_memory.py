@@ -163,6 +163,13 @@ class InMemoryStorage(BaseStorage):
             self._update_cache(trial_id, study_id)
             return trial_id
 
+    def create_new_trials(
+        self, study_id: int, n: int, template_trial: FrozenTrial | None = None
+    ) -> list[int]:
+        # One lock acquisition for the whole batch.
+        with self._lock:
+            return [self.create_new_trial(study_id, template_trial) for _ in range(n)]
+
     def _get_trial_mutable(self, trial_id: int) -> tuple[FrozenTrial, int]:
         if trial_id not in self._trial_id_to_study_id_and_number:
             raise KeyError(f"No trial with trial_id {trial_id} exists.")

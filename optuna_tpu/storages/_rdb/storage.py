@@ -210,9 +210,12 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
             return url[len("rdb:///"):]
         if url.startswith(("mysql", "postgresql")):
             raise ValueError(
-                "Server databases are not supported by this sqlite-native RDBStorage; "
-                "use JournalStorage (file/redis) or the gRPC proxy storage for "
-                "multi-host studies."
+                f"Server databases are not supported by this sqlite-native RDBStorage "
+                f"(got {url.split('://')[0]!r}). For multi-host studies use "
+                f"JournalStorage(JournalFileBackend(path)) on a shared filesystem, "
+                f"JournalRedisBackend, or run_grpc_proxy_server() in front of any "
+                f"storage — see README 'Server databases (MySQL/PostgreSQL)' for the "
+                f"migration guide."
             )
         return url  # bare path
 
@@ -438,63 +441,88 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                 (study_id,),
             ).fetchone()
             number = int(row[0])
-            if template_trial is None:
-                cur = con.execute(
-                    "INSERT INTO trials (number, study_id, state, datetime_start) VALUES (?, ?, ?, ?)",
-                    (
-                        number,
-                        study_id,
-                        int(TrialState.RUNNING),
-                        _dt_str(datetime.datetime.now()),
-                    ),
-                )
-                return int(cur.lastrowid)
-            t = template_trial
+            return self._insert_trial_row(con, study_id, number, template_trial)
+
+    def create_new_trials(
+        self, study_id: int, n: int, template_trial: FrozenTrial | None = None
+    ) -> list[int]:
+        """Batch create in ONE transaction (one commit for the whole batch)."""
+        with self._txn() as con:
+            self._check_study_exists(con, study_id)
+            row = con.execute(
+                "SELECT COALESCE(MAX(number), -1) + 1 FROM trials WHERE study_id = ?",
+                (study_id,),
+            ).fetchone()
+            start = int(row[0])
+            return [
+                self._insert_trial_row(con, study_id, start + i, template_trial)
+                for i in range(n)
+            ]
+
+    def _insert_trial_row(
+        self,
+        con: sqlite3.Connection,
+        study_id: int,
+        number: int,
+        template_trial: FrozenTrial | None,
+    ) -> int:
+        if template_trial is None:
             cur = con.execute(
-                "INSERT INTO trials (number, study_id, state, datetime_start, datetime_complete) "
-                "VALUES (?, ?, ?, ?, ?)",
+                "INSERT INTO trials (number, study_id, state, datetime_start) VALUES (?, ?, ?, ?)",
                 (
                     number,
                     study_id,
-                    int(t.state),
-                    _dt_str(t.datetime_start),
-                    _dt_str(t.datetime_complete),
+                    int(TrialState.RUNNING),
+                    _dt_str(datetime.datetime.now()),
                 ),
             )
-            trial_id = int(cur.lastrowid)
-            for name, value in t.params.items():
-                dist = t.distributions[name]
-                con.execute(
-                    "INSERT INTO trial_params (trial_id, param_name, param_value, distribution_json) "
-                    "VALUES (?, ?, ?, ?)",
-                    (trial_id, name, dist.to_internal_repr(value), distribution_to_json(dist)),
-                )
-            if t.values is not None:
-                for i, v in enumerate(t.values):
-                    value, value_type = _encode_value(v)
-                    con.execute(
-                        "INSERT INTO trial_values (trial_id, objective, value, value_type) "
-                        "VALUES (?, ?, ?, ?)",
-                        (trial_id, i, value, value_type),
-                    )
-            for step, v in t.intermediate_values.items():
+            return int(cur.lastrowid)
+        t = template_trial
+        cur = con.execute(
+            "INSERT INTO trials (number, study_id, state, datetime_start, datetime_complete) "
+            "VALUES (?, ?, ?, ?, ?)",
+            (
+                number,
+                study_id,
+                int(t.state),
+                _dt_str(t.datetime_start),
+                _dt_str(t.datetime_complete),
+            ),
+        )
+        trial_id = int(cur.lastrowid)
+        for name, value in t.params.items():
+            dist = t.distributions[name]
+            con.execute(
+                "INSERT INTO trial_params (trial_id, param_name, param_value, distribution_json) "
+                "VALUES (?, ?, ?, ?)",
+                (trial_id, name, dist.to_internal_repr(value), distribution_to_json(dist)),
+            )
+        if t.values is not None:
+            for i, v in enumerate(t.values):
                 value, value_type = _encode_value(v)
                 con.execute(
-                    "INSERT INTO trial_intermediate_values (trial_id, step, intermediate_value, value_type) "
+                    "INSERT INTO trial_values (trial_id, objective, value, value_type) "
                     "VALUES (?, ?, ?, ?)",
-                    (trial_id, step, value, value_type),
+                    (trial_id, i, value, value_type),
                 )
-            for key, v in t.user_attrs.items():
-                con.execute(
-                    "INSERT INTO trial_user_attributes (trial_id, key, value_json) VALUES (?, ?, ?)",
-                    (trial_id, key, json.dumps(v)),
-                )
-            for key, v in t.system_attrs.items():
-                con.execute(
-                    "INSERT INTO trial_system_attributes (trial_id, key, value_json) VALUES (?, ?, ?)",
-                    (trial_id, key, json.dumps(v)),
-                )
-            return trial_id
+        for step, v in t.intermediate_values.items():
+            value, value_type = _encode_value(v)
+            con.execute(
+                "INSERT INTO trial_intermediate_values (trial_id, step, intermediate_value, value_type) "
+                "VALUES (?, ?, ?, ?)",
+                (trial_id, step, value, value_type),
+            )
+        for key, v in t.user_attrs.items():
+            con.execute(
+                "INSERT INTO trial_user_attributes (trial_id, key, value_json) VALUES (?, ?, ?)",
+                (trial_id, key, json.dumps(v)),
+            )
+        for key, v in t.system_attrs.items():
+            con.execute(
+                "INSERT INTO trial_system_attributes (trial_id, key, value_json) VALUES (?, ?, ?)",
+                (trial_id, key, json.dumps(v)),
+            )
+        return trial_id
 
     def _check_trial_updatable(self, con: sqlite3.Connection, trial_id: int) -> None:
         row = con.execute("SELECT state, number FROM trials WHERE trial_id = ?", (trial_id,)).fetchone()

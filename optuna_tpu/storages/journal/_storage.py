@@ -440,6 +440,44 @@ class JournalStorage(BaseStorage):
             payload["template"] = _trial_to_json(template_trial)
         return self._enqueue(JournalOperation.CREATE_TRIAL, payload)
 
+    def create_new_trials(
+        self, study_id: int, n: int, template_trial: FrozenTrial | None = None
+    ) -> list[int]:
+        """Batch create: ONE backend append (one lock/fsync/exchange round)
+        carries all n CREATE_TRIAL ops."""
+        if n <= 0:
+            return []
+        template_json = None if template_trial is None else _trial_to_json(template_trial)
+        with self._thread_lock:
+            ops = []
+            iids = []
+            for _ in range(n):
+                self._issue_counter += 1
+                iids.append(self._issue_counter)
+                payload: dict[str, Any] = {
+                    "study_id": study_id,
+                    "datetime_start": _dt_str(datetime.datetime.now()),
+                }
+                if template_json is not None:
+                    payload["template"] = template_json
+                ops.append(
+                    {
+                        "op": int(JournalOperation.CREATE_TRIAL),
+                        "wid": self._worker_id,
+                        "iid": iids[-1],
+                        **payload,
+                    }
+                )
+            self._backend.append_logs(ops)
+            self._sync()
+            out: list[int] = []
+            for iid in iids:
+                result = self._replay.own_results.pop((self._worker_id, iid), None)
+                if isinstance(result, Exception):
+                    raise result
+                out.append(result)
+            return out
+
     def set_trial_param(
         self,
         trial_id: int,

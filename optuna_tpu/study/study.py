@@ -224,8 +224,14 @@ class Study:
         trial_id = self._pop_waiting_trial_id()
         if trial_id is None:
             trial_id = self._storage.create_new_trial(self._study_id)
-        trial = Trial(self, trial_id)
+        return self._init_asked_trial(trial_id, fixed_distributions)
 
+    def _init_asked_trial(
+        self, trial_id: int, fixed_distributions: dict[str, BaseDistribution]
+    ) -> Trial:
+        """Shared per-trial setup for ask/ask_batch: fixed params, the
+        ``before_trial`` hook, and the system-attr refresh."""
+        trial = Trial(self, trial_id)
         for name, param in fixed_distributions.items():
             trial._suggest(name, param)
 
@@ -242,6 +248,34 @@ class Study:
                 trial._trial_id
             ).system_attrs
         return trial
+
+    def ask_batch(
+        self, n: int, fixed_distributions: dict[str, BaseDistribution] | None = None
+    ) -> list[Trial]:
+        """Create ``n`` trials in one storage batch (claiming WAITING trials
+        first) — the host-side half of vectorized optimization.
+
+        Semantically ``[study.ask() for _ in range(n)]``, but fresh trials are
+        created through ``storage.create_new_trials`` so the whole batch costs
+        one commit (lock/fsync/transaction/exchange) instead of n.
+        """
+        if not self._thread_local.in_optimize_loop and is_heartbeat_enabled(self._storage):
+            warnings.warn("Heartbeat of storage is supposed to be used with Study.optimize.")
+
+        fixed_distributions = fixed_distributions or {}
+        self._thread_local.cached_all_trials = None
+
+        trial_ids: list[int] = []
+        while len(trial_ids) < n:
+            waiting = self._pop_waiting_trial_id()
+            if waiting is None:
+                break
+            trial_ids.append(waiting)
+        if len(trial_ids) < n:
+            trial_ids.extend(
+                self._storage.create_new_trials(self._study_id, n - len(trial_ids))
+            )
+        return [self._init_asked_trial(tid, fixed_distributions) for tid in trial_ids]
 
     def tell(
         self,

@@ -20,6 +20,7 @@ STORAGE_MODES: list[str] = [
     "sqlite",
     "cached_sqlite",
     "journal",
+    "journal_redis",  # fake-redis backed, like the reference's fakeredis mode
     "grpc_rdb",
     "grpc_journal_file",
 ]
@@ -65,8 +66,12 @@ class StorageSupplier:
             return JournalStorage(JournalFileBackend(self.tempfile.name), **self.extra_args)
         if self.storage_specifier == "journal_redis":
             from optuna_tpu.storages.journal import JournalRedisBackend, JournalStorage
+            from optuna_tpu.testing._fake_redis import FakeRedis, _FakeServer
 
-            backend = JournalRedisBackend("redis://localhost", **self.extra_args)
+            client = self.extra_args.pop("client", None) or FakeRedis(_FakeServer())
+            backend = JournalRedisBackend(
+                "redis://localhost", client=client, **self.extra_args
+            )
             return JournalStorage(backend)
         if self.storage_specifier.startswith("grpc_"):
             from optuna_tpu.storages._grpc.client import GrpcStorageProxy

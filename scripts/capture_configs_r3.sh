@@ -1,0 +1,13 @@
+#!/bin/bash
+# Capture BASELINE configs 1,3,4,5 on the live TPU (config 2 = gp headline is
+# captured separately). Sequential; one JSON line per config.
+set -u
+cd /root/repo
+mkdir -p bench_results
+for cfg in tpe cmaes nsga2 mlp; do
+  echo "=== config $cfg ==="
+  python bench.py --config "$cfg" 2>"bench_results/${cfg}_stderr.log" >"bench_results/${cfg}.json"
+  echo "rc=$?"
+  cat "bench_results/${cfg}.json"
+done
+echo CONFIGS_DONE

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Session 2: config benches (tpe/cmaes/nsga2/mlp) then a compile-cache-warm
+# n=1000 GP run (run twice: first populates the persistent cache, second
+# measures steady-state wall-clock).
+set -u
+cd /root/repo
+mkdir -p bench_results
+export JAX_COMPILATION_CACHE_DIR=/tmp/optuna_tpu_jax_cache
+
+for cfg in tpe cmaes nsga2 mlp; do
+  echo "=== config $cfg ==="
+  python bench.py --config "$cfg" 2>"bench_results/${cfg}_stderr.log" >"bench_results/${cfg}.json"
+  echo "rc=$?"; cat "bench_results/${cfg}.json"
+done
+
+echo "=== n=1000 warm (pass 1: populate cache) ==="
+for pass in 1 2; do
+python - <<EOF 2>>bench_results/n1000_warm_stderr.log
+import json, time, os
+import jax
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/optuna_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass
+import optuna_tpu
+from optuna_tpu.models.benchmarks import hartmann20
+from optuna_tpu.samplers import GPSampler
+optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+t0 = time.time()
+study = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=10, speculative_chain=8))
+study.optimize(hartmann20, n_trials=1000)
+dt = time.time() - t0
+print(json.dumps({"who": "ours_warm_pass$pass", "n": 1000, "best": study.best_value,
+                  "wall_s": round(dt, 1), "trials_per_sec": round(1000 / dt, 2),
+                  "vs_ref_3338s": round(3338.5 / dt, 2)}), flush=True)
+EOF
+done
+echo "SESSION2_DONE rc=$?"

@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-3 TPU capture session: full headline bench, Pallas-on-TPU check,
+# n=1000 best-value parity. Sequential so jobs never contend for the chip.
+set -u
+cd /root/repo
+mkdir -p bench_results
+
+echo "=== [1/3] full GP bench ==="
+python bench.py --config gp 2>bench_results/gp_full_stderr.log >bench_results/gp_full.json
+echo "rc=$?"; cat bench_results/gp_full.json
+
+echo "=== [2/3] pallas dominance kernel on TPU ==="
+python - <<'EOF' 2>&1 | tail -5
+import numpy as np, jax
+from optuna_tpu.ops.pareto import non_domination_rank_np, dominance_matrix
+import jax.numpy as jnp
+print("backend:", jax.default_backend())
+rng = np.random.RandomState(0)
+vals = rng.normal(size=(512, 3))
+ranks = non_domination_rank_np(vals)
+# host reference check
+n = len(vals)
+leq = np.all(vals[:, None, :] <= vals[None, :, :], axis=2)
+lt = np.any(vals[:, None, :] < vals[None, :, :], axis=2)
+dom = leq & lt
+exp = np.full(n, -1)
+remaining = np.ones(n, bool); r = 0
+while remaining.any():
+    dominated = np.any(dom[remaining][:, :], axis=0) & remaining
+    front = remaining & ~np.any(dom & remaining[:, None], axis=0)
+    exp[front] = r; remaining &= ~front; r += 1
+assert (ranks == exp).all(), f"mismatch: {np.flatnonzero(ranks != exp)[:10]}"
+print("PALLAS_TPU_OK ranks match host, n=512 m=3, n_fronts=", ranks.max() + 1)
+EOF
+
+echo "=== [3/3] n=1000 parity: ours (chain=8) vs reference ==="
+python - <<'EOF' 2>bench_results/parity_stderr.log
+import json, time
+import optuna_tpu
+from optuna_tpu.models.benchmarks import hartmann20
+from optuna_tpu.samplers import GPSampler
+optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+t0 = time.time()
+study = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=10, speculative_chain=8))
+study.optimize(hartmann20, n_trials=1000)
+ours_dt = time.time() - t0
+ours_best = study.best_value
+print(json.dumps({"who": "ours", "n": 1000, "best": ours_best, "wall_s": round(ours_dt, 1),
+                  "trials_per_sec": round(1000 / ours_dt, 2)}), flush=True)
+import sys, tempfile, os
+shim = tempfile.mkdtemp()
+open(os.path.join(shim, "colorlog.py"), "w").write(
+    "import logging\n"
+    "class ColoredFormatter(logging.Formatter):\n"
+    "    def __init__(self, fmt=None, *a, log_colors=None, **k):\n"
+    "        if fmt is not None: fmt = fmt.replace('%(log_color)s','').replace('%(reset)s','')\n"
+    "        super().__init__(fmt)\n"
+    "class TTYColoredFormatter(ColoredFormatter):\n"
+    "    def __init__(self, *a, stream=None, **k): super().__init__(*a, **k)\n"
+    "class StreamHandler(logging.StreamHandler): pass\n")
+sys.path.insert(0, shim); sys.path.insert(0, "/root/reference")
+import optuna
+optuna.logging.set_verbosity(optuna.logging.ERROR)
+t0 = time.time()
+ref = optuna.create_study(sampler=optuna.samplers.GPSampler(seed=0))
+ref.optimize(hartmann20, n_trials=1000)
+ref_dt = time.time() - t0
+print(json.dumps({"who": "reference", "n": 1000, "best": ref.best_value, "wall_s": round(ref_dt, 1),
+                  "trials_per_sec": round(1000 / ref_dt, 2),
+                  "speedup": round(ref_dt / ours_dt, 2)}), flush=True)
+EOF
+echo "SESSION_DONE rc=$?"

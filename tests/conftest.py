@@ -21,3 +21,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+# Readable assertion introspection inside the shipped test library (the
+# reference registers its optuna.testing modules the same way).
+pytest.register_assert_rewrite(
+    "optuna_tpu.testing.pytest_storages", "optuna_tpu.testing.pytest_samplers"
+)

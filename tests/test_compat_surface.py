@@ -129,3 +129,50 @@ def test_unknown_lazy_name_raises_attribute_error():
         optuna_tpu.samplers.NoSuchSampler  # noqa: B018
     with pytest.raises(AttributeError):
         optuna_tpu.storages.NoSuchStorage  # noqa: B018
+
+
+def test_base_storage_public_surface_matches_reference():
+    """Every public method of the reference's BaseStorage ABC exists here with
+    a compatible callable (reference ``optuna/storages/_base.py:21-607``) —
+    code that drives a storage object directly must not break."""
+    from tests._reference import load_reference
+
+    ref_optuna = load_reference()
+    from optuna_tpu.storages import BaseStorage
+
+    ref_cls = ref_optuna.storages.BaseStorage
+    ref_public = {
+        n
+        for n in dir(ref_cls)
+        if not n.startswith("_") and callable(getattr(ref_cls, n))
+    }
+    ours = set(dir(BaseStorage))
+    missing = sorted(ref_public - ours)
+    assert not missing, f"BaseStorage drop-in surface missing: {missing}"
+
+
+def test_base_storage_convenience_getters_roundtrip():
+    import optuna_tpu
+    from optuna_tpu.exceptions import UpdateFinishedTrialError
+    from optuna_tpu.trial._state import TrialState
+
+    study = optuna_tpu.create_study()
+    trial = study.ask()
+    trial.suggest_float("x", 0.0, 1.0)
+    trial.set_user_attr("tag", "v")
+    storage = study._storage
+    tid = trial._trial_id
+    assert set(storage.get_trial_params(tid)) == {"x"}
+    assert storage.get_trial_user_attrs(tid)["tag"] == "v"
+    assert isinstance(storage.get_trial_system_attrs(tid), dict)
+    storage.check_trial_is_updatable(tid, TrialState.RUNNING)  # no raise
+    study.tell(trial, 1.0)
+    with pytest.raises(UpdateFinishedTrialError):
+        storage.check_trial_is_updatable(tid, storage.get_trial(tid).state)
+
+
+def test_grpc_client_exposes_convenience_getters():
+    from optuna_tpu.storages._grpc._service import METHODS
+
+    for name in ("get_trial_params", "get_trial_user_attrs", "get_trial_system_attrs"):
+        assert name in METHODS

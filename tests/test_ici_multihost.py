@@ -11,6 +11,8 @@ failed collective loses nothing (ops ride the retry exactly once).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 
 import numpy as np
@@ -196,3 +198,61 @@ def test_two_studies_one_pod_bus_stay_consistent():
     assert vals0 == vals1 == [1.0, 2.0]
     # Both hosts hold byte-identical journals.
     assert bus.workers[0].read_logs(0) == bus.workers[1].read_logs(0)
+
+
+@pytest.mark.skipif(
+    os.environ.get("OPTUNA_TPU_TEST_MULTIHOST") != "1",
+    reason="real multi-process allgather smoke is opt-in (OPTUNA_TPU_TEST_MULTIHOST=1), "
+    "mirroring the reference's TEST_DB_URL-gated server tests",
+)
+def test_real_two_process_allgather_exchange(tmp_path):
+    """Two real ``jax.distributed`` CPU processes push distinct ops through the
+    REAL ``multihost_utils.process_allgather`` (not the FakePodBus seam) and
+    must each derive the identical merged journal."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import json, os, sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "pid = int(sys.argv[1])\n"
+        f"jax.distributed.initialize('localhost:{port}', num_processes=2, process_id=pid)\n"
+        "from optuna_tpu.parallel.ici_journal import IciJournalBackend\n"
+        "b = IciJournalBackend()\n"
+        "b.append_logs([{'op': 'from', 'proc': pid, 'seq': 0}])\n"
+        "b.append_logs([{'op': 'from', 'proc': pid, 'seq': 1}])\n"
+        "print('MERGED ' + json.dumps(b.read_logs(0)))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon sitecustomize out
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err[-2000:]
+        outs.append(next(l for l in out.splitlines() if l.startswith("MERGED ")))
+    merged0 = json.loads(outs[0][len("MERGED "):])
+    merged1 = json.loads(outs[1][len("MERGED "):])
+    assert merged0 == merged1  # identical global log on every host
+    assert len(merged0) == 4
+    # Deterministic (round, process_index, seq) order.
+    assert [(l["proc"], l["seq"]) for l in merged0] == [(0, 0), (1, 0), (0, 1), (1, 1)]

@@ -144,3 +144,57 @@ def test_nsgaiii_runs_three_objectives():
 def test_nsgaii_default_for_multiobjective():
     study = optuna_tpu.create_study(directions=["minimize", "minimize"])
     assert type(study.sampler).__name__ == "NSGAIISampler"
+
+
+def test_polynomial_mutation_parity_with_reference():
+    """Decision parity: identical RNG streams -> identical mutated values
+    (reference ``optuna/samplers/nsgaii/_mutations/_polynomial.py:16``)."""
+    from tests._reference import load_reference
+
+    ref_optuna = load_reference()
+    from optuna_tpu.samplers.nsgaii import PolynomialMutation
+
+    ref_cls = ref_optuna.samplers.nsgaii.PolynomialMutation
+    bounds = np.array([-3.0, 7.0])
+    for eta in (5.0, 20.0, 60.0):
+        ours = PolynomialMutation(eta=eta)
+        theirs = ref_cls(eta=eta)
+        for seed in range(10):
+            r1 = np.random.RandomState(seed)
+            r2 = np.random.RandomState(seed)
+            param = float(np.random.RandomState(100 + seed).uniform(-3.0, 7.0))
+            got = ours.mutation(param, r1, None, bounds)
+            exp = theirs.mutation(param, r2, None, bounds)
+            np.testing.assert_allclose(got, exp, rtol=1e-12)
+
+
+def test_polynomial_mutation_end_to_end_and_validation():
+    from optuna_tpu.samplers.nsgaii import PolynomialMutation
+
+    with pytest.raises(ValueError):
+        PolynomialMutation(eta=-1.0)
+    with pytest.raises(ValueError):
+        NSGAIISampler(mutation="not-a-mutation")  # type: ignore[arg-type]
+
+    sampler = NSGAIISampler(population_size=10, seed=3, mutation=PolynomialMutation())
+    study = optuna_tpu.create_study(directions=["minimize", "minimize"], sampler=sampler)
+    study.optimize(zdt1, n_trials=60)
+    ref = np.array([1.1, 10.0])
+    all_vals = np.asarray([t.values for t in study.trials])
+    assert compute_hypervolume(all_vals, ref) > compute_hypervolume(all_vals[:10], ref)
+
+
+def test_perform_mutation_categorical_returns_none():
+    from optuna_tpu.distributions import CategoricalDistribution, IntDistribution
+    from optuna_tpu.samplers.nsgaii import PolynomialMutation
+    from optuna_tpu.samplers.nsgaii._mutations import perform_mutation
+
+    rng = np.random.RandomState(0)
+    assert (
+        perform_mutation(
+            PolynomialMutation(), rng, None, CategoricalDistribution(["a", "b"]), "a"
+        )
+        is None
+    )
+    got = perform_mutation(PolynomialMutation(), rng, None, IntDistribution(1, 10), 5)
+    assert isinstance(got, int) and 1 <= got <= 10

@@ -115,5 +115,10 @@ def test_cli_storage_upgrade_command(v1_db):
 @pytest.mark.parametrize("url", ["mysql://u:p@h/db", "postgresql://u:p@h/db",
                                  "mysql+pymysql://u:p@h/db"])
 def test_server_dialect_urls_rejected_with_guidance(url):
-    with pytest.raises(ValueError, match="JournalStorage|gRPC"):
+    # The error must name both migration paths and the README section that
+    # documents them (VERDICT r2 item 9).
+    with pytest.raises(ValueError, match="JournalFileBackend") as ei:
         RDBStorage(url)
+    msg = str(ei.value)
+    assert "run_grpc_proxy_server" in msg
+    assert "README" in msg

@@ -1,28 +1,17 @@
 """Behavioral contract every sampler must satisfy.
 
-Modeled on the reference's sampler test library
-(``optuna/testing/pytest_samplers.py:99-442`` and
-``tests/samplers_tests/test_samplers.py``): the same parametrized checks run
-against every sampler — distribution-domain correctness for each suggest
-flavour, dynamic and conditional spaces, seeded reproducibility, the
-relative-sampling protocol, resilience to failed/pruned history, and the
-multi-objective / constraints capability matrix.
+Thin parametrization of the shipped suites
+(:mod:`optuna_tpu.testing.pytest_samplers`) over the in-repo sampler matrix —
+mirroring how the reference's ``tests/samplers_tests/test_samplers.py`` drives
+``optuna/testing/pytest_samplers.py:99-442``. Sampler-specific behaviors
+(grids, fixed params, exhaustion, capability errors) stay here.
 """
 
 from __future__ import annotations
 
-import math
-
-import numpy as np
 import pytest
 
-import optuna_tpu
 from optuna_tpu import TrialState, create_study
-from optuna_tpu.distributions import (
-    CategoricalDistribution,
-    FloatDistribution,
-    IntDistribution,
-)
 from optuna_tpu.samplers import (
     BruteForceSampler,
     CmaEsSampler,
@@ -35,7 +24,13 @@ from optuna_tpu.samplers import (
     RandomSampler,
     TPESampler,
 )
-from optuna_tpu.trial import Trial
+from optuna_tpu.testing.pytest_samplers import (
+    BasicSamplerTestCase,
+    ConstrainedSamplerTestCase,
+    MultiObjectiveSamplerTestCase,
+    RelativeSamplerTestCase,
+    SeededSamplerTestCase,
+)
 
 # --------------------------------------------------------------- the matrix
 
@@ -70,249 +65,45 @@ SAMPLER_FACTORIES = {
 CONTINUOUS_CAPABLE = [k for k in SAMPLER_FACTORIES if k not in ("bruteforce",)]
 MULTI_OBJECTIVE_CAPABLE = ["random", "tpe", "tpe-mv", "gp", "nsga2", "nsga3", "qmc"]
 SEEDED_REPRODUCIBLE = ["random", "tpe", "tpe-mv", "gp", "cmaes", "qmc", "nsga2", "nsga3"]
+RELATIVE_CAPABLE = ["tpe-mv", "gp", "cmaes"]
 CONSTRAINED_CAPABLE = {
     "tpe-c": lambda cfn: TPESampler(seed=0, n_startup_trials=3, constraints_func=cfn),
     "gp-c": lambda cfn: GPSampler(seed=0, n_startup_trials=3, constraints_func=cfn),
     "nsga2-c": lambda cfn: NSGAIISampler(seed=0, population_size=4, constraints_func=cfn),
 }
 
-parametrize_sampler = pytest.mark.parametrize("name", CONTINUOUS_CAPABLE)
+
+class TestBasicContract(BasicSamplerTestCase):
+    @pytest.fixture(params=CONTINUOUS_CAPABLE)
+    def sampler_factory(self, request):
+        return SAMPLER_FACTORIES[request.param]
 
 
-def _make(name: str, **kw):
-    return SAMPLER_FACTORIES[name](**kw)
+class TestSeededContract(SeededSamplerTestCase):
+    @pytest.fixture(params=SEEDED_REPRODUCIBLE)
+    def sampler_factory(self, request):
+        return SAMPLER_FACTORIES[request.param]
 
 
-# ----------------------------------------------------- distribution domains
-
-FLOAT_DISTS = [
-    FloatDistribution(-5.0, 5.0),
-    FloatDistribution(1e-5, 1e5, log=True),
-    FloatDistribution(-2.0, 2.0, step=0.5),
-    FloatDistribution(0.0, 0.0),  # single-point
-]
-INT_DISTS = [
-    IntDistribution(-7, 7),
-    IntDistribution(1, 1024, log=True),
-    IntDistribution(0, 12, step=3),
-    IntDistribution(4, 4),  # single-point
-]
-CAT_CHOICES = [
-    ("a", "b", "c"),
-    (1, 2.5, None),
-    (True, False),
-    (0.0,),  # single choice
-]
+class TestRelativeContract(RelativeSamplerTestCase):
+    @pytest.fixture(params=RELATIVE_CAPABLE)
+    def sampler_factory(self, request):
+        return SAMPLER_FACTORIES[request.param]
 
 
-@parametrize_sampler
-@pytest.mark.parametrize("dist", FLOAT_DISTS, ids=["plain", "log", "step", "single"])
-def test_float_domain(name, dist):
-    def objective(trial: Trial) -> float:
-        v = trial.suggest_float(
-            "x", dist.low, dist.high, log=dist.log, step=dist.step
-        )
-        assert isinstance(v, float)
-        assert dist.low <= v <= dist.high
-        if dist.step is not None:
-            k = (v - dist.low) / dist.step
-            assert abs(k - round(k)) < 1e-9
-        return v
-
-    study = create_study(sampler=_make(name))
-    study.optimize(objective, n_trials=8)
-    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+class TestMultiObjectiveContract(MultiObjectiveSamplerTestCase):
+    @pytest.fixture(params=MULTI_OBJECTIVE_CAPABLE)
+    def sampler_factory(self, request):
+        return SAMPLER_FACTORIES[request.param]
 
 
-@parametrize_sampler
-@pytest.mark.parametrize("dist", INT_DISTS, ids=["plain", "log", "step", "single"])
-def test_int_domain(name, dist):
-    def objective(trial: Trial) -> float:
-        v = trial.suggest_int("i", dist.low, dist.high, log=dist.log, step=dist.step)
-        assert isinstance(v, int) and not isinstance(v, bool)
-        assert dist.low <= v <= dist.high
-        assert (v - dist.low) % dist.step == 0
-        return float(v)
-
-    study = create_study(sampler=_make(name))
-    study.optimize(objective, n_trials=8)
-    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+class TestConstrainedContract(ConstrainedSamplerTestCase):
+    @pytest.fixture(params=sorted(CONSTRAINED_CAPABLE))
+    def constrained_factory(self, request):
+        return CONSTRAINED_CAPABLE[request.param]
 
 
-@parametrize_sampler
-@pytest.mark.parametrize(
-    "choices", CAT_CHOICES, ids=["str", "mixed", "bool", "single"]
-)
-def test_categorical_domain(name, choices):
-    def objective(trial: Trial) -> float:
-        v = trial.suggest_categorical("c", choices)
-        assert any(v is c or v == c for c in choices)
-        return float(choices.index(v))
-
-    study = create_study(sampler=_make(name))
-    study.optimize(objective, n_trials=8)
-    seen = {t.params["c"] for t in study.trials}
-    assert seen <= set(choices)
-
-
-# ----------------------------------------------------------- reproducibility
-
-
-@pytest.mark.parametrize("name", SEEDED_REPRODUCIBLE)
-def test_same_seed_reproduces_sequence(name):
-    def objective(trial: Trial) -> float:
-        x = trial.suggest_float("x", -1.0, 1.0)
-        i = trial.suggest_int("i", 0, 9)
-        return x + i
-
-    runs = []
-    for _ in range(2):
-        study = create_study(sampler=_make(name, seed=42))
-        study.optimize(objective, n_trials=10)
-        runs.append([(t.params["x"], t.params["i"]) for t in study.trials])
-    assert runs[0] == runs[1]
-
-
-@pytest.mark.parametrize("name", SEEDED_REPRODUCIBLE)
-def test_reseed_rng_changes_stream(name):
-    sampler = _make(name, seed=7)
-    study1 = create_study(sampler=sampler)
-    study1.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=6)
-    sampler2 = _make(name, seed=7)
-    sampler2.reseed_rng()
-    study2 = create_study(sampler=sampler2)
-    study2.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=6)
-    a = [t.params["x"] for t in study1.trials]
-    b = [t.params["x"] for t in study2.trials]
-    # Independent-phase draws must diverge after an explicit reseed.
-    assert a != b
-
-
-# ------------------------------------------------------------ dynamic spaces
-
-
-@parametrize_sampler
-def test_dynamic_value_range(name):
-    """The same param name with a per-trial range must never escape the
-    trial's own range (reference BasicSamplerTestCase.test_dynamic_range)."""
-
-    def objective(trial: Trial) -> float:
-        width = 1.0 + (trial.number % 3)
-        x = trial.suggest_float("x", -width, width)
-        assert -width <= x <= width
-        i = trial.suggest_int("i", 0, trial.number % 4 + 1)
-        assert 0 <= i <= trial.number % 4 + 1
-        return x + i
-
-    study = create_study(sampler=_make(name))
-    study.optimize(objective, n_trials=10)
-    assert len(study.trials) == 10
-
-
-@parametrize_sampler
-def test_deep_conditional_tree(name):
-    def objective(trial: Trial) -> float:
-        algo = trial.suggest_categorical("algo", ["svm", "forest"])
-        if algo == "svm":
-            kernel = trial.suggest_categorical("kernel", ["rbf", "poly"])
-            c = trial.suggest_float("C", 1e-3, 1e3, log=True)
-            if kernel == "poly":
-                degree = trial.suggest_int("degree", 2, 5)
-                return c * degree
-            return c
-        depth = trial.suggest_int("depth", 1, 16, log=True)
-        est = trial.suggest_int("n_estimators", 10, 100, step=10)
-        return depth + est / 100.0
-
-    study = create_study(sampler=_make(name))
-    study.optimize(objective, n_trials=14)
-    for t in study.trials:
-        if t.params["algo"] == "svm":
-            assert "depth" not in t.params
-            assert ("degree" in t.params) == (t.params["kernel"] == "poly")
-        else:
-            assert "kernel" not in t.params and "C" not in t.params
-
-
-@parametrize_sampler
-def test_survives_failed_and_pruned_history(name):
-    def objective(trial: Trial) -> float:
-        x = trial.suggest_float("x", 0.0, 1.0)
-        if trial.number % 4 == 1:
-            raise optuna_tpu.TrialPruned()
-        if trial.number % 4 == 2:
-            raise RuntimeError("boom")
-        return x
-
-    study = create_study(sampler=_make(name))
-    study.optimize(objective, n_trials=16, catch=(RuntimeError,))
-    states = [t.state for t in study.trials]
-    assert states.count(TrialState.PRUNED) == 4
-    assert states.count(TrialState.FAIL) == 4
-    assert states.count(TrialState.COMPLETE) == 8
-
-
-# ------------------------------------------------- relative-sampling protocol
-
-
-@pytest.mark.parametrize("name", ["tpe-mv", "gp", "cmaes"])
-def test_relative_params_within_distribution(name):
-    """Samplers that implement relative sampling must return values inside
-    the distributions of the inferred relative space."""
-    sampler = _make(name)
-    study = create_study(sampler=sampler)
-
-    def objective(trial: Trial) -> float:
-        x = trial.suggest_float("x", -3.0, 3.0)
-        i = trial.suggest_int("i", 0, 10)
-        return x * x + i
-
-    study.optimize(objective, n_trials=6)
-    frozen = study.trials[-1]
-    space = sampler.infer_relative_search_space(study, frozen)
-    for pname, dist in space.items():
-        assert pname in ("x", "i")
-    t = study.ask()
-    proposal = sampler.sample_relative(study, t._cached_frozen_trial, space)
-    for pname, value in proposal.items():
-        assert space[pname]._contains(space[pname].to_internal_repr(value))
-    study.tell(t, 1.0)
-
-
-@pytest.mark.parametrize("name", ["tpe-mv", "gp", "cmaes"])
-def test_relative_space_excludes_conditional_params(name):
-    sampler = _make(name)
-    study = create_study(sampler=sampler)
-
-    def objective(trial: Trial) -> float:
-        x = trial.suggest_float("x", 0.0, 1.0)
-        if trial.number % 2:
-            y = trial.suggest_float("y", 0.0, 1.0)
-            return x + y
-        return x
-
-    study.optimize(objective, n_trials=8)
-    space = sampler.infer_relative_search_space(study, study.trials[-1])
-    # y is not in every trial -> the intersection space is {x} only.
-    assert set(space) <= {"x"}
-
-
-# ------------------------------------------------------------ multi-objective
-
-
-@pytest.mark.parametrize("name", MULTI_OBJECTIVE_CAPABLE)
-def test_multi_objective_study_runs(name):
-    def objective(trial: Trial):
-        x = trial.suggest_float("x", 0.0, 1.0)
-        y = trial.suggest_float("y", 0.0, 1.0)
-        return x, (1.0 - x) * (1.0 + y)
-
-    study = create_study(directions=["minimize", "minimize"], sampler=_make(name))
-    study.optimize(objective, n_trials=12)
-    assert len(study.trials) == 12
-    assert len(study.best_trials) >= 1
-    for t in study.best_trials:
-        assert len(t.values) == 2
+# -------------------------------------------------------- sampler specifics
 
 
 def test_cmaes_rejects_multi_objective():
@@ -325,28 +116,6 @@ def test_cmaes_rejects_multi_objective():
             lambda t: (t.suggest_float("x", 0, 1), t.suggest_float("y", 0, 1)),
             n_trials=3,
         )
-
-
-# --------------------------------------------------------------- constraints
-
-
-@pytest.mark.parametrize("name", sorted(CONSTRAINED_CAPABLE))
-def test_constraints_steer_best_trial(name):
-    def constraints(frozen) -> tuple[float, ...]:
-        # Feasible iff x <= 0.5 (constraint value <= 0).
-        return (frozen.params["x"] - 0.5,)
-
-    sampler = CONSTRAINED_CAPABLE[name](constraints)
-    study = create_study(sampler=sampler)
-    study.optimize(lambda t: t.suggest_float("x", 0.0, 1.0), n_trials=14)
-    from optuna_tpu.samplers._base import _CONSTRAINTS_KEY
-
-    stored = [t.system_attrs.get(_CONSTRAINTS_KEY) for t in study.trials]
-    assert all(s is not None for s in stored)
-    assert all(len(s) == 1 for s in stored)
-
-
-# -------------------------------------------------------- sampler specifics
 
 
 def test_grid_sampler_reports_all_combinations():
@@ -411,12 +180,12 @@ def test_model_based_beats_random_on_quadratic(name):
     """Model-based samplers should reliably out-optimize random search on a
     smooth 2D quadratic with an equal 25-trial budget."""
 
-    def objective(trial: Trial) -> float:
+    def objective(trial) -> float:
         x = trial.suggest_float("x", -5.0, 5.0)
         y = trial.suggest_float("y", -5.0, 5.0)
         return (x - 1.0) ** 2 + (y + 2.0) ** 2
 
-    model = create_study(sampler=_make(name, seed=5))
+    model = create_study(sampler=SAMPLER_FACTORIES[name](seed=5))
     model.optimize(objective, n_trials=25)
     rand = create_study(sampler=RandomSampler(seed=5))
     rand.optimize(objective, n_trials=25)

@@ -1,362 +1,22 @@
 """BaseStorage behavioral contract, run across every storage mode.
 
-Modeled on the reference's ``optuna/testing/pytest_storages.py`` (~1.1k LoC
-of backend-agnostic behavior checks): study CRUD and naming, directions,
-attrs, trial lifecycle and immutability rules, param/distribution
-compatibility, intermediate values, filtered reads, best-trial semantics,
-and cross-thread number uniqueness — identical expectations for every
-backend in ``optuna_tpu.testing.storages.STORAGE_MODES``.
+Thin parametrization of the shipped suite
+(:mod:`optuna_tpu.testing.pytest_storages`) over the full
+``optuna_tpu.testing.storages.STORAGE_MODES`` matrix — mirroring how the
+reference's ``tests/storages_tests/test_storages.py`` drives
+``optuna/testing/pytest_storages.py``.
 """
 
 from __future__ import annotations
 
-import threading
-
-import numpy as np
 import pytest
 
-import optuna_tpu
-from optuna_tpu.distributions import (
-    CategoricalDistribution,
-    FloatDistribution,
-    IntDistribution,
-)
-from optuna_tpu.exceptions import DuplicatedStudyError
-from optuna_tpu.study import StudyDirection
+from optuna_tpu.testing.pytest_storages import StorageTestCase
 from optuna_tpu.testing.storages import STORAGE_MODES, StorageSupplier
-from optuna_tpu.trial import FrozenTrial, TrialState
-
-parametrize_storage = pytest.mark.parametrize("mode", STORAGE_MODES)
-
-MINIMIZE = [StudyDirection.MINIMIZE]
-BOTH = [StudyDirection.MINIMIZE, StudyDirection.MAXIMIZE]
 
 
-# ------------------------------------------------------------------- studies
-
-
-@parametrize_storage
-def test_study_create_and_name_round_trip(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE, study_name="alpha")
-        assert storage.get_study_id_from_name("alpha") == sid
-        assert storage.get_study_name_from_id(sid) == "alpha"
-        # Unnamed studies get a generated unique name.
-        sid2 = storage.create_new_study(MINIMIZE)
-        name2 = storage.get_study_name_from_id(sid2)
-        assert name2 and name2 != "alpha"
-        assert storage.get_study_id_from_name(name2) == sid2
-
-
-@parametrize_storage
-def test_duplicate_study_name_raises(mode):
-    with StorageSupplier(mode) as storage:
-        storage.create_new_study(MINIMIZE, study_name="dup")
-        with pytest.raises(DuplicatedStudyError):
-            storage.create_new_study(MINIMIZE, study_name="dup")
-
-
-@parametrize_storage
-def test_missing_study_lookup_raises(mode):
-    with StorageSupplier(mode) as storage:
-        with pytest.raises(KeyError):
-            storage.get_study_id_from_name("never-created")
-        with pytest.raises(KeyError):
-            storage.get_study_name_from_id(10_000_019)
-
-
-@parametrize_storage
-def test_delete_study_removes_trials_and_name(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE, study_name="doomed")
-        tid = storage.create_new_trial(sid)
-        storage.set_trial_state_values(tid, TrialState.COMPLETE, [1.0])
-        storage.delete_study(sid)
-        with pytest.raises(KeyError):
-            storage.get_study_id_from_name("doomed")
-        # The name becomes available again.
-        sid2 = storage.create_new_study(MINIMIZE, study_name="doomed")
-        assert storage.get_all_trials(sid2) == []
-
-
-@parametrize_storage
-def test_study_directions_persist(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(BOTH)
-        assert storage.get_study_directions(sid) == BOTH
-        sid1 = storage.create_new_study(MINIMIZE)
-        assert storage.get_study_directions(sid1) == MINIMIZE
-
-
-@parametrize_storage
-def test_study_attrs(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        storage.set_study_user_attr(sid, "owner", "me")
-        storage.set_study_user_attr(sid, "tags", ["a", "b"])
-        storage.set_study_system_attr(sid, "internal", {"k": 1})
-        assert storage.get_study_user_attrs(sid) == {"owner": "me", "tags": ["a", "b"]}
-        assert storage.get_study_system_attrs(sid) == {"internal": {"k": 1}}
-        # Overwrite.
-        storage.set_study_user_attr(sid, "owner", "you")
-        assert storage.get_study_user_attrs(sid)["owner"] == "you"
-
-
-@parametrize_storage
-def test_get_all_studies_summaries(mode):
-    with StorageSupplier(mode) as storage:
-        ids = [storage.create_new_study(MINIMIZE, study_name=f"s{i}") for i in range(3)]
-        studies = storage.get_all_studies()
-        assert {s._study_id for s in studies} >= set(ids)
-        names = {s.study_name for s in studies}
-        assert {"s0", "s1", "s2"} <= names
-
-
-# -------------------------------------------------------------------- trials
-
-
-@parametrize_storage
-def test_trial_numbers_are_dense_and_ordered(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tids = [storage.create_new_trial(sid) for _ in range(5)]
-        numbers = [storage.get_trial_number_from_id(t) for t in tids]
-        assert numbers == [0, 1, 2, 3, 4]
-        for num, tid in zip(numbers, tids):
-            assert storage.get_trial_id_from_study_id_trial_number(sid, num) == tid
-        # Numbers are per-study.
-        sid2 = storage.create_new_study(MINIMIZE)
-        assert storage.get_trial_number_from_id(storage.create_new_trial(sid2)) == 0
-
-
-@parametrize_storage
-def test_create_trial_from_template(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        template = FrozenTrial(
-            number=-1,
-            state=TrialState.COMPLETE,
-            value=0.25,
-            datetime_start=None,
-            datetime_complete=None,
-            params={"x": 2.0},
-            distributions={"x": FloatDistribution(0.0, 4.0)},
-            user_attrs={"note": "seeded"},
-            system_attrs={},
-            intermediate_values={0: 1.0},
-            trial_id=-1,
-        )
-        tid = storage.create_new_trial(sid, template_trial=template)
-        got = storage.get_trial(tid)
-        assert got.state == TrialState.COMPLETE
-        assert got.value == 0.25
-        assert got.params == {"x": 2.0}
-        assert got.user_attrs == {"note": "seeded"}
-        assert got.intermediate_values == {0: 1.0}
-
-
-@parametrize_storage
-def test_trial_param_set_and_read_back(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        fdist = FloatDistribution(0.0, 10.0)
-        idist = IntDistribution(0, 8)
-        cdist = CategoricalDistribution(("a", "b"))
-        storage.set_trial_param(tid, "f", 3.5, fdist)
-        storage.set_trial_param(tid, "i", 4.0, idist)
-        storage.set_trial_param(tid, "c", 1.0, cdist)
-        assert storage.get_trial_param(tid, "f") == 3.5
-        assert storage.get_trial_param(tid, "i") == 4.0
-        assert storage.get_trial_param(tid, "c") == 1.0
-        frozen = storage.get_trial(tid)
-        assert frozen.params == {"f": 3.5, "i": 4, "c": "b"}
-        assert frozen.distributions["f"] == fdist
-
-
-@parametrize_storage
-def test_completed_trial_is_immutable(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        storage.set_trial_state_values(tid, TrialState.COMPLETE, [1.0])
-        with pytest.raises(RuntimeError):
-            storage.set_trial_param(tid, "x", 0.5, FloatDistribution(0, 1))
-        with pytest.raises(RuntimeError):
-            storage.set_trial_state_values(tid, TrialState.COMPLETE, [2.0])
-        with pytest.raises(RuntimeError):
-            storage.set_trial_intermediate_value(tid, 0, 1.0)
-        with pytest.raises(RuntimeError):
-            storage.set_trial_user_attr(tid, "k", "v")
-
-
-@parametrize_storage
-def test_running_to_waiting_transition_allowed(mode):
-    """Re-parking a RUNNING trial to WAITING is permitted (the reference
-    allows it; retry machinery depends on re-queueing)."""
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        assert storage.get_trial(tid).state == TrialState.RUNNING
-        assert storage.set_trial_state_values(tid, TrialState.WAITING)
-        assert storage.get_trial(tid).state == TrialState.WAITING
-        # ... and it can be claimed again.
-        assert storage.set_trial_state_values(tid, TrialState.RUNNING)
-
-
-@parametrize_storage
-def test_cas_claims_single_winner(mode):
-    """set_trial_state_values RUNNING->RUNNING acts as the claim CAS: exactly
-    one concurrent claimer wins a WAITING trial."""
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        template = FrozenTrial(
-            number=-1, state=TrialState.WAITING, value=None,
-            datetime_start=None, datetime_complete=None, params={},
-            distributions={}, user_attrs={}, system_attrs={},
-            intermediate_values={}, trial_id=-1,
-        )
-        tid = storage.create_new_trial(sid, template_trial=template)
-        wins = [storage.set_trial_state_values(tid, TrialState.RUNNING) for _ in range(3)]
-        assert wins.count(True) == 1
-
-
-@parametrize_storage
-def test_intermediate_values_and_overwrite(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        storage.set_trial_intermediate_value(tid, 0, 10.0)
-        storage.set_trial_intermediate_value(tid, 5, 5.0)
-        storage.set_trial_intermediate_value(tid, 0, 9.0)  # overwrite
-        got = storage.get_trial(tid).intermediate_values
-        assert got == {0: 9.0, 5: 5.0}
-
-
-@parametrize_storage
-def test_trial_attrs_persist(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        storage.set_trial_user_attr(tid, "lr", 0.1)
-        storage.set_trial_system_attr(tid, "retry_of", 3)
-        got = storage.get_trial(tid)
-        assert got.user_attrs == {"lr": 0.1}
-        assert got.system_attrs == {"retry_of": 3}
-
-
-@parametrize_storage
-def test_get_all_trials_state_filter_and_copy(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        for k in range(6):
-            tid = storage.create_new_trial(sid)
-            if k % 2 == 0:
-                storage.set_trial_state_values(tid, TrialState.COMPLETE, [float(k)])
-        complete = storage.get_all_trials(sid, states=(TrialState.COMPLETE,))
-        running = storage.get_all_trials(sid, states=(TrialState.RUNNING,))
-        assert len(complete) == 3 and len(running) == 3
-        assert storage.get_n_trials(sid) == 6
-        assert storage.get_n_trials(sid, state=TrialState.COMPLETE) == 3
-        # deepcopy=True must hand back an isolated object.
-        t0 = storage.get_all_trials(sid, deepcopy=True)[0]
-        t0.user_attrs["mutate"] = 1
-        assert "mutate" not in storage.get_all_trials(sid, deepcopy=True)[0].user_attrs
-
-
-@parametrize_storage
-def test_best_trial_semantics(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        with pytest.raises(ValueError):
-            storage.get_best_trial(sid)
-        values = [3.0, 1.0, 2.0]
-        for v in values:
-            tid = storage.create_new_trial(sid)
-            storage.set_trial_state_values(tid, TrialState.COMPLETE, [v])
-        assert storage.get_best_trial(sid).value == 1.0
-        # Maximize study picks the max.
-        sid2 = storage.create_new_study([StudyDirection.MAXIMIZE])
-        for v in values:
-            tid = storage.create_new_trial(sid2)
-            storage.set_trial_state_values(tid, TrialState.COMPLETE, [v])
-        assert storage.get_best_trial(sid2).value == 3.0
-
-
-@parametrize_storage
-def test_datetime_fields_progress(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        running = storage.get_trial(tid)
-        assert running.datetime_start is not None
-        assert running.datetime_complete is None
-        storage.set_trial_state_values(tid, TrialState.COMPLETE, [0.0])
-        done = storage.get_trial(tid)
-        assert done.datetime_complete is not None
-        assert done.datetime_complete >= done.datetime_start
-
-
-@parametrize_storage
-def test_multi_objective_values_round_trip(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(BOTH)
-        tid = storage.create_new_trial(sid)
-        storage.set_trial_state_values(tid, TrialState.COMPLETE, [1.5, -2.5])
-        assert storage.get_trial(tid).values == [1.5, -2.5]
-
-
-@parametrize_storage
-def test_nan_and_inf_values_survive(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        tid = storage.create_new_trial(sid)
-        storage.set_trial_state_values(tid, TrialState.COMPLETE, [float("inf")])
-        assert storage.get_trial(tid).value == float("inf")
-        tid2 = storage.create_new_trial(sid)
-        storage.set_trial_intermediate_value(tid2, 0, float("nan"))
-        assert np.isnan(storage.get_trial(tid2).intermediate_values[0])
-
-
-@parametrize_storage
-def test_cross_thread_trial_numbers_unique(mode):
-    with StorageSupplier(mode) as storage:
-        sid = storage.create_new_study(MINIMIZE)
-        numbers: list[int] = []
-        lock = threading.Lock()
-
-        def worker():
-            for _ in range(10):
-                tid = storage.create_new_trial(sid)
-                n = storage.get_trial_number_from_id(tid)
-                with lock:
-                    numbers.append(n)
-
-        threads = [threading.Thread(target=worker) for _ in range(4)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        assert sorted(numbers) == list(range(40))
-
-
-@parametrize_storage
-def test_unknown_trial_id_raises(mode):
-    with StorageSupplier(mode) as storage:
-        storage.create_new_study(MINIMIZE)
-        with pytest.raises(KeyError):
-            storage.get_trial(987654321)
-
-
-# --------------------------------------------------- end-to-end through Study
-
-
-@parametrize_storage
-def test_study_end_to_end_over_storage(mode):
-    with StorageSupplier(mode) as storage:
-        study = optuna_tpu.create_study(storage=storage, study_name="e2e")
-        study.optimize(lambda t: (t.suggest_float("x", -1, 1)) ** 2, n_trials=10)
-        assert len(study.trials) == 10
-        reloaded = optuna_tpu.load_study(storage=storage, study_name="e2e")
-        assert len(reloaded.trials) == 10
-        assert reloaded.best_value == study.best_value
+class TestStorageContract(StorageTestCase):
+    @pytest.fixture(params=STORAGE_MODES)
+    def storage(self, request):
+        with StorageSupplier(request.param) as s:
+            yield s

@@ -315,3 +315,114 @@ def test_cached_storage_sees_other_workers_trials(tmp_path):
     b.get_trial(t_high)  # would previously poison B's watermark
     ids = {t._trial_id for t in b.get_all_trials(study_id)}
     assert t_low in ids and t_high in ids
+
+
+def test_grpc_proxy_incremental_polling_large_study():
+    """VERDICT r2 item 8: a cached gRPC proxy must not re-ship the full trial
+    list per poll — after the initial sync, only new trials cross the wire."""
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._cached_storage import _CachedStorage
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.testing.storages import _find_free_port
+    from optuna_tpu.trial._frozen import create_trial
+    from optuna_tpu.trial._state import TrialState
+
+    backing = InMemoryStorage()
+    port = _find_free_port()
+    server = make_grpc_server(backing, "localhost", port)
+    server.start()
+    try:
+        proxy = GrpcStorageProxy(host="localhost", port=port)
+        study_id = proxy.create_new_study([StudyDirection.MINIMIZE], "big")
+        n0 = 5000
+        template = create_trial(state=TrialState.COMPLETE, value=1.0)
+        for _ in range(n0):  # server-side fill, cheap on in-memory backing
+            backing.create_new_trial(study_id, template_trial=template)
+
+        wire_counts: list[int] = []
+        orig = proxy._read_trials_partial
+
+        def counted(sid, max_known, extra):
+            out = orig(sid, max_known, extra)
+            wire_counts.append(len(out))
+            return out
+
+        proxy._read_trials_partial = counted  # type: ignore[method-assign]
+        cached = _CachedStorage(proxy)
+
+        assert len(cached.get_all_trials(study_id)) == n0
+        assert wire_counts[-1] == n0  # initial sync ships everything once
+
+        for _ in range(3):
+            backing.create_new_trial(study_id, template_trial=template)
+        assert len(cached.get_all_trials(study_id)) == n0 + 3
+        assert wire_counts[-1] == 3  # poll shipped ONLY the new trials
+
+        assert len(cached.get_all_trials(study_id)) == n0 + 3
+        assert wire_counts[-1] == 0  # steady-state poll ships nothing
+    finally:
+        server.stop(0)
+
+
+def test_get_storage_wraps_grpc_in_cache():
+    from optuna_tpu.storages import InMemoryStorage, get_storage
+    from optuna_tpu.storages._cached_storage import _CachedStorage
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.testing.storages import _find_free_port
+
+    port = _find_free_port()
+    server = make_grpc_server(InMemoryStorage(), "localhost", port)
+    server.start()
+    try:
+        wrapped = get_storage(f"grpc://localhost:{port}")
+        assert isinstance(wrapped, _CachedStorage)
+        assert isinstance(wrapped._backend, GrpcStorageProxy)
+    finally:
+        server.stop(0)
+
+
+def test_create_new_trials_batch_forwarded_over_grpc_and_cache():
+    """create_new_trials must reach the server as ONE RPC (VERDICT r2 item 4 /
+    review finding: no silent degradation to n round trips)."""
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._cached_storage import _CachedStorage
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.testing.storages import _find_free_port
+
+    backing = InMemoryStorage()
+    port = _find_free_port()
+    server = make_grpc_server(backing, "localhost", port)
+    server.start()
+    try:
+        proxy = GrpcStorageProxy(host="localhost", port=port)
+        calls = []
+        orig = proxy._call
+        proxy._call = lambda m, *a, **k: (calls.append(m), orig(m, *a, **k))[1]
+        cached = _CachedStorage(proxy)
+        sid = cached.create_new_study([StudyDirection.MINIMIZE], "batch")
+        ids = cached.create_new_trials(sid, 16)
+        assert len(ids) == 16 and len(set(ids)) == 16
+        assert calls.count("create_new_trials") == 1
+        assert calls.count("create_new_trial") == 0
+        numbers = [cached.get_trial(t).number for t in ids]
+        assert numbers == list(range(16))
+    finally:
+        server.stop(0)
+
+
+def test_rdb_create_new_trials_single_transaction(tmp_path):
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+    from optuna_tpu.study._study_direction import StudyDirection
+
+    storage = RDBStorage(f"sqlite:///{tmp_path}/b.db")
+    sid = storage.create_new_study([StudyDirection.MINIMIZE])
+    ids = storage.create_new_trials(sid, 25)
+    assert [storage.get_trial_number_from_id(t) for t in ids] == list(range(25))
+    # interleaves correctly with single creates
+    one = storage.create_new_trial(sid)
+    assert storage.get_trial_number_from_id(one) == 25
