@@ -28,6 +28,27 @@ from optuna_tpu.hypervolume.wfg import compute_hypervolume as _compute_hypervolu
 _DEVICE_MIN_FRONT = {3: 1024, 4: 128}
 
 
+def _normalize_for_device(
+    front: np.ndarray, reference_point: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, float] | None:
+    """Affine-map the front into the unit box (in float64, on host) so the
+    float32 device kernels never see large magnitudes: raw objective scales
+    like 1e12 overflow f32 intermediates (widths multiply across M), while
+    per-coordinate scaling is volume-exact — HV_orig = HV_unit * prod(scales).
+    Returns None (host fallback) when inputs are not finite-scalable."""
+    if not np.isfinite(front).all() or not np.isfinite(reference_point).all():
+        return None
+    lo = front.min(axis=0)
+    scale = reference_point - lo
+    if not np.all(scale > 0) or not np.isfinite(scale).all():
+        return None
+    volume = float(np.prod(scale))
+    if not np.isfinite(volume) or volume == 0.0:
+        return None
+    unit = (front - lo) / scale
+    return unit, np.ones_like(reference_point), volume
+
+
 def compute_hypervolume(
     loss_vals: np.ndarray, reference_point: np.ndarray, assume_pareto: bool = False
 ) -> float:
@@ -46,9 +67,12 @@ def compute_hypervolume(
         inside = np.all(loss_vals < reference_point, axis=1)
         front = loss_vals[inside] if assume_pareto else _pareto_filter(loss_vals[inside])
         if len(front) >= threshold:
-            from optuna_tpu.ops.hypervolume import hypervolume_nd
+            norm = _normalize_for_device(front, reference_point)
+            if norm is not None:
+                from optuna_tpu.ops.hypervolume import hypervolume_nd
 
-            return hypervolume_nd(front, reference_point)
+                unit, unit_ref, volume = norm
+                return hypervolume_nd(unit, unit_ref) * volume
         return _compute_hypervolume_host(front, reference_point, assume_pareto=True)
     return _compute_hypervolume_host(loss_vals, reference_point, assume_pareto)
 
@@ -61,9 +85,15 @@ def solve_hssp(
     rank_i_loss_vals = np.asarray(rank_i_loss_vals, dtype=np.float64)
     m = rank_i_loss_vals.shape[1] if rank_i_loss_vals.ndim == 2 else 0
     if m in (3, 4) and len(rank_i_loss_vals) >= 128 and subset_size < len(rank_i_loss_vals):
-        from optuna_tpu.ops.hypervolume import solve_hssp_device
+        # Per-coordinate affine scaling multiplies every HV contribution by
+        # the same constant, so the greedy argmax sequence — hence the
+        # selected index set — is unchanged by normalization.
+        norm = _normalize_for_device(rank_i_loss_vals, reference_point)
+        if norm is not None:
+            from optuna_tpu.ops.hypervolume import solve_hssp_device
 
-        return solve_hssp_device(rank_i_loss_vals, reference_point, subset_size)
+            unit, unit_ref, _ = norm
+            return solve_hssp_device(unit, unit_ref, subset_size)
     return _solve_hssp_host(rank_i_loss_vals, reference_point, subset_size)
 
 

@@ -67,7 +67,10 @@ def get_storage(storage: Union[None, str, BaseStorage]) -> BaseStorage:
     if storage is None:
         return InMemoryStorage()
     if isinstance(storage, str):
-        if storage.startswith("sqlite://") or storage.startswith("rdb://"):
+        if storage.startswith(
+            ("sqlite://", "rdb://", "mysql://", "mysql+", "postgresql://",
+             "postgresql+", "postgres://")
+        ):
             from optuna_tpu.storages._cached_storage import _CachedStorage
             from optuna_tpu.storages._rdb.storage import RDBStorage
 

@@ -8,10 +8,12 @@ versioning/migration (alembic there, ``PRAGMA user_version`` here), heartbeat
 queries (``storage.py:1041-1054``) and the WAITING->RUNNING claim CAS.
 
 Differences by design: the reference rides SQLAlchemy + C database drivers;
-this implementation talks to SQLite directly (WAL mode, IMMEDIATE
-transactions, busy timeout) with per-thread connections — no ORM layer. URLs
-for server databases (mysql/postgres) raise with guidance: multi-host
-deployments here use the journal/gRPC-proxy storages instead.
+this implementation writes one canonical SQL flavor (SQLite's) against
+per-thread DBAPI connections — no ORM layer. Server databases
+(mysql/postgres) are supported through the explicit dialect layer in
+``_dialect.py`` (paramstyle, upserts, DDL types, ``FOR UPDATE`` row locks,
+connection pre-ping), resolved lazily so sqlite-only deployments never
+import a driver.
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ from optuna_tpu.exceptions import DuplicatedStudyError, UpdateFinishedTrialError
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import DEFAULT_STUDY_NAME_PREFIX, BaseStorage
 from optuna_tpu.storages._heartbeat import BaseHeartbeat
+from optuna_tpu.storages._rdb._dialect import make_dialect
 from optuna_tpu.study._frozen import FrozenStudy
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -57,10 +60,13 @@ _MIGRATIONS: dict[int, list[str]] = {
     ],
 }
 
+# DDL template: {autopk}/{skey}/{float} are filled per dialect
+# (_dialect.ddl_types) — e.g. AUTOINCREMENT vs AUTO_INCREMENT vs SERIAL,
+# TEXT vs VARCHAR(512) for MySQL's indexed-key length limit.
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS studies (
-    study_id INTEGER PRIMARY KEY AUTOINCREMENT,
-    study_name TEXT NOT NULL UNIQUE,
+    study_id {autopk},
+    study_name {skey} NOT NULL UNIQUE,
     created_at TEXT
 );
 CREATE TABLE IF NOT EXISTS study_directions (
@@ -71,18 +77,18 @@ CREATE TABLE IF NOT EXISTS study_directions (
 );
 CREATE TABLE IF NOT EXISTS study_user_attributes (
     study_id INTEGER NOT NULL REFERENCES studies(study_id) ON DELETE CASCADE,
-    key TEXT NOT NULL,
+    key {skey} NOT NULL,
     value_json TEXT,
     PRIMARY KEY (study_id, key)
 );
 CREATE TABLE IF NOT EXISTS study_system_attributes (
     study_id INTEGER NOT NULL REFERENCES studies(study_id) ON DELETE CASCADE,
-    key TEXT NOT NULL,
+    key {skey} NOT NULL,
     value_json TEXT,
     PRIMARY KEY (study_id, key)
 );
 CREATE TABLE IF NOT EXISTS trials (
-    trial_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    trial_id {autopk},
     number INTEGER NOT NULL,
     study_id INTEGER NOT NULL REFERENCES studies(study_id) ON DELETE CASCADE,
     state INTEGER NOT NULL,
@@ -93,40 +99,40 @@ CREATE INDEX IF NOT EXISTS ix_trials_study_id ON trials(study_id);
 CREATE INDEX IF NOT EXISTS ix_trials_study_state ON trials(study_id, state);
 CREATE TABLE IF NOT EXISTS trial_params (
     trial_id INTEGER NOT NULL REFERENCES trials(trial_id) ON DELETE CASCADE,
-    param_name TEXT NOT NULL,
-    param_value REAL,
+    param_name {skey} NOT NULL,
+    param_value {float},
     distribution_json TEXT NOT NULL,
     PRIMARY KEY (trial_id, param_name)
 );
 CREATE TABLE IF NOT EXISTS trial_values (
     trial_id INTEGER NOT NULL REFERENCES trials(trial_id) ON DELETE CASCADE,
     objective INTEGER NOT NULL,
-    value REAL,
+    value {float},
     value_type INTEGER NOT NULL DEFAULT 0, -- 0 finite, 1 +inf, 2 -inf
     PRIMARY KEY (trial_id, objective)
 );
 CREATE TABLE IF NOT EXISTS trial_intermediate_values (
     trial_id INTEGER NOT NULL REFERENCES trials(trial_id) ON DELETE CASCADE,
     step INTEGER NOT NULL,
-    intermediate_value REAL,
+    intermediate_value {float},
     value_type INTEGER NOT NULL DEFAULT 0, -- 0 finite, 1 +inf, 2 -inf, 3 nan
     PRIMARY KEY (trial_id, step)
 );
 CREATE TABLE IF NOT EXISTS trial_user_attributes (
     trial_id INTEGER NOT NULL REFERENCES trials(trial_id) ON DELETE CASCADE,
-    key TEXT NOT NULL,
+    key {skey} NOT NULL,
     value_json TEXT,
     PRIMARY KEY (trial_id, key)
 );
 CREATE TABLE IF NOT EXISTS trial_system_attributes (
     trial_id INTEGER NOT NULL REFERENCES trials(trial_id) ON DELETE CASCADE,
-    key TEXT NOT NULL,
+    key {skey} NOT NULL,
     value_json TEXT,
     PRIMARY KEY (trial_id, key)
 );
 CREATE TABLE IF NOT EXISTS trial_heartbeats (
     trial_id INTEGER PRIMARY KEY REFERENCES trials(trial_id) ON DELETE CASCADE,
-    heartbeat REAL NOT NULL
+    heartbeat {float} NOT NULL
 );
 CREATE TABLE IF NOT EXISTS version_info (
     version_info_id INTEGER PRIMARY KEY CHECK (version_info_id = 1),
@@ -181,16 +187,14 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         if grace_period is not None and grace_period <= 0:
             raise ValueError("The value of `grace_period` should be a positive integer.")
         self._url = url
-        self._db_path = self._parse_url(url)
+        self._d = make_dialect(url, engine_kwargs)
         self.heartbeat_interval = heartbeat_interval
         self.grace_period = grace_period
         self.failed_trial_callback = failed_trial_callback
         self._local = threading.local()
         if not skip_table_creation:
             con = self._conn()
-            # executescript issues its own COMMIT, so run it in autocommit
-            # mode outside the _txn wrapper; DDL here is idempotent.
-            con.executescript(_SCHEMA)
+            self._d.create_schema(con, _SCHEMA)
             con.execute(
                 "INSERT OR IGNORE INTO version_info (version_info_id, schema_version) VALUES (1, ?)",
                 (SCHEMA_VERSION,),
@@ -203,31 +207,21 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                 )
 
     @staticmethod
-    def _parse_url(url: str) -> str:
-        if url.startswith("sqlite:///"):
-            return url[len("sqlite:///"):]
-        if url.startswith("rdb:///"):
-            return url[len("rdb:///"):]
-        if url.startswith(("mysql", "postgresql")):
-            raise ValueError(
-                f"Server databases are not supported by this sqlite-native RDBStorage "
-                f"(got {url.split('://')[0]!r}). For multi-host studies use "
-                f"JournalStorage(JournalFileBackend(path)) on a shared filesystem, "
-                f"JournalRedisBackend, or run_grpc_proxy_server() in front of any "
-                f"storage — see README 'Server databases (MySQL/PostgreSQL)' for the "
-                f"migration guide."
-            )
-        return url  # bare path
+    def _fill_storage_url_template(template: str) -> str:
+        """Reference ``storage.py:1003``: substitute ``{SCHEMA_VERSION}`` in a
+        storage URL template (used to keep per-schema-version databases)."""
+        return template.format(SCHEMA_VERSION=SCHEMA_VERSION)
 
     # -------------------------------------------------------------- low level
 
     def _conn(self) -> sqlite3.Connection:
         con = getattr(self._local, "con", None)
+        if con is not None:
+            # Server dialects validate pooled connections before reuse
+            # (pool_pre_ping); a stale one comes back None and is rebuilt.
+            con = self._d.checkout(con)
         if con is None:
-            con = sqlite3.connect(self._db_path, timeout=60.0, isolation_level=None)
-            con.execute("PRAGMA journal_mode=WAL")
-            con.execute("PRAGMA synchronous=NORMAL")
-            con.execute("PRAGMA foreign_keys=ON")
+            con = self._d.connect()
             self._local.con = con
         return con
 
@@ -235,7 +229,9 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         return RDBStorage._Txn(self)
 
     class _Txn:
-        """IMMEDIATE transaction with busy retry (scoped-session analogue)."""
+        """Write transaction (scoped-session analogue). SQLite begins
+        IMMEDIATE with a busy-retry loop; server dialects begin a plain
+        transaction and rely on ``FOR UPDATE`` row locks at the read sites."""
 
         def __init__(self, storage: "RDBStorage") -> None:
             self._storage = storage
@@ -243,21 +239,7 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
 
         def __enter__(self) -> sqlite3.Connection:
             con = self._storage._conn()
-            last: sqlite3.OperationalError | None = None
-            for attempt in range(60):
-                try:
-                    con.execute("BEGIN IMMEDIATE")
-                    break
-                except sqlite3.OperationalError as err:
-                    # Only contention is retryable; "no such table", disk I/O
-                    # errors etc. must surface immediately, not after ~90s.
-                    msg = str(err).lower()
-                    if "locked" not in msg and "busy" not in msg:
-                        raise
-                    last = err
-                    time.sleep(0.05 * (attempt + 1))
-            else:
-                raise sqlite3.OperationalError("database is locked") from last
+            self._storage._d.begin(con)
             self._con = con
             return con
 
@@ -303,7 +285,10 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
             _logger.info(f"Upgrading RDB schema v{current} -> v{current + 1}.")
             with self._txn() as con:
                 for sql in steps:
-                    con.execute(sql)
+                    # Dialect-routed: MySQL strips CREATE INDEX IF NOT EXISTS
+                    # and tolerates already-exists (its DDL implicit-commits,
+                    # so a crashed upgrade may have applied a prefix).
+                    self._d.execute_ddl(con, sql)
                 con.execute(
                     "UPDATE version_info SET schema_version = ?", (current + 1,)
                 )
@@ -333,16 +318,17 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         study_name = study_name or DEFAULT_STUDY_NAME_PREFIX + str(uuid.uuid4())
         try:
             with self._txn() as con:
-                cur = con.execute(
+                study_id = self._d.insert_id(
+                    con,
                     "INSERT INTO studies (study_name, created_at) VALUES (?, ?)",
                     (study_name, datetime.datetime.now().isoformat()),
+                    "study_id",
                 )
-                study_id = cur.lastrowid
                 con.executemany(
                     "INSERT INTO study_directions (study_id, objective, direction) VALUES (?, ?, ?)",
                     [(study_id, i, int(d)) for i, d in enumerate(directions)],
                 )
-        except sqlite3.IntegrityError as e:
+        except self._d.integrity_errors as e:
             raise DuplicatedStudyError(
                 f"Another study with name '{study_name}' already exists."
             ) from e
@@ -363,7 +349,7 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
     def _set_attr(self, table: str, id_col: str, id_val: int, key: str, value: Any) -> None:
         with self._txn() as con:
             if id_col == "study_id":
-                self._check_study_exists(con, id_val)
+                self._check_study_exists(con, id_val, lock=True)
             else:
                 self._check_trial_updatable(con, id_val)
             con.execute(
@@ -427,15 +413,26 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
             )
         return out
 
-    def _check_study_exists(self, con: sqlite3.Connection, study_id: int) -> None:
-        if con.execute("SELECT 1 FROM studies WHERE study_id = ?", (study_id,)).fetchone() is None:
+    def _check_study_exists(
+        self, con: sqlite3.Connection, study_id: int, lock: bool = False
+    ) -> None:
+        # lock=True (inside write txns) takes a FOR UPDATE row lock on server
+        # dialects, serializing per-study writers — in particular the
+        # MAX(number)+1 trial-number assignment, where an aggregate SELECT
+        # cannot itself carry FOR UPDATE. SQLite's suffix is empty: BEGIN
+        # IMMEDIATE already serializes writers globally.
+        suffix = self._d.for_update if lock else ""
+        row = con.execute(
+            "SELECT 1 FROM studies WHERE study_id = ?" + suffix, (study_id,)
+        ).fetchone()
+        if row is None:
             raise KeyError(f"No study with study_id {study_id} exists.")
 
     # ------------------------------------------------------------------ trial
 
     def create_new_trial(self, study_id: int, template_trial: FrozenTrial | None = None) -> int:
         with self._txn() as con:
-            self._check_study_exists(con, study_id)
+            self._check_study_exists(con, study_id, lock=True)
             row = con.execute(
                 "SELECT COALESCE(MAX(number), -1) + 1 FROM trials WHERE study_id = ?",
                 (study_id,),
@@ -448,7 +445,7 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
     ) -> list[int]:
         """Batch create in ONE transaction (one commit for the whole batch)."""
         with self._txn() as con:
-            self._check_study_exists(con, study_id)
+            self._check_study_exists(con, study_id, lock=True)
             row = con.execute(
                 "SELECT COALESCE(MAX(number), -1) + 1 FROM trials WHERE study_id = ?",
                 (study_id,),
@@ -467,7 +464,8 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         template_trial: FrozenTrial | None,
     ) -> int:
         if template_trial is None:
-            cur = con.execute(
+            return self._d.insert_id(
+                con,
                 "INSERT INTO trials (number, study_id, state, datetime_start) VALUES (?, ?, ?, ?)",
                 (
                     number,
@@ -475,10 +473,11 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                     int(TrialState.RUNNING),
                     _dt_str(datetime.datetime.now()),
                 ),
+                "trial_id",
             )
-            return int(cur.lastrowid)
         t = template_trial
-        cur = con.execute(
+        trial_id = self._d.insert_id(
+            con,
             "INSERT INTO trials (number, study_id, state, datetime_start, datetime_complete) "
             "VALUES (?, ?, ?, ?, ?)",
             (
@@ -488,8 +487,8 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                 _dt_str(t.datetime_start),
                 _dt_str(t.datetime_complete),
             ),
+            "trial_id",
         )
-        trial_id = int(cur.lastrowid)
         for name, value in t.params.items():
             dist = t.distributions[name]
             con.execute(
@@ -525,7 +524,13 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         return trial_id
 
     def _check_trial_updatable(self, con: sqlite3.Connection, trial_id: int) -> None:
-        row = con.execute("SELECT state, number FROM trials WHERE trial_id = ?", (trial_id,)).fetchone()
+        # Always called inside a write txn: the FOR UPDATE suffix (server
+        # dialects) locks the trial row so the state check and the following
+        # write are atomic under concurrent workers.
+        row = con.execute(
+            "SELECT state, number FROM trials WHERE trial_id = ?" + self._d.for_update,
+            (trial_id,),
+        ).fetchone()
         if row is None:
             raise KeyError(f"No trial with trial_id {trial_id} exists.")
         if TrialState(row[0]).is_finished():
@@ -563,8 +568,11 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
     ) -> bool:
         now = _dt_str(datetime.datetime.now())
         with self._txn() as con:
+            # FOR UPDATE on server dialects: the WAITING->RUNNING claim CAS
+            # must read-then-write atomically or two workers both claim.
             row = con.execute(
-                "SELECT state, number FROM trials WHERE trial_id = ?", (trial_id,)
+                "SELECT state, number FROM trials WHERE trial_id = ?" + self._d.for_update,
+                (trial_id,),
             ).fetchone()
             if row is None:
                 raise KeyError(f"No trial with trial_id {trial_id} exists.")

@@ -114,11 +114,14 @@ def test_cli_storage_upgrade_command(v1_db):
 
 @pytest.mark.parametrize("url", ["mysql://u:p@h/db", "postgresql://u:p@h/db",
                                  "mysql+pymysql://u:p@h/db"])
-def test_server_dialect_urls_rejected_with_guidance(url):
-    # The error must name both migration paths and the README section that
-    # documents them (VERDICT r2 item 9).
-    with pytest.raises(ValueError, match="JournalFileBackend") as ei:
+def test_server_dialect_without_driver_raises_with_guidance(url):
+    # Server dialects are supported through _dialect.py, but this image ships
+    # no MySQL/PG driver: the error must name the pip install AND both
+    # serverless migration paths (VERDICT r2 item 9; full dialect coverage in
+    # tests/test_rdb_dialect.py).
+    with pytest.raises(ImportError, match="JournalFileBackend") as ei:
         RDBStorage(url)
     msg = str(ei.value)
+    assert "pip install" in msg
     assert "run_grpc_proxy_server" in msg
     assert "README" in msg
