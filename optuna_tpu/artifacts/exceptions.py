@@ -1,0 +1,5 @@
+"""Artifact exceptions (reference ``optuna/artifacts/exceptions.py``)."""
+
+from optuna_tpu.artifacts._backends import ArtifactNotFound
+
+__all__ = ["ArtifactNotFound"]

@@ -249,3 +249,197 @@ def sample_and_score_univariate_batch(
             above["cat_log_probs"],
         )
     return num_out, cat_out
+
+
+# --------------------------------------------------------------------------
+# In-graph KDE build: the bandwidth heuristic, prior component, and
+# categorical smoothing computed INSIDE the XLA program from raw (padded)
+# observations. The host then ships one small array per set instead of
+# building _ParzenEstimator objects and six packed tensors per trial —
+# on a dispatch-latency-bound path that halves the per-suggestion host time.
+# Math parity target: parzen_estimator.py:198-277 (itself matching reference
+# optuna/samplers/_tpe/parzen_estimator.py:132-216).
+
+
+def _build_num_dim(obs, n, low, high, consider_endpoints, magic_clip, n_k):
+    """(mus, sigmas) of shape (B,) for one numeric dim; component n is the
+    prior, padded slots carry the prior's mu/sigma (masked by -inf weights)."""
+    B = obs.shape[0]
+    idx = jnp.arange(B)
+    obs_mask = idx < n
+    prior_mu = 0.5 * (low + high)
+    prior_sigma = high - low
+
+    big = jnp.asarray(jnp.finfo(obs.dtype).max, obs.dtype)
+    x = jnp.where(obs_mask, obs, big)
+    order = jnp.argsort(x)
+    sorted_x = x[order]
+    # Neighbor gaps with [low, obs..., high] endpoints (reference :217-225).
+    prev_x = jnp.concatenate([jnp.asarray([low], obs.dtype), sorted_x[:-1]])
+    left_gap = sorted_x - prev_x
+    next_x = jnp.concatenate([sorted_x[1:], jnp.asarray([big], obs.dtype)])
+    right_gap = jnp.where(idx == n - 1, high - sorted_x, next_x - sorted_x)
+    sig_sorted = jnp.maximum(left_gap, right_gap)
+    if not consider_endpoints:
+        # Reference :226-228: first/last obs use their single inner gap.
+        sig_sorted = jnp.where((idx == 0) & (n >= 2), right_gap, sig_sorted)
+        sig_sorted = jnp.where((idx == n - 1) & (n >= 2), left_gap, sig_sorted)
+    sigmas = jnp.zeros(B, obs.dtype).at[order].set(sig_sorted)
+
+    maxsigma = high - low
+    if magic_clip:
+        minsigma = (high - low) / jnp.minimum(100.0, 1.0 + n_k)
+    else:
+        minsigma = jnp.asarray(EPS_BUILD, obs.dtype)
+    sigmas = jnp.clip(sigmas, minsigma, maxsigma)
+
+    mus = jnp.where(obs_mask, obs, prior_mu)
+    sigmas = jnp.where(obs_mask, sigmas, prior_sigma)
+    return mus, sigmas
+
+
+def _build_cat_dim(obs, n, n_choices, prior_weight, n_comp, Cmax):
+    """(B, Cmax) log-probability table for one categorical dim (no distance
+    kernel; that case stays on the host build)."""
+    B = obs.shape[0]
+    idx = jnp.arange(B)
+    obs_mask = idx < n
+    choice = jnp.arange(Cmax)
+    choice_mask = choice < n_choices
+    base = prior_weight / jnp.maximum(n_comp, 1.0)
+    onehot = (choice[None, :] == obs[:, None]) & obs_mask[:, None] & choice_mask[None, :]
+    probs = jnp.where(choice_mask[None, :], base, 0.0) + onehot.astype(jnp.float32)
+    row_sums = probs.sum(axis=1, keepdims=True)
+    probs = probs / jnp.where(row_sums == 0, 1.0, row_sums)
+    return jnp.where(
+        choice_mask[None, :] & (probs > 0), jnp.log(jnp.maximum(probs, EPS_BUILD)), -jnp.inf
+    )
+
+
+EPS_BUILD = 1e-12
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_samples", "consider_endpoints", "magic_clip", "cat_cmax"),
+)
+def sample_univariate_from_obs(
+    seed: jnp.ndarray,
+    b_obs_num: jnp.ndarray,  # (Dn, Bb) transformed observations, padded
+    b_obs_cat: jnp.ndarray,  # (Dc, Bb) int32 choice indices, padded
+    b_log_w: jnp.ndarray,  # (Bb,) log component weights (prior appended, padded -inf)
+    b_n: jnp.ndarray,  # int32: real observation count below
+    b_n_k: jnp.ndarray,  # f32: component count for magic clip / cat base
+    a_obs_num: jnp.ndarray,  # (Dn, Ba)
+    a_obs_cat: jnp.ndarray,  # (Dc, Ba)
+    a_log_w: jnp.ndarray,  # (Ba,)
+    a_n: jnp.ndarray,
+    a_n_k: jnp.ndarray,
+    lows: jnp.ndarray,  # (Dn,)
+    highs: jnp.ndarray,  # (Dn,)
+    steps: jnp.ndarray,  # (Dn,)
+    n_choices: jnp.ndarray,  # (Dc,) int32
+    prior_weight: jnp.ndarray,  # f32 scalar
+    n_samples: int,
+    consider_endpoints: bool,
+    magic_clip: bool,
+    cat_cmax: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Univariate TPE for every dimension, from raw observations, in ONE
+    dispatch: in-graph KDE build + per-dim sample/score/argmax."""
+    key = jax.random.PRNGKey(seed)
+    Dn = b_obs_num.shape[0]
+    Dc = b_obs_cat.shape[0]
+
+    def build_num(obs, n, n_k):
+        return jax.vmap(
+            lambda o, lo, hi: _build_num_dim(
+                o, n, lo, hi, consider_endpoints, magic_clip, n_k
+            )
+        )(obs, lows, highs)
+
+    def build_cat(obs, n, n_k):
+        return jax.vmap(
+            lambda o, c: _build_cat_dim(o, n, c, prior_weight, n_k, cat_cmax)
+        )(obs, n_choices)
+
+    def one_num_dim(key, b_logw, b_mu, b_sigma, a_logw, a_mu, a_sigma, low, high, step):
+        bpack = {
+            "log_weights": b_logw,
+            "mus": b_mu[:, None],
+            "sigmas": b_sigma[:, None],
+            "lows": low[None],
+            "highs": high[None],
+            "steps": step[None],
+            "cat_log_probs": jnp.zeros((b_logw.shape[0], 0, 1)),
+        }
+        apack = {
+            "log_weights": a_logw,
+            "mus": a_mu[:, None],
+            "sigmas": a_sigma[:, None],
+            "lows": low[None],
+            "highs": high[None],
+            "steps": step[None],
+            "cat_log_probs": jnp.zeros((a_logw.shape[0], 0, 1)),
+        }
+        x_num, x_cat = _sample_from(key, bpack, n_samples)
+        score = _component_log_pdf(x_num, x_cat, bpack) - _component_log_pdf(
+            x_num, x_cat, apack
+        )
+        return x_num[jnp.argmax(score), 0]
+
+    def one_cat_dim(key, b_logw, b_probs, a_logw, a_probs):
+        bpack = {
+            "log_weights": b_logw,
+            "mus": jnp.zeros((b_logw.shape[0], 0)),
+            "sigmas": jnp.ones((b_logw.shape[0], 0)),
+            "lows": jnp.zeros(0),
+            "highs": jnp.zeros(0),
+            "steps": jnp.zeros(0),
+            "cat_log_probs": b_probs[:, None, :],
+        }
+        apack = {
+            "log_weights": a_logw,
+            "mus": jnp.zeros((a_logw.shape[0], 0)),
+            "sigmas": jnp.ones((a_logw.shape[0], 0)),
+            "lows": jnp.zeros(0),
+            "highs": jnp.zeros(0),
+            "steps": jnp.zeros(0),
+            "cat_log_probs": a_probs[:, None, :],
+        }
+        x_num, x_cat = _sample_from(key, bpack, n_samples)
+        score = _component_log_pdf(x_num, x_cat, bpack) - _component_log_pdf(
+            x_num, x_cat, apack
+        )
+        return x_cat[jnp.argmax(score), 0]
+
+    num_out = jnp.zeros(0)
+    cat_out = jnp.zeros(0, dtype=jnp.int32)
+    if Dn > 0:
+        b_mus, b_sigmas = build_num(b_obs_num, b_n, b_n_k)
+        a_mus, a_sigmas = build_num(a_obs_num, a_n, a_n_k)
+        keys = jax.random.split(key, Dn)
+        num_out = jax.vmap(one_num_dim)(
+            keys,
+            jnp.broadcast_to(b_log_w, (Dn,) + b_log_w.shape),
+            b_mus,
+            b_sigmas,
+            jnp.broadcast_to(a_log_w, (Dn,) + a_log_w.shape),
+            a_mus,
+            a_sigmas,
+            lows,
+            highs,
+            steps,
+        )
+    if Dc > 0:
+        b_probs = build_cat(b_obs_cat, b_n, b_n_k)
+        a_probs = build_cat(a_obs_cat, a_n, a_n_k)
+        keys = jax.random.split(jax.random.fold_in(key, 1), Dc)
+        cat_out = jax.vmap(one_cat_dim)(
+            keys,
+            jnp.broadcast_to(b_log_w, (Dc,) + b_log_w.shape),
+            b_probs,
+            jnp.broadcast_to(a_log_w, (Dc,) + a_log_w.shape),
+            a_probs,
+        )
+    return num_out, cat_out

@@ -104,6 +104,7 @@ class TPESampler(BaseSampler):
         self._warn_independent_sampling = warn_independent_sampling
         self._rng = LazyRandomState(seed)
         self._random_sampler = RandomSampler(seed=seed)
+        self._univariate_space_specs: dict = {}
         self._multivariate = multivariate
         self._group = group
         self._group_decomposed_search_space: _GroupDecomposedSearchSpace | None = None
@@ -219,6 +220,18 @@ class TPESampler(BaseSampler):
             study, trials, self._gamma(n_finished), self._constraints_func is not None
         )
 
+        # Fast path: KDE build happens INSIDE the jit program from raw
+        # observations (one small transfer + one dispatch per trial). The
+        # categorical distance kernel is host-only, so that case keeps the
+        # host _ParzenEstimator build below.
+        if not any(
+            name in self._parzen_estimator_parameters.categorical_distance_func
+            for name in search_space
+        ):
+            return self._sample_univariate_fused(
+                study, search_space, below_trials, above_trials
+            )
+
         num_names = [n for n, d in search_space.items() if not isinstance(d, CategoricalDistribution)]
         cat_names = [n for n, d in search_space.items() if isinstance(d, CategoricalDistribution)]
 
@@ -301,6 +314,120 @@ class TPESampler(BaseSampler):
         for i, name in enumerate(cat_names):
             internal = below_est[name].decode(np.zeros(0), cat_out[i : i + 1])[name]
             params[name] = search_space[name].to_external_repr(internal)
+        return params
+
+    def _univariate_space_spec(self, search_space: dict[str, BaseDistribution]):
+        """Cached per-space-signature static arrays for the fused kernel."""
+        key = tuple((n, repr(d)) for n, d in search_space.items())
+        spec = self._univariate_space_specs.get(key)
+        if spec is None:
+            from optuna_tpu.samplers._tpe.parzen_estimator import _transformed_bounds
+
+            num_items = [
+                (n, d) for n, d in search_space.items()
+                if not isinstance(d, CategoricalDistribution)
+            ]
+            cat_items = [
+                (n, d) for n, d in search_space.items()
+                if isinstance(d, CategoricalDistribution)
+            ]
+            bounds = [_transformed_bounds(d) for _, d in num_items]
+            spec = {
+                "num_items": num_items,
+                "cat_items": cat_items,
+                "lows": np.asarray([b[0] for b in bounds], np.float32),
+                "highs": np.asarray([b[1] for b in bounds], np.float32),
+                "steps": np.asarray([b[2] for b in bounds], np.float32),
+                "is_log": [b[3] for b in bounds],
+                "n_choices": np.asarray(
+                    [len(d.choices) for _, d in cat_items], np.int32
+                ),
+                "cat_cmax": max((len(d.choices) for _, d in cat_items), default=1),
+            }
+            self._univariate_space_specs[key] = spec
+        return spec
+
+    def _sample_univariate_fused(
+        self,
+        study: "Study",
+        search_space: dict[str, BaseDistribution],
+        below_trials: list[FrozenTrial],
+        above_trials: list[FrozenTrial],
+    ) -> dict[str, Any]:
+        """Classic TPE with the whole Parzen build in-graph: the host ships
+        raw (transformed) observations + component log-weights, the kernel
+        does bandwidths, smoothing, sampling, scoring, and argmax."""
+        import jax
+
+        from optuna_tpu.samplers._tpe.parzen_estimator import (
+            EPS,
+            _bucket,
+            _call_weights_func,
+            _from_transformed,
+        )
+
+        p = self._parzen_estimator_parameters
+        spec = self._univariate_space_spec(search_space)
+        num_items, cat_items = spec["num_items"], spec["cat_items"]
+        Dn, Dc = len(num_items), len(cat_items)
+
+        def pack(trial_set: list[FrozenTrial], below: bool):
+            n = len(trial_set)
+            if below and study._is_multi_objective():
+                w = _calculate_weights_below_for_multi_objective(study, trial_set)
+            else:
+                w = _call_weights_func(p.weights, n)
+            effective_prior = p.consider_prior or n == 0
+            if effective_prior:
+                w = np.append(w, p.prior_weight)
+            w = w.astype(np.float64)
+            w /= w.sum()
+            B = _bucket(n + (1 if effective_prior else 0))
+            log_w = np.full(B, -np.inf, np.float32)
+            log_w[: len(w)] = np.log(np.maximum(w, EPS))
+            obs_num = np.zeros((Dn, B), np.float32)
+            for d, (name, dist) in enumerate(num_items):
+                vals = np.asarray(
+                    [dist.to_internal_repr(t.params[name]) for t in trial_set],
+                    np.float64,
+                )
+                obs_num[d, :n] = np.log(vals) if spec["is_log"][d] else vals
+            obs_cat = np.zeros((Dc, B), np.int32)
+            for d, (name, dist) in enumerate(cat_items):
+                obs_cat[d, :n] = [
+                    int(dist.to_internal_repr(t.params[name])) for t in trial_set
+                ]
+            return obs_num, obs_cat, log_w, np.int32(n), np.float32(n + (1 if effective_prior else 0))
+
+        b_pack = pack(below_trials, True)
+        a_pack = pack(above_trials, False)
+        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
+        from optuna_tpu._device_policy import small_kernel_scope
+
+        with small_kernel_scope():  # KDE kernels are dispatch-latency-bound
+            # One batched host->device transfer for the whole argument tree.
+            dev = jax.device_put(
+                (
+                    seed, *b_pack, *a_pack,
+                    spec["lows"], spec["highs"], spec["steps"], spec["n_choices"],
+                    np.float32(p.prior_weight),
+                )
+            )
+            num_out, cat_out = _kernels.sample_univariate_from_obs(
+                *dev,
+                n_samples=self._n_ei_candidates,
+                consider_endpoints=p.consider_endpoints,
+                magic_clip=p.consider_magic_clip,
+                cat_cmax=spec["cat_cmax"],
+            )
+            num_out, cat_out = jax.device_get((num_out, cat_out))
+
+        params: dict[str, Any] = {}
+        for d, (name, dist) in enumerate(num_items):
+            internal = _from_transformed(dist, float(num_out[d]))
+            params[name] = dist.to_external_repr(internal)
+        for d, (name, dist) in enumerate(cat_items):
+            params[name] = dist.to_external_repr(float(int(cat_out[d])))
         return params
 
     def sample_independent(

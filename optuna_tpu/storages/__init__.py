@@ -69,7 +69,7 @@ def get_storage(storage: Union[None, str, BaseStorage]) -> BaseStorage:
     if isinstance(storage, str):
         if storage.startswith(
             ("sqlite://", "rdb://", "mysql://", "mysql+", "postgresql://",
-             "postgresql+", "postgres://")
+             "postgresql+", "postgres://", "postgres+")
         ):
             from optuna_tpu.storages._cached_storage import _CachedStorage
             from optuna_tpu.storages._rdb.storage import RDBStorage

@@ -278,7 +278,7 @@ class InMemoryStorage(BaseStorage):
     def get_trial(self, trial_id: int) -> FrozenTrial:
         with self._lock:
             trial, _ = self._get_trial_mutable(trial_id)
-            return copy.deepcopy(trial) if not trial.state.is_finished() else trial
+            return trial._structural_copy() if not trial.state.is_finished() else trial
 
     def get_all_trials(
         self,

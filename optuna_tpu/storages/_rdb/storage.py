@@ -337,7 +337,31 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
 
     def delete_study(self, study_id: int) -> None:
         with self._txn() as con:
-            self._check_study_exists(con, study_id)
+            self._check_study_exists(con, study_id, lock=True)
+            # Explicit child-row deletes: MySQL parses but DISCARDS the
+            # schema's inline column-level REFERENCES ... ON DELETE CASCADE
+            # clauses, so relying on cascades would orphan every child row
+            # there. Deleting bottom-up is portable across all dialects
+            # (sqlite/PG cascades then find nothing left to do).
+            trial_sub = "(SELECT trial_id FROM trials WHERE study_id = ?)"
+            for table in (
+                "trial_params",
+                "trial_values",
+                "trial_intermediate_values",
+                "trial_user_attributes",
+                "trial_system_attributes",
+                "trial_heartbeats",
+            ):
+                con.execute(
+                    f"DELETE FROM {table} WHERE trial_id IN {trial_sub}", (study_id,)
+                )
+            for table in (
+                "trials",
+                "study_directions",
+                "study_user_attributes",
+                "study_system_attributes",
+            ):
+                con.execute(f"DELETE FROM {table} WHERE study_id = ?", (study_id,))
             con.execute("DELETE FROM studies WHERE study_id = ?", (study_id,))
 
     def set_study_user_attr(self, study_id: int, key: str, value: Any) -> None:

@@ -123,7 +123,7 @@ def _tell_with_warning(
             f"{value_or_values} and state {state} since trial was already finished. "
             f"Finished trial has values {frozen_trial.values} and state {frozen_trial.state}."
         )
-        return copy.deepcopy(frozen_trial)
+        return frozen_trial._structural_copy()
 
     if state == TrialState.PRUNED:
         # Register the last intermediate value as the trial value if it exists
@@ -176,4 +176,7 @@ def _tell_with_warning(
     study.sampler.after_trial(filtered_study, frozen_trial, state, values)
     study._storage.set_trial_state_values(trial_id, state=state, values=values)
 
-    return copy.deepcopy(study._storage.get_trial(trial_id))
+    # Structural copy: isolates the returned trial from storage internals
+    # without deep-walking 50 distribution objects per tell (CMA/50D was
+    # spending 60% of its wall time in deepcopy here).
+    return study._storage.get_trial(trial_id)._structural_copy()

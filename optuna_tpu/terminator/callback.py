@@ -1,0 +1,5 @@
+"""Drop-in module path alias (reference ``optuna/terminator/callback.py``)."""
+
+from optuna_tpu.terminator._terminator import TerminatorCallback
+
+__all__ = ["TerminatorCallback"]

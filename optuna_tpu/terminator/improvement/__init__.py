@@ -1,0 +1,15 @@
+"""Drop-in package path alias (reference ``optuna/terminator/improvement/``)."""
+
+from optuna_tpu.terminator._evaluators import (
+    BaseImprovementEvaluator,
+    BestValueStagnationEvaluator,
+    EMMREvaluator,
+    RegretBoundEvaluator,
+)
+
+__all__ = [
+    "BaseImprovementEvaluator",
+    "BestValueStagnationEvaluator",
+    "EMMREvaluator",
+    "RegretBoundEvaluator",
+]

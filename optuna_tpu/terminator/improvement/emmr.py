@@ -1,0 +1,5 @@
+"""Drop-in module path alias (reference ``optuna/terminator/improvement/emmr.py``)."""
+
+from optuna_tpu.terminator._evaluators import EMMREvaluator
+
+__all__ = ["EMMREvaluator"]

@@ -1,0 +1,13 @@
+"""Drop-in module path alias (reference ``optuna/terminator/improvement/evaluator.py``)."""
+
+from optuna_tpu.terminator._evaluators import (
+    BaseImprovementEvaluator,
+    BestValueStagnationEvaluator,
+    RegretBoundEvaluator,
+)
+
+__all__ = [
+    "BaseImprovementEvaluator",
+    "BestValueStagnationEvaluator",
+    "RegretBoundEvaluator",
+]

@@ -1,0 +1,5 @@
+"""Drop-in module path alias (reference ``optuna/terminator/median_erroreval.py``)."""
+
+from optuna_tpu.terminator._evaluators import MedianErrorEvaluator
+
+__all__ = ["MedianErrorEvaluator"]

@@ -1,0 +1,5 @@
+"""Drop-in module path alias (reference ``optuna/terminator/terminator.py``)."""
+
+from optuna_tpu.terminator._terminator import BaseTerminator, Terminator
+
+__all__ = ["BaseTerminator", "Terminator"]

@@ -83,6 +83,32 @@ class FrozenTrial:
         else:
             self._values = None
 
+    def _structural_copy(self) -> "FrozenTrial":
+        """Fresh FrozenTrial with copied containers but shared leaf values.
+
+        Isolation-equivalent to ``copy.deepcopy`` for every mutation the
+        runtime performs (field assignment, dict insertion) at a fraction of
+        the cost — deepcopy walks 50 distribution dataclasses per read on a
+        wide space, which dominated the tell path. Leaf values (numbers,
+        strings, datetimes, distributions-by-convention) are immutable; the
+        reference shares the entire object without any copy
+        (``optuna/storages/_in_memory.py:362-369``), so this is strictly
+        more isolated than the parity target."""
+        return FrozenTrial(
+            number=self.number,
+            state=self.state,
+            value=None,
+            datetime_start=self.datetime_start,
+            datetime_complete=self.datetime_complete,
+            params=dict(self.params),
+            distributions=dict(self._distributions),
+            user_attrs=dict(self.user_attrs),
+            system_attrs=dict(self.system_attrs),
+            intermediate_values=dict(self.intermediate_values),
+            trial_id=self._trial_id,
+            values=list(self._values) if self._values is not None else None,
+        )
+
     # ------------------------------------------------------------------ values
 
     @property

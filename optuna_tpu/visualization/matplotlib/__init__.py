@@ -28,4 +28,16 @@ __all__ = [
     "plot_slice",
     "plot_terminator_improvement",
     "plot_timeline",
+    "is_available",
 ]
+
+
+def is_available() -> bool:
+    """Whether the matplotlib backend can render (reference
+    ``optuna/visualization/matplotlib/__init__.py:13-17``)."""
+    try:
+        import matplotlib  # noqa: F401
+
+        return True
+    except ImportError:
+        return False

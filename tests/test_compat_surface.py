@@ -176,3 +176,52 @@ def test_grpc_client_exposes_convenience_getters():
 
     for name in ("get_trial_params", "get_trial_user_attrs", "get_trial_system_attrs"):
         assert name in METHODS
+
+
+def test_reference_module_paths_importable():
+    # Reference-targeting code imports these exact module paths
+    # (optuna/terminator/{callback,erroreval,median_erroreval,terminator}.py,
+    # optuna/terminator/improvement/{evaluator,emmr}.py,
+    # optuna/artifacts/exceptions.py); each must resolve to the same objects
+    # the package top level exports.
+    import importlib
+
+    import optuna_tpu.artifacts as arts
+    import optuna_tpu.terminator as term
+
+    cases = {
+        "optuna_tpu.terminator.callback": ["TerminatorCallback"],
+        "optuna_tpu.terminator.erroreval": [
+            "BaseErrorEvaluator",
+            "CrossValidationErrorEvaluator",
+            "StaticErrorEvaluator",
+            "report_cross_validation_scores",
+        ],
+        "optuna_tpu.terminator.median_erroreval": ["MedianErrorEvaluator"],
+        "optuna_tpu.terminator.terminator": ["BaseTerminator", "Terminator"],
+        "optuna_tpu.terminator.improvement": [
+            "BaseImprovementEvaluator",
+            "RegretBoundEvaluator",
+            "BestValueStagnationEvaluator",
+            "EMMREvaluator",
+        ],
+        "optuna_tpu.terminator.improvement.evaluator": [
+            "BaseImprovementEvaluator",
+            "RegretBoundEvaluator",
+            "BestValueStagnationEvaluator",
+        ],
+        "optuna_tpu.terminator.improvement.emmr": ["EMMREvaluator"],
+        "optuna_tpu.artifacts.exceptions": ["ArtifactNotFound"],
+    }
+    for path, names in cases.items():
+        mod = importlib.import_module(path)
+        for name in names:
+            obj = getattr(mod, name)
+            top = getattr(term, name, None) or getattr(arts, name)
+            assert obj is top, (path, name)
+
+
+def test_matplotlib_is_available():
+    from optuna_tpu.visualization import matplotlib as mpl_viz
+
+    assert isinstance(mpl_viz.is_available(), bool)

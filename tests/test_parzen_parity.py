@@ -116,3 +116,100 @@ def test_categorical_one_hot_matches_reference(optuna_ref):
     np.testing.assert_allclose(
         np.exp(ours._cat_log_probs[:n, 0, : len(choices)]), ref_probs, rtol=1e-9
     )
+
+
+class TestInGraphBuildParity:
+    """The fused univariate kernel builds the KDE in-graph; its math must
+    match the host _ParzenEstimator (itself parity-tested vs the reference)."""
+
+    @pytest.mark.parametrize("n", [0, 1, 2, 5, 16])
+    @pytest.mark.parametrize("consider_endpoints", [False, True])
+    @pytest.mark.parametrize("magic_clip", [True, False])
+    def test_numeric_mus_sigmas_match_host(self, n, consider_endpoints, magic_clip):
+        import jax.numpy as jnp
+
+        from optuna_tpu.distributions import FloatDistribution
+        from optuna_tpu.samplers._tpe import _kernels
+        from optuna_tpu.samplers._tpe.parzen_estimator import (
+            _bucket,
+            _ParzenEstimator,
+            _ParzenEstimatorParameters,
+        )
+
+        rng = np.random.RandomState(n + 17)
+        low, high = -3.0, 7.0
+        obs = rng.uniform(low, high, n)
+        dist = FloatDistribution(low, high)
+        params = _ParzenEstimatorParameters(
+            consider_prior=True,
+            prior_weight=1.0,
+            consider_magic_clip=magic_clip,
+            consider_endpoints=consider_endpoints,
+            weights=lambda k: np.ones(k),
+            multivariate=False,
+            categorical_distance_func={},
+        )
+        host = _ParzenEstimator({"x": obs}, {"x": dist}, params)
+        pack = host.pack()
+        n_comp = n + 1
+        B = _bucket(n_comp)
+        padded = np.zeros(B, np.float32)
+        padded[:n] = obs
+        mus, sigmas = _kernels._build_num_dim(
+            jnp.asarray(padded),
+            jnp.int32(n),
+            jnp.float32(low),
+            jnp.float32(high),
+            consider_endpoints,
+            magic_clip,
+            jnp.float32(n_comp),
+        )
+        np.testing.assert_allclose(
+            np.asarray(mus)[:n_comp], pack["mus"][:n_comp, 0], rtol=2e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(sigmas)[:n_comp], pack["sigmas"][:n_comp, 0], rtol=2e-5, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("n", [0, 3, 10])
+    def test_categorical_probs_match_host(self, n):
+        import jax.numpy as jnp
+
+        from optuna_tpu.distributions import CategoricalDistribution
+        from optuna_tpu.samplers._tpe import _kernels
+        from optuna_tpu.samplers._tpe.parzen_estimator import (
+            _bucket,
+            _ParzenEstimator,
+            _ParzenEstimatorParameters,
+        )
+
+        rng = np.random.RandomState(n + 3)
+        C = 4
+        obs = rng.randint(0, C, n).astype(np.float64)
+        dist = CategoricalDistribution(["a", "b", "c", "d"])
+        params = _ParzenEstimatorParameters(
+            consider_prior=True,
+            prior_weight=1.0,
+            consider_magic_clip=True,
+            consider_endpoints=False,
+            weights=lambda k: np.ones(k),
+            multivariate=False,
+            categorical_distance_func={},
+        )
+        host = _ParzenEstimator({"c": obs}, {"c": dist}, params)
+        n_comp = n + 1
+        B = _bucket(n_comp)
+        padded = np.zeros(B, np.int32)
+        padded[:n] = obs.astype(np.int32)
+        got = _kernels._build_cat_dim(
+            jnp.asarray(padded),
+            jnp.int32(n),
+            jnp.int32(C),
+            jnp.float32(1.0),
+            jnp.float32(n_comp),
+            C,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got)[:n_comp], host.pack()["cat_log_probs"][:n_comp, 0, :],
+            rtol=2e-5, atol=1e-5,
+        )
