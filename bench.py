@@ -83,6 +83,14 @@ def _prewarm_gp(d: int, n_max: int, chain: int, n_startup: int) -> None:
         sampler._spec_queue = []  # force a fresh chain dispatch per bucket
 
 
+# Reference GPSampler wall time for the full n=1000 Hartmann-20D study,
+# measured in THIS process image on THIS host (2026-07-29, torch/scipy on
+# CPU — the reference has no TPU path; see bench_results/gp_n1000_parity.json
+# for the paired capture, best value -3.322365). Re-measure live with
+# OPTUNA_TPU_BENCH_FULL_BASELINE=1 (costs ~56 min).
+_PINNED_GP_BASELINE = {"n": 1000, "wall_s": 3338.5, "best": -3.322364882027747}
+
+
 def run_ours_gp(
     n_warmup: int, n_timed: int, chain: int = 8, n_startup: int = 10
 ) -> tuple[float, float]:
@@ -100,6 +108,23 @@ def run_ours_gp(
     study.optimize(hartmann20, n_trials=n_timed)
     dt = time.time() - t0
     return n_timed / dt, study.best_value
+
+
+def run_ours_gp_end_to_end(n_total: int, chain: int = 8) -> tuple[float, float]:
+    """The BASELINE.json headline: the ENTIRE study, compiles included
+    (amortized across runs by the persistent XLA cache, like any production
+    deployment)."""
+    import optuna_tpu
+    from optuna_tpu.models.benchmarks import hartmann20
+    from optuna_tpu.samplers import GPSampler
+
+    _silence()
+    study = optuna_tpu.create_study(
+        sampler=GPSampler(seed=0, speculative_chain=chain)
+    )
+    t0 = time.time()
+    study.optimize(hartmann20, n_trials=n_total)
+    return time.time() - t0, study.best_value
 
 
 def run_ours_tpe(n_warmup: int, n_timed: int) -> tuple[float, float]:
@@ -381,23 +406,58 @@ def main() -> None:
     _setup_jax_cache()
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--config", default="gp", choices=["gp", "gp_batch", "tpe", "cmaes", "nsga2", "mlp"]
+        "--config",
+        default="gp",
+        choices=["gp", "gp_window", "gp_batch", "tpe", "cmaes", "nsga2", "mlp"],
     )
     parser.add_argument("--quick", action="store_true")
     args = parser.parse_args()
 
     if args.config == "gp":
-        # The timed window sits deep in the study (trials 300-400 of the
-        # n=1000 BASELINE run): GP suggestion cost grows ~O(n^3) with history,
-        # so a shallow window (50 warm) measures mostly the regime where the
-        # reference's torch/scipy fit is still cheap. Both sides run the SAME
-        # warm+timed windows, so the ratio stays apples-to-apples.
+        # Headline = BASELINE.json's own form: the WHOLE n=1000 study
+        # end-to-end. A per-window ratio misleads both ways (shallow windows
+        # under-count the reference's O(n^3) growth, mid-depth windows land
+        # in the U-shaped middle); the end-to-end wall clock is what the
+        # north star specifies. The reference side takes ~56 min, so it is
+        # pinned from a paired same-host capture (re-measure live with
+        # OPTUNA_TPU_BENCH_FULL_BASELINE=1).
+        n_total = 250 if args.quick else _PINNED_GP_BASELINE["n"]
+        _log(f"running ours (GPSampler / 20D Hartmann, n={n_total} end-to-end, chain=8)...")
+        wall, ours_best = run_ours_gp_end_to_end(n_total)
+        ours_rate = n_total / wall
+        _log(f"ours: {wall:.1f}s = {ours_rate:.3f} trials/s (best {ours_best:.4f})")
+        if os.environ.get("OPTUNA_TPU_BENCH_FULL_BASELINE"):
+            base = run_baseline_gp(0, n_total)
+        else:
+            # Both quick and full modes use the pinned capture: even 250
+            # reference GP trials cost minutes, which would defeat --quick.
+            # Quick mode's ratio is vs the *prorated* pinned rate — labelled
+            # approximate in the log.
+            base = (
+                _PINNED_GP_BASELINE["n"] / _PINNED_GP_BASELINE["wall_s"],
+                _PINNED_GP_BASELINE["best"],
+            )
+            approx = " (approximate: prorated)" if n_total != _PINNED_GP_BASELINE["n"] else ""
+            _log(
+                f"baseline: pinned same-host capture {_PINNED_GP_BASELINE['wall_s']}s "
+                f"(best {_PINNED_GP_BASELINE['best']:.4f}){approx}; "
+                "set OPTUNA_TPU_BENCH_FULL_BASELINE=1 to re-measure live"
+            )
+        if base is not None and abs(ours_best - base[1]) > 0.05:
+            _log(
+                f"WARNING: best-value parity drift: ours {ours_best:.4f} "
+                f"vs reference {base[1]:.4f}"
+            )
+        metric = "gp_sampler_trials_per_sec_hartmann20d_n1000_end_to_end"
+    elif args.config == "gp_window":
+        # Fixed-depth window comparison (trials 300-400), both sides run the
+        # SAME warm+timed windows live.
         n_warm, n_timed = (12, 24) if args.quick else (300, 100)
         _log("running ours (GPSampler / 20D Hartmann, ask-ahead chain=8)...")
         ours_rate, ours_best = run_ours_gp(n_warm, n_timed, chain=8)
         _log(f"ours: {ours_rate:.3f} trials/s (best {ours_best:.4f}); running baseline...")
         base = run_baseline_gp(n_warm, n_timed)
-        metric = "gp_sampler_trials_per_sec_hartmann20d"
+        metric = "gp_sampler_trials_per_sec_hartmann20d_window300"
     elif args.config == "gp_batch":
         n_warm, n_timed = (16, 32) if args.quick else (32, 64)
         _log("running ours (GPSampler / 20D Hartmann, q=16 batch ask)...")

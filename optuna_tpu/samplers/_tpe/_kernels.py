@@ -443,3 +443,101 @@ def sample_univariate_from_obs(
             a_probs,
         )
     return num_out, cat_out
+
+
+def _make_joint_pack(
+    obs_num, obs_cat, log_w, n, n_k, lows, highs, steps, n_choices,
+    prior_weight, consider_endpoints, magic_clip, cat_cmax,
+):
+    """In-graph build of the JOINT (multivariate) mixture pack: per-dim
+    bandwidths are identical to the univariate case (the reference has no
+    separate multivariate bandwidth branch), assembled into the (B, D)
+    layout `_sample_from`/`_component_log_pdf` consume."""
+    Dn = obs_num.shape[0]
+    Dc = obs_cat.shape[0]
+    B = log_w.shape[0]
+    if Dn > 0:
+        mus_d, sigmas_d = jax.vmap(
+            lambda o, lo, hi: _build_num_dim(
+                o, n, lo, hi, consider_endpoints, magic_clip, n_k
+            )
+        )(obs_num, lows, highs)
+        mus, sigmas = mus_d.T, sigmas_d.T  # (B, Dn)
+    else:
+        mus = jnp.zeros((B, 0))
+        sigmas = jnp.ones((B, 0))
+    if Dc > 0:
+        probs_d = jax.vmap(
+            lambda o, c: _build_cat_dim(o, n, c, prior_weight, n_k, cat_cmax)
+        )(obs_cat, n_choices)  # (Dc, B, C)
+        cat_log_probs = jnp.transpose(probs_d, (1, 0, 2))  # (B, Dc, C)
+    else:
+        cat_log_probs = jnp.zeros((B, 0, 1))
+    return {
+        "log_weights": log_w,
+        "mus": mus,
+        "sigmas": sigmas,
+        "lows": lows,
+        "highs": highs,
+        "steps": steps,
+        "cat_log_probs": cat_log_probs,
+    }
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_samples", "consider_endpoints", "magic_clip", "cat_cmax"),
+)
+def sample_and_score_from_obs(
+    seed,
+    b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k,
+    a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k,
+    lows, highs, steps, n_choices, prior_weight,
+    n_samples: int, consider_endpoints: bool, magic_clip: bool, cat_cmax: int,
+):
+    """Multivariate TPE from raw observations: joint-KDE build + draw +
+    score + argmax, one dispatch."""
+    key = jax.random.PRNGKey(seed)
+    below = _make_joint_pack(
+        b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k, lows, highs, steps,
+        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+    )
+    above = _make_joint_pack(
+        a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k, lows, highs, steps,
+        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+    )
+    x_num, x_cat = _sample_from(key, below, n_samples)
+    score = _component_log_pdf(x_num, x_cat, below) - _component_log_pdf(
+        x_num, x_cat, above
+    )
+    best = jnp.argmax(score)
+    return x_num[best], x_cat[best]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_samples", "k", "consider_endpoints", "magic_clip", "cat_cmax"),
+)
+def sample_and_score_topk_from_obs(
+    seed,
+    b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k,
+    a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k,
+    lows, highs, steps, n_choices, prior_weight,
+    n_samples: int, k: int, consider_endpoints: bool, magic_clip: bool, cat_cmax: int,
+):
+    """Batch-ask variant: top-k scoring joint candidates, one dispatch."""
+    key = jax.random.PRNGKey(seed)
+    below = _make_joint_pack(
+        b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k, lows, highs, steps,
+        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+    )
+    above = _make_joint_pack(
+        a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k, lows, highs, steps,
+        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+    )
+    x_num, x_cat = _sample_from(key, below, n_samples)
+    score = _component_log_pdf(x_num, x_cat, below) - _component_log_pdf(
+        x_num, x_cat, above
+    )
+    _, idx = jax.lax.top_k(score, k)
+    return x_num[idx], x_cat[idx]

@@ -224,10 +224,7 @@ class TPESampler(BaseSampler):
         # observations (one small transfer + one dispatch per trial). The
         # categorical distance kernel is host-only, so that case keeps the
         # host _ParzenEstimator build below.
-        if not any(
-            name in self._parzen_estimator_parameters.categorical_distance_func
-            for name in search_space
-        ):
+        if not self._uses_distance_kernel(search_space):
             return self._sample_univariate_fused(
                 study, search_space, below_trials, above_trials
             )
@@ -347,6 +344,85 @@ class TPESampler(BaseSampler):
             self._univariate_space_specs[key] = spec
         return spec
 
+    def _pack_observations(
+        self,
+        study: "Study",
+        spec: dict,
+        trial_set: list[FrozenTrial],
+        below: bool,
+    ):
+        """Raw padded observations + component log-weights for one KDE set —
+        everything the in-graph builders need (weights stay host-side: the
+        weights callable and the MOTPE HSSP ramp are user/host logic)."""
+        from optuna_tpu.samplers._tpe.parzen_estimator import (
+            EPS,
+            _bucket,
+            _call_weights_func,
+        )
+
+        p = self._parzen_estimator_parameters
+        num_items, cat_items = spec["num_items"], spec["cat_items"]
+        n = len(trial_set)
+        if below and study._is_multi_objective():
+            w = _calculate_weights_below_for_multi_objective(study, trial_set)
+        else:
+            w = _call_weights_func(p.weights, n)
+        effective_prior = p.consider_prior or n == 0
+        if effective_prior:
+            w = np.append(w, p.prior_weight)
+        w = w.astype(np.float64)
+        w /= w.sum()
+        B = _bucket(n + (1 if effective_prior else 0))
+        log_w = np.full(B, -np.inf, np.float32)
+        log_w[: len(w)] = np.log(np.maximum(w, EPS))
+        obs_num = np.zeros((len(num_items), B), np.float32)
+        for d, (name, dist) in enumerate(num_items):
+            vals = np.asarray(
+                [dist.to_internal_repr(t.params[name]) for t in trial_set],
+                np.float64,
+            )
+            obs_num[d, :n] = np.log(vals) if spec["is_log"][d] else vals
+        obs_cat = np.zeros((len(cat_items), B), np.int32)
+        for d, (name, dist) in enumerate(cat_items):
+            obs_cat[d, :n] = [
+                int(dist.to_internal_repr(t.params[name])) for t in trial_set
+            ]
+        return obs_num, obs_cat, log_w, np.int32(n), np.float32(n + (1 if effective_prior else 0))
+
+    def _fused_obs_inputs(self, study, spec, below_trials, above_trials):
+        """Device-resident argument tree for the *_from_obs kernels (ONE
+        batched host->device transfer)."""
+        import jax
+
+        p = self._parzen_estimator_parameters
+        b_pack = self._pack_observations(study, spec, below_trials, below=True)
+        a_pack = self._pack_observations(study, spec, above_trials, below=False)
+        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
+        return jax.device_put(
+            (
+                seed, *b_pack, *a_pack,
+                spec["lows"], spec["highs"], spec["steps"], spec["n_choices"],
+                np.float32(p.prior_weight),
+            )
+        )
+
+    def _decode_fused(self, spec, num_out, cat_out) -> dict[str, Any]:
+        from optuna_tpu.samplers._tpe.parzen_estimator import _from_transformed
+
+        params: dict[str, Any] = {}
+        for d, (name, dist) in enumerate(spec["num_items"]):
+            internal = _from_transformed(dist, float(num_out[d]))
+            params[name] = dist.to_external_repr(internal)
+        for d, (name, dist) in enumerate(spec["cat_items"]):
+            params[name] = dist.to_external_repr(float(int(cat_out[d])))
+        return params
+
+    def _uses_distance_kernel(self, search_space: dict[str, BaseDistribution]) -> bool:
+        return any(
+            name in self._parzen_estimator_parameters.categorical_distance_func
+            for name in search_space
+        )
+
     def _sample_univariate_fused(
         self,
         study: "Study",
@@ -359,60 +435,12 @@ class TPESampler(BaseSampler):
         does bandwidths, smoothing, sampling, scoring, and argmax."""
         import jax
 
-        from optuna_tpu.samplers._tpe.parzen_estimator import (
-            EPS,
-            _bucket,
-            _call_weights_func,
-            _from_transformed,
-        )
-
         p = self._parzen_estimator_parameters
         spec = self._univariate_space_spec(search_space)
-        num_items, cat_items = spec["num_items"], spec["cat_items"]
-        Dn, Dc = len(num_items), len(cat_items)
-
-        def pack(trial_set: list[FrozenTrial], below: bool):
-            n = len(trial_set)
-            if below and study._is_multi_objective():
-                w = _calculate_weights_below_for_multi_objective(study, trial_set)
-            else:
-                w = _call_weights_func(p.weights, n)
-            effective_prior = p.consider_prior or n == 0
-            if effective_prior:
-                w = np.append(w, p.prior_weight)
-            w = w.astype(np.float64)
-            w /= w.sum()
-            B = _bucket(n + (1 if effective_prior else 0))
-            log_w = np.full(B, -np.inf, np.float32)
-            log_w[: len(w)] = np.log(np.maximum(w, EPS))
-            obs_num = np.zeros((Dn, B), np.float32)
-            for d, (name, dist) in enumerate(num_items):
-                vals = np.asarray(
-                    [dist.to_internal_repr(t.params[name]) for t in trial_set],
-                    np.float64,
-                )
-                obs_num[d, :n] = np.log(vals) if spec["is_log"][d] else vals
-            obs_cat = np.zeros((Dc, B), np.int32)
-            for d, (name, dist) in enumerate(cat_items):
-                obs_cat[d, :n] = [
-                    int(dist.to_internal_repr(t.params[name])) for t in trial_set
-                ]
-            return obs_num, obs_cat, log_w, np.int32(n), np.float32(n + (1 if effective_prior else 0))
-
-        b_pack = pack(below_trials, True)
-        a_pack = pack(above_trials, False)
-        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
         from optuna_tpu._device_policy import small_kernel_scope
 
         with small_kernel_scope():  # KDE kernels are dispatch-latency-bound
-            # One batched host->device transfer for the whole argument tree.
-            dev = jax.device_put(
-                (
-                    seed, *b_pack, *a_pack,
-                    spec["lows"], spec["highs"], spec["steps"], spec["n_choices"],
-                    np.float32(p.prior_weight),
-                )
-            )
+            dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
             num_out, cat_out = _kernels.sample_univariate_from_obs(
                 *dev,
                 n_samples=self._n_ei_candidates,
@@ -421,14 +449,7 @@ class TPESampler(BaseSampler):
                 cat_cmax=spec["cat_cmax"],
             )
             num_out, cat_out = jax.device_get((num_out, cat_out))
-
-        params: dict[str, Any] = {}
-        for d, (name, dist) in enumerate(num_items):
-            internal = _from_transformed(dist, float(num_out[d]))
-            params[name] = dist.to_external_repr(internal)
-        for d, (name, dist) in enumerate(cat_items):
-            params[name] = dist.to_external_repr(float(int(cat_out[d])))
-        return params
+        return self._decode_fused(spec, num_out, cat_out)
 
     def sample_independent(
         self,
@@ -477,15 +498,33 @@ class TPESampler(BaseSampler):
             self._constraints_func is not None,
         )
 
-        below = self._build_parzen(below_trials, study, search_space, below=True)
-        above = self._build_parzen(above_trials, study, search_space, below=False)
-
         import jax
         import jax.numpy as jnp
 
-        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
         from optuna_tpu._device_policy import small_kernel_scope
 
+        if not self._uses_distance_kernel(search_space):
+            # Joint KDE with the build in-graph (same bandwidths as the
+            # univariate case; the reference has no separate multivariate
+            # bandwidth branch).
+            p = self._parzen_estimator_parameters
+            spec = self._univariate_space_spec(search_space)
+            with small_kernel_scope():
+                dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
+                x_num, x_cat = _kernels.sample_and_score_from_obs(
+                    *dev,
+                    n_samples=self._n_ei_candidates,
+                    consider_endpoints=p.consider_endpoints,
+                    magic_clip=p.consider_magic_clip,
+                    cat_cmax=spec["cat_cmax"],
+                )
+                x_num, x_cat = jax.device_get((x_num, x_cat))
+            return self._decode_fused(spec, x_num, x_cat)
+
+        below = self._build_parzen(below_trials, study, search_space, below=True)
+        above = self._build_parzen(above_trials, study, search_space, below=False)
+
+        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
         with small_kernel_scope():
             x_num, x_cat, _ = _kernels.sample_and_score(
                 seed,
@@ -547,11 +586,27 @@ class TPESampler(BaseSampler):
         below_trials, above_trials = _split_trials(
             study, trials, self._gamma(len(trials)), self._constraints_func is not None
         )
+        from optuna_tpu._device_policy import small_kernel_scope
+
+        if not self._uses_distance_kernel(search_space):
+            p = self._parzen_estimator_parameters
+            spec = self._univariate_space_spec(search_space)
+            with small_kernel_scope():
+                dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
+                x_num, x_cat = _kernels.sample_and_score_topk_from_obs(
+                    *dev,
+                    n_samples=max(self._n_ei_candidates, 4 * n),
+                    k=n,
+                    consider_endpoints=p.consider_endpoints,
+                    magic_clip=p.consider_magic_clip,
+                    cat_cmax=spec["cat_cmax"],
+                )
+                x_num, x_cat = jax.device_get((x_num, x_cat))
+            return [self._decode_fused(spec, x_num[i], x_cat[i]) for i in range(n)]
+
         below = self._build_parzen(below_trials, study, search_space, below=True)
         above = self._build_parzen(above_trials, study, search_space, below=False)
         seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
-        from optuna_tpu._device_policy import small_kernel_scope
-
         with small_kernel_scope():
             x_num, x_cat = _kernels.sample_and_score_topk(
                 seed,

@@ -150,6 +150,78 @@ class BasicSamplerTestCase(_SamplerTestCase):
         assert states.count(TrialState.FAIL) == 4
         assert states.count(TrialState.COMPLETE) == 8
 
+    def test_nan_objective_value_ignored_for_best(self, sampler_factory):
+        """NaN completions must not poison best_value (reference
+        ``pytest_samplers.py:209-227``)."""
+        study = create_study(sampler=sampler_factory())
+
+        def objective(trial: Trial, base: float) -> float:
+            return trial.suggest_float("x", 0.1, 0.2) + base
+
+        for i in range(6, 1, -1):
+            study.optimize(lambda t, i=i: objective(t, i), n_trials=1)
+        assert int(study.best_value) == 2
+        study.optimize(lambda t: objective(t, float("nan")), n_trials=1)
+        assert int(study.best_value) == 2
+        study.optimize(lambda t: objective(t, 1.0), n_trials=1)
+        assert int(study.best_value) == 1
+
+    def test_partial_fixed_wrapper_pins_param(self, sampler_factory):
+        """Every sampler must compose with PartialFixedSampler (reference
+        ``pytest_samplers.py:228-248``)."""
+        from optuna_tpu.samplers import PartialFixedSampler
+
+        def objective(trial: Trial) -> float:
+            x = trial.suggest_float("x", -1, 1)
+            y = trial.suggest_int("y", -1, 1)
+            z = trial.suggest_float("z", -1, 1)
+            return x + y + z
+
+        study = create_study(sampler=sampler_factory())
+        study.optimize(objective, n_trials=1)
+        study.sampler = PartialFixedSampler({"y": 0}, study.sampler)
+        study.optimize(objective, n_trials=1)
+        assert study.trials[-1].params["y"] == 0
+
+    def test_sample_single_point_relative_space(self, sampler_factory):
+        """Degenerate (single-point) distributions across every flavour must
+        sample their only value, including once a model can be fit
+        (reference ``pytest_samplers.py:249-271``)."""
+        from optuna_tpu.distributions import CategoricalDistribution
+
+        space = {
+            "a": CategoricalDistribution([1]),
+            "b": IntDistribution(low=1, high=1),
+            "c": IntDistribution(low=1, high=1, log=True),
+            "d": FloatDistribution(low=1.0, high=1.0),
+            "e": FloatDistribution(low=1.0, high=1.0, log=True),
+            "f": FloatDistribution(low=1.0, high=1.0, step=1.0),
+        }
+        study = create_study(sampler=sampler_factory())
+        for _ in range(2):
+            trial = study.ask(fixed_distributions=space)
+            study.tell(trial, 1.0)
+            for name in space:
+                assert trial.params[name] == 1
+
+    def test_combination_objective_completes(self, sampler_factory):
+        """A space mixing every distribution flavour in one objective
+        (reference ``pytest_samplers.py:307-330``)."""
+
+        def objective(trial: Trial) -> float:
+            x = trial.suggest_float("x", -1.0, 1.0)
+            y = trial.suggest_float("y", 1e-3, 1.0, log=True)
+            z = trial.suggest_float("z", -1.0, 1.0, step=0.25)
+            i = trial.suggest_int("i", 0, 8)
+            j = trial.suggest_int("j", 1, 128, log=True)
+            c = trial.suggest_categorical("c", ("a", "b", "c"))
+            return x + y + z + i + j + (1.0 if c == "a" else 0.0)
+
+        study = create_study(sampler=sampler_factory())
+        study.optimize(objective, n_trials=12)
+        assert len(study.trials) == 12
+        assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
 
 class SeededSamplerTestCase(_SamplerTestCase):
     """Determinism contract for samplers accepting a seed."""

@@ -259,7 +259,7 @@ def test_real_server_smoke():
     assert len(study.trials) == 5
 
 
-def test_delete_study_removes_all_child_rows(pg_like_storage=None, monkeypatch=None):
+def test_delete_study_removes_all_child_rows():
     # MySQL discards inline REFERENCES/CASCADE clauses, so delete_study must
     # clear child tables explicitly; verify by counting rows directly.
     import sys as _sys
