@@ -22,10 +22,13 @@ from optuna_tpu.hypervolume.wfg import compute_hypervolume as _compute_hypervolu
 # Device routing thresholds, set so the device path wins even across a
 # tunneled (~100 ms/dispatch) TPU: the host recursion is O(front^2)-ish at
 # M=3 but blows up combinatorially at M=4 (measured: 2.4 s for a 256-point
-# 4D front vs 73 ms on device). M >= 5 stays on host: the slicing pipeline's
-# deterministic O(N^{M-1}) is unmeasured there and would dwarf the host
-# recursion's pruned average case.
+# 4D front vs 73 ms on device). M >= 5 routes to the WFG stack machine in
+# :mod:`optuna_tpu.ops.wfg` (the slicing pipeline's deterministic
+# O(N^{M-1}) exponent blows up there); measured on TPU: 5D front of 52
+# points — host 429 ms vs device 223 ms; 6D front of 78 — host 2.17 s vs
+# device 1.05 s. Below ~48 front points, tunnel dispatch dominates.
 _DEVICE_MIN_FRONT = {3: 1024, 4: 128}
+_DEVICE_MIN_FRONT_WFG = 48  # applies to every M >= 5
 
 
 def _normalize_for_device(
@@ -61,6 +64,8 @@ def compute_hypervolume(
     reference_point = np.asarray(reference_point, dtype=np.float64)
     m = loss_vals.shape[1] if loss_vals.ndim == 2 else 0
     threshold = _DEVICE_MIN_FRONT.get(m)
+    if threshold is None and m >= 5:
+        threshold = _DEVICE_MIN_FRONT_WFG
     if threshold is not None and len(loss_vals) >= threshold:
         if np.any(np.isnan(loss_vals)):
             raise ValueError("loss_vals must not contain NaN.")
@@ -69,12 +74,65 @@ def compute_hypervolume(
         if len(front) >= threshold:
             norm = _normalize_for_device(front, reference_point)
             if norm is not None:
+                unit, unit_ref, volume = norm
+                if m >= 5:
+                    from optuna_tpu.ops.wfg import hypervolume_wfg_nd
+
+                    return hypervolume_wfg_nd(unit, unit_ref) * volume
                 from optuna_tpu.ops.hypervolume import hypervolume_nd
 
-                unit, unit_ref, volume = norm
                 return hypervolume_nd(unit, unit_ref) * volume
         return _compute_hypervolume_host(front, reference_point, assume_pareto=True)
     return _compute_hypervolume_host(loss_vals, reference_point, assume_pareto)
+
+
+def loo_contributions(
+    loss_vals: np.ndarray, reference_point: np.ndarray
+) -> np.ndarray:
+    """Exclusive (leave-one-out) hypervolume contribution per point, routed.
+
+    The MOTPE below-weights primitive (reference
+    ``_tpe/sampler.py:873``): 2D uses the windowed scan, M in {3, 4} the
+    slicing pipeline, M >= 5 the WFG stack — all as single device programs
+    above their thresholds; small inputs fall back to host leave-one-out.
+    Per-coordinate normalization scales every contribution by the same
+    ``prod(scale)``, which is multiplied back before returning.
+    """
+    loss_vals = np.asarray(loss_vals, dtype=np.float64)
+    reference_point = np.asarray(reference_point, dtype=np.float64)
+    n, m = loss_vals.shape
+    if m == 2:
+        import jax.numpy as jnp
+
+        from optuna_tpu.ops.hypervolume import hypervolume_2d_contributions
+
+        norm = _normalize_for_device(loss_vals, reference_point)
+        if norm is not None:
+            unit, unit_ref, volume = norm
+            out = np.asarray(
+                hypervolume_2d_contributions(
+                    jnp.asarray(unit, jnp.float32), jnp.asarray(unit_ref, jnp.float32)
+                )
+            )
+            return np.maximum(out, 0.0) * volume
+    elif (m in (3, 4) and n >= 64) or (m >= 5 and n >= _DEVICE_MIN_FRONT_WFG):
+        norm = _normalize_for_device(loss_vals, reference_point)
+        if norm is not None:
+            unit, unit_ref, volume = norm
+            if m >= 5:
+                from optuna_tpu.ops.wfg import wfg_loo_nd
+
+                return np.maximum(wfg_loo_nd(unit, unit_ref), 0.0) * volume
+            from optuna_tpu.ops.hypervolume import hypervolume_loo_nd
+
+            return np.maximum(hypervolume_loo_nd(unit, unit_ref), 0.0) * volume
+    hv_total = _compute_hypervolume_host(loss_vals, reference_point)
+    out = np.zeros(n)
+    for i in range(n):
+        subset = np.delete(loss_vals, i, axis=0)
+        hv_wo = _compute_hypervolume_host(subset, reference_point) if len(subset) else 0.0
+        out[i] = max(hv_total - hv_wo, 0.0)
+    return out
 
 
 def solve_hssp(
@@ -84,7 +142,7 @@ def solve_hssp(
     :func:`compute_hypervolume` (reference ``optuna/_hypervolume/hssp.py:45``)."""
     rank_i_loss_vals = np.asarray(rank_i_loss_vals, dtype=np.float64)
     m = rank_i_loss_vals.shape[1] if rank_i_loss_vals.ndim == 2 else 0
-    if m in (3, 4) and len(rank_i_loss_vals) >= 128 and subset_size < len(rank_i_loss_vals):
+    if m >= 3 and len(rank_i_loss_vals) >= 128 and subset_size < len(rank_i_loss_vals):
         # Per-coordinate affine scaling multiplies every HV contribution by
         # the same constant, so the greedy argmax sequence — hence the
         # selected index set — is unchanged by normalization.
@@ -97,4 +155,4 @@ def solve_hssp(
     return _solve_hssp_host(rank_i_loss_vals, reference_point, subset_size)
 
 
-__all__ = ["compute_hypervolume", "solve_hssp"]
+__all__ = ["compute_hypervolume", "loo_contributions", "solve_hssp"]

@@ -200,8 +200,8 @@ def hypervolume_loo_contributions(
     return jnp.where(mask, jnp.maximum(total - loo, 0.0), 0.0)
 
 
-@partial(jax.jit, static_argnames=("k_pad",))
-def _hssp_greedy(points, reference_point, mask, k, k_pad):
+@partial(jax.jit, static_argnames=("k_pad", "use_wfg"))
+def _hssp_greedy(points, reference_point, mask, k, k_pad, use_wfg=False):
     """Greedy HSSP on device: ``k`` steps, each scoring all N candidates'
     joint hypervolume with the current selection in one vmapped batch.
 
@@ -209,8 +209,15 @@ def _hssp_greedy(points, reference_point, mask, k, k_pad):
     (``optuna/_hypervolume/hssp.py:45``; laziness only reorders evaluations).
     ``k_pad`` bounds the selection buffer so the compiled program is reused
     across nearby subset sizes; unused rows sit at the reference point and
-    contribute nothing.
+    contribute nothing. ``use_wfg`` switches the per-candidate scorer from
+    the O(k^{M-1}) slicing pipeline to the WFG stack machine
+    (:mod:`optuna_tpu.ops.wfg`), which wins for M >= 5 where slicing's
+    exponent blows up; candidate sets are only k_pad+1 points, so the
+    vmapped lockstep while_loops stay shallow.
     """
+    from optuna_tpu.ops.wfg import hypervolume_wfg
+
+    hv_fn = hypervolume_wfg if use_wfg else hypervolume_masked
     n, m_dim = points.shape
     sel = jnp.broadcast_to(reference_point, (k_pad, m_dim))
     chosen = jnp.full((k_pad,), -1, jnp.int32)
@@ -221,7 +228,7 @@ def _hssp_greedy(points, reference_point, mask, k, k_pad):
         cand = jnp.concatenate(
             [jnp.broadcast_to(sel[None], (n, k_pad, m_dim)), points[:, None, :]], axis=1
         )
-        hvs = jax.vmap(lambda s: hypervolume_masked(s, reference_point, all_true))(cand)
+        hvs = jax.vmap(lambda s: hv_fn(s, reference_point, all_true))(cand)
         gains = jnp.where(avail, hvs - hv_sel, -jnp.inf)
         i = jnp.argmax(gains)
         return (
@@ -240,7 +247,12 @@ def _hssp_greedy(points, reference_point, mask, k, k_pad):
 def solve_hssp_device(
     points: np.ndarray, reference_point: np.ndarray, subset_size: int
 ) -> np.ndarray:
-    """Host entry for device greedy HSSP; returns selected indices (k,)."""
+    """Host entry for device greedy HSSP; returns selected indices (k,).
+
+    The per-candidate scorer is chosen by objective count: slicing for
+    M <= 4, the WFG stack for M >= 5 (measured crossover — slicing is
+    O(k^{M-1}) per candidate).
+    """
     n = len(points)
     k = int(min(subset_size, n))
     if k <= 0:
@@ -255,6 +267,7 @@ def solve_hssp_device(
         mask,
         k,
         k_pad,
+        use_wfg=points.shape[1] >= 5,
     )
     return np.asarray(chosen)[:k].astype(np.int64)
 

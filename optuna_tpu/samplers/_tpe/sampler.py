@@ -314,10 +314,16 @@ class TPESampler(BaseSampler):
         return params
 
     def _univariate_space_spec(self, search_space: dict[str, BaseDistribution]):
-        """Cached per-space-signature static arrays for the fused kernel."""
+        """Cached per-space-signature static arrays for the fused kernel.
+
+        Bounded: dynamic search spaces (e.g. per-trial float bounds) mint a
+        fresh signature every call, so the cache is capped — misses only
+        cost a cheap host-side rebuild (ADVICE r3)."""
         key = tuple((n, repr(d)) for n, d in search_space.items())
         spec = self._univariate_space_specs.get(key)
         if spec is None:
+            if len(self._univariate_space_specs) >= 128:
+                self._univariate_space_specs.clear()
             from optuna_tpu.samplers._tpe.parzen_estimator import _transformed_bounds
 
             num_items = [
@@ -835,7 +841,7 @@ def _calculate_weights_below_for_multi_objective(
     ``_calculate_weights_below_for_multi_objective:873``)."""
     if len(below_trials) <= 1:
         return None
-    from optuna_tpu.hypervolume import compute_hypervolume
+    from optuna_tpu.hypervolume import loo_contributions
     from optuna_tpu.study._multi_objective import _normalize_values
 
     loss_vals = _normalize_values(
@@ -848,32 +854,9 @@ def _calculate_weights_below_for_multi_objective(
     ref_point = _hv_reference_point(worst)
     contributions = np.zeros(len(below_trials))
     finite_idx = np.flatnonzero(finite)
-    if loss_vals.shape[1] == 2:
-        # 2-objective exclusive contributions in one device program
-        # (ops/hypervolume.py) instead of n leave-one-out host WFG calls.
-        from optuna_tpu.ops.hypervolume import hypervolume_2d_contributions
-        import jax.numpy as jnp
-
-        contrib = np.asarray(
-            hypervolume_2d_contributions(
-                jnp.asarray(loss_vals[finite], dtype=jnp.float32),
-                jnp.asarray(ref_point, dtype=jnp.float32),
-            )
-        )
-        contributions[finite_idx] = np.maximum(contrib, 0.0)
-    elif loss_vals.shape[1] in (3, 4) and len(finite_idx) >= 64:
-        # Large M in {3,4} sets: all leave-one-out contributions in one
-        # N-bucketed device program instead of n sequential host recursions.
-        from optuna_tpu.ops.hypervolume import hypervolume_loo_nd
-
-        contrib = hypervolume_loo_nd(loss_vals[finite], ref_point)
-        contributions[finite_idx] = np.maximum(contrib, 0.0)
-    else:
-        hv_total = compute_hypervolume(loss_vals[finite], ref_point)
-        for j, i in enumerate(finite_idx):
-            subset = np.delete(loss_vals[finite], j, axis=0)
-            hv_without = compute_hypervolume(subset, ref_point) if len(subset) else 0.0
-            contributions[i] = max(hv_total - hv_without, 0.0)
+    # Routed exclusive contributions: windowed 2D scan / slicing (M 3-4) /
+    # WFG stack (M >= 5) as single device programs at scale, host below.
+    contributions[finite_idx] = loo_contributions(loss_vals[finite], ref_point)
     if contributions.sum() <= 0:
         return None
     weights = contributions + 1e-12

@@ -151,10 +151,14 @@ class SqliteDialect:
 
 
 _UPSERT_RE = re.compile(
-    r"ON CONFLICT\(([^)]*)\) DO UPDATE SET (.*)$", re.DOTALL
+    r"ON\s+CONFLICT\s*\(([^)]*)\)\s*DO\s+UPDATE\s+SET\s+(.*)$",
+    re.DOTALL | re.IGNORECASE,
 )
-_EXCLUDED_RE = re.compile(r"excluded\.(\w+)")
+_EXCLUDED_RE = re.compile(r"excluded\.(\w+)", re.IGNORECASE)
 _KEY_COL_RE = re.compile(r"\bkey\b")  # case-sensitive: skips "PRIMARY KEY"
+# Translation-completeness check: any sqlite-only construct surviving into a
+# server dialect means a rewrite regex silently failed to match (ADVICE r3).
+_SQLITE_ONLY_RE = re.compile(r"ON\s+CONFLICT\s*\(|excluded\.|INSERT\s+OR\s+IGNORE", re.IGNORECASE)
 
 
 class _ServerDialect:
@@ -187,6 +191,11 @@ class _ServerDialect:
     def integrity_errors(self) -> tuple[type[Exception], ...]:
         return (sqlite3.IntegrityError, self._module.IntegrityError)
 
+    # Overridden per dialect: constructs that must NOT survive translation
+    # (PostgreSQL speaks ON CONFLICT natively, so it only bans the sqlite
+    # INSERT OR IGNORE spelling; MySQL bans all three).
+    _forbidden_after_translate = re.compile(r"INSERT\s+OR\s+IGNORE", re.IGNORECASE)
+
     def translate(self, sql: str) -> str:
         cached = self._translate_cache.get(sql)
         if cached is not None:
@@ -195,6 +204,11 @@ class _ServerDialect:
         out = self._rewrite_insert_ignore(out)
         out = self._quote_key_column(out)
         out = out.replace("?", "%s")
+        if self._forbidden_after_translate.search(out) is not None:
+            raise RuntimeError(
+                f"SQL rewrite incomplete for {self.name}: sqlite-only syntax "
+                f"survived translation: {out[:200]!r}"
+            )
         self._translate_cache[sql] = out
         return out
 
@@ -245,6 +259,15 @@ class _ServerDialect:
         reference ``storage.py:997-1000``). Returns None if it went stale so
         the caller reconnects. Throttled: a connection used within the last
         few seconds cannot have hit ``wait_timeout``, so skip the ping."""
+        if con.broken:
+            # A prior execute hit a connection-level error (server restart,
+            # killed session): hand back None so the caller reconnects
+            # instead of surfacing repeated hard failures (ADVICE r3).
+            try:
+                con.close()
+            except Exception:
+                pass
+            return None
         if not self._engine_kwargs.get("pool_pre_ping", True):
             return con
         import time
@@ -282,6 +305,7 @@ class _ServerDialect:
 
 class MySQLDialect(_ServerDialect):
     name = "mysql"
+    _forbidden_after_translate = _SQLITE_ONLY_RE
 
     def _resolve_driver(self) -> Any:
         return _import_driver("MySQL", self._url.driver, _MYSQL_DRIVERS)
@@ -372,21 +396,59 @@ class _ServerConnection:
         self._raw = raw
         self._dialect = dialect
         self.last_used = 0.0
+        self.broken = False
 
     def _touch(self) -> None:
         import time
 
         self.last_used = time.monotonic()
 
+    def _is_connection_error(self, err: Exception) -> bool:
+        """Did ``err`` kill the connection (vs. a retryable statement error)?
+
+        OperationalError also covers deadlocks / lock-wait timeouts, which
+        must NOT poison the handle — so consult the driver's own liveness
+        flag first (psycopg ``closed``, pymysql ``open``), falling back to
+        the MySQL connection-lost errnos."""
+        mod = self._dialect._module
+        iface = getattr(mod, "InterfaceError", None)
+        if iface is not None and isinstance(err, iface):
+            return True
+        oper = getattr(mod, "OperationalError", None)
+        if oper is None or not isinstance(err, oper):
+            return False
+        closed = getattr(self._raw, "closed", None)  # psycopg: truthy when dead
+        if closed is not None:
+            return bool(closed)
+        is_open = getattr(self._raw, "open", None)  # pymysql: falsy when dead
+        if is_open is not None:
+            return not is_open
+        args = getattr(err, "args", ())
+        # 2006 server gone, 2013 lost connection, 2055 lost connection to
+        # server, 4031 inactivity timeout.
+        return bool(args and isinstance(args[0], int) and args[0] in (2006, 2013, 2055, 4031))
+
     def execute(self, sql: str, args: Sequence[Any] = ()) -> Any:
         cur = self._raw.cursor()
-        cur.execute(self._dialect.translate(sql), tuple(args))
+        try:
+            cur.execute(self._dialect.translate(sql), tuple(args))
+        except Exception as err:
+            # Connection-level failures poison the handle; checkout() sees
+            # the flag and reconnects on the next operation (ADVICE r3).
+            if self._is_connection_error(err):
+                self.broken = True
+            raise
         self._touch()
         return cur
 
     def executemany(self, sql: str, seq: Sequence[Sequence[Any]]) -> Any:
         cur = self._raw.cursor()
-        cur.executemany(self._dialect.translate(sql), [tuple(a) for a in seq])
+        try:
+            cur.executemany(self._dialect.translate(sql), [tuple(a) for a in seq])
+        except Exception as err:
+            if self._is_connection_error(err):
+                self.broken = True
+            raise
         self._touch()
         return cur
 

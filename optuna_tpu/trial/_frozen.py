@@ -84,16 +84,35 @@ class FrozenTrial:
             self._values = None
 
     def _structural_copy(self) -> "FrozenTrial":
-        """Fresh FrozenTrial with copied containers but shared leaf values.
+        """Fresh FrozenTrial with copied containers but shared scalar leaves.
 
         Isolation-equivalent to ``copy.deepcopy`` for every mutation the
         runtime performs (field assignment, dict insertion) at a fraction of
         the cost — deepcopy walks 50 distribution dataclasses per read on a
-        wide space, which dominated the tell path. Leaf values (numbers,
-        strings, datetimes, distributions-by-convention) are immutable; the
-        reference shares the entire object without any copy
+        wide space, which dominated the tell path. Scalar leaf values
+        (numbers, strings, datetimes, distributions-by-convention) are
+        immutable and shared; attr values that are themselves mutable
+        containers (a user's ``user_attrs['hist']`` list, say) are
+        deep-copied so in-place mutation of a returned trial can never write
+        through to storage internals (ADVICE r3). The reference shares the
+        entire object without any copy
         (``optuna/storages/_in_memory.py:362-369``), so this is strictly
         more isolated than the parity target."""
+
+        _scalar = (int, float, complex, bool, str, bytes, type(None), datetime.datetime)
+
+        def _copy_attrs(attrs: dict) -> dict:
+            # Scalars are shared; anything else (lists, dicts, ndarrays,
+            # tuples that may wrap mutables) is deep-copied.
+            if all(isinstance(v, _scalar) for v in attrs.values()):
+                return dict(attrs)  # hot path: scalar-only attrs, one shallow copy
+            import copy as _copy
+
+            return {
+                k: v if isinstance(v, _scalar) else _copy.deepcopy(v)
+                for k, v in attrs.items()
+            }
+
         return FrozenTrial(
             number=self.number,
             state=self.state,
@@ -102,8 +121,8 @@ class FrozenTrial:
             datetime_complete=self.datetime_complete,
             params=dict(self.params),
             distributions=dict(self._distributions),
-            user_attrs=dict(self.user_attrs),
-            system_attrs=dict(self.system_attrs),
+            user_attrs=_copy_attrs(self.user_attrs),
+            system_attrs=_copy_attrs(self.system_attrs),
             intermediate_values=dict(self.intermediate_values),
             trial_id=self._trial_id,
             values=list(self._values) if self._values is not None else None,
