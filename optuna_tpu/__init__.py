@@ -10,6 +10,13 @@ hypervolume, and pod-scale distributed studies synchronized over ICI.
 Top-level re-exports mirror ``optuna/__init__.py:28-54``.
 """
 
+from optuna_tpu.utils._compile_cache import ensure_compile_cache as _ensure_compile_cache
+
+# Persistent XLA cache across processes: a cold `import optuna_tpu` study
+# reuses every previously compiled sampler program (no-op if the user
+# configured their own cache; OPTUNA_TPU_NO_COMPILE_CACHE=1 opts out).
+_ensure_compile_cache()
+
 from optuna_tpu import distributions, exceptions, importance, logging, pruners, samplers
 from optuna_tpu import search_space, storages, study, trial
 from optuna_tpu.exceptions import TrialPruned

@@ -1,0 +1,59 @@
+"""Persistent XLA compilation cache, enabled as a framework feature.
+
+The fused sampler programs (GP suggestion chains, TPE KDE kernels, CMA-ES
+generations) re-specialize per history bucket; a cold process otherwise
+pays every compile again. Pointing JAX's persistent compilation cache at a
+per-user on-disk directory makes the *second* process start warm — the
+production deployment story the reference never needs (its NumPy/torch
+samplers have no compile step) but a compiled framework must ship.
+
+Respecting the user: an explicitly configured cache (via the
+``JAX_COMPILATION_CACHE_DIR`` env var or ``jax.config``) is left alone,
+and ``OPTUNA_TPU_NO_COMPILE_CACHE=1`` opts out entirely.
+"""
+
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def ensure_compile_cache() -> None:
+    """Idempotently point JAX's persistent compile cache at a durable dir."""
+    global _done
+    if _done:
+        return
+    _done = True
+    if os.environ.get("OPTUNA_TPU_NO_COMPILE_CACHE"):
+        return
+    try:
+        import sys
+
+        default_dir = os.environ.get(
+            "OPTUNA_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "optuna_tpu", "xla"),
+        )
+        if "jax" not in sys.modules:
+            # jax not imported yet: the env route avoids forcing the import
+            # here (jax reads these at its own import time).
+            if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+                os.makedirs(default_dir, exist_ok=True)
+                os.environ["JAX_COMPILATION_CACHE_DIR"] = default_dir
+            os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+            os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+            return
+        import jax
+
+        if not (
+            os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or jax.config.jax_compilation_cache_dir
+        ):
+            os.makedirs(default_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", default_dir)
+        # Cache every program: sampler kernels are numerous and individually
+        # cheap-ish to compile, but a cold study pays for dozens of them.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - cache is an optimization only
+        pass
