@@ -31,8 +31,8 @@ def _setup_jax_cache() -> None:
             "jax_compilation_cache_dir",
             os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/optuna_tpu_jax_cache"),
         )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # Thresholds stay at jax defaults: caching every tiny executable
+        # (0/0) measurably slows a cold run with disk writes (r4 regression).
     except Exception:
         pass
 
@@ -127,21 +127,22 @@ def run_ours_gp_end_to_end(n_total: int, chain: int = 8) -> tuple[float, float]:
     return time.time() - t0, study.best_value
 
 
-def run_ours_tpe(n_warmup: int, n_timed: int) -> tuple[float, float]:
+def run_ours_tpe(n_warmup: int, n_timed: int, objective=None) -> tuple[float, float]:
     import optuna_tpu
     from optuna_tpu.models.benchmarks import branin
     from optuna_tpu.samplers import TPESampler
 
     _silence()
+    objective = objective or branin
     # Throwaway study visits every history bucket the timed window will touch,
     # so the measurement excludes XLA compile time (same policy as the GP
     # prewarm; in-bucket TPE runs at reference-parity rates).
     warm = optuna_tpu.create_study(sampler=TPESampler(seed=1))
-    warm.optimize(branin, n_trials=n_warmup + n_timed)
+    warm.optimize(objective, n_trials=n_warmup + n_timed)
     study = optuna_tpu.create_study(sampler=TPESampler(seed=0))
-    study.optimize(branin, n_trials=n_warmup)
+    study.optimize(objective, n_trials=n_warmup)
     t0 = time.time()
-    study.optimize(branin, n_trials=n_timed)
+    study.optimize(objective, n_trials=n_timed)
     dt = time.time() - t0
     return n_timed / dt, study.best_value
 
@@ -372,15 +373,17 @@ def run_baseline_gp(n_warmup: int, n_timed: int) -> tuple[float, float] | None:
         return None
 
 
-def run_baseline_tpe(n_warmup: int, n_timed: int) -> tuple[float, float] | None:
+def run_baseline_tpe(
+    n_warmup: int, n_timed: int, objective=None
+) -> tuple[float, float] | None:
     try:
         optuna = _import_reference()
         from optuna_tpu.models.benchmarks import branin
 
         study = optuna.create_study(sampler=optuna.samplers.TPESampler(seed=0))
-        study.optimize(branin, n_trials=n_warmup)
+        study.optimize(objective or branin, n_trials=n_warmup)
         t0 = time.time()
-        study.optimize(branin, n_trials=n_timed)
+        study.optimize(objective or branin, n_trials=n_timed)
         dt = time.time() - t0
         return n_timed / dt, study.best_value
     except Exception as e:  # pragma: no cover
@@ -597,8 +600,8 @@ def main() -> None:
         "--config",
         default="gp",
         choices=[
-            "gp", "gp_window", "gp_batch", "tpe", "cmaes", "nsga2",
-            "nsga2_zdt2", "nsga2_zdt3", "mlp", "hv",
+            "gp", "gp_window", "gp_batch", "tpe", "tpe_highdim", "cmaes",
+            "nsga2", "nsga2_zdt2", "nsga2_zdt3", "mlp", "hv",
         ],
     )
     parser.add_argument("--quick", action="store_true")
@@ -668,6 +671,15 @@ def main() -> None:
         _log(f"ours: {ours_rate:.3f} trials/s; running baseline...")
         base = run_baseline_tpe(n_warm, n_timed)
         metric = "tpe_sampler_trials_per_sec_branin"
+    elif args.config == "tpe_highdim":
+        from optuna_tpu.models.benchmarks import highdim_mixed
+
+        n_warm, n_timed = (30, 70) if args.quick else (50, 250)
+        _log("running ours (TPESampler / 30-param mixed space)...")
+        ours_rate, ours_best = run_ours_tpe(n_warm, n_timed, highdim_mixed)
+        _log(f"ours: {ours_rate:.3f} trials/s; running baseline...")
+        base = run_baseline_tpe(n_warm, n_timed, highdim_mixed)
+        metric = "tpe_sampler_trials_per_sec_highdim_mixed30"
     elif args.config == "cmaes":
         n_warm, n_timed = (100, 400) if args.quick else (500, 2000)
         ours_rate, ours_best = run_ours_cmaes(n_warm, n_timed)

@@ -101,7 +101,9 @@ def loo_contributions(
     loss_vals = np.asarray(loss_vals, dtype=np.float64)
     reference_point = np.asarray(reference_point, dtype=np.float64)
     n, m = loss_vals.shape
-    if m == 2:
+    if m == 2 and n >= 32:
+        # Below ~32 points the host O(n log n) scan is microseconds while a
+        # tunneled dispatch is ~100 ms — mirror the M >= 3 thresholds.
         import jax.numpy as jnp
 
         from optuna_tpu.ops.hypervolume import hypervolume_2d_contributions

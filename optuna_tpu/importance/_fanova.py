@@ -1,8 +1,11 @@
 """fANOVA importance: random forest + exact per-tree marginal variance.
 
-Parity target: ``optuna/importance/_fanova/`` — sklearn RandomForestRegressor
-over the transformed space, then for each tree an exact functional-ANOVA
-first-order decomposition over the tree's split boxes (``_tree.py``):
+Parity target: ``optuna/importance/_fanova/`` — a random-forest fit
+over the transformed space (the reference wraps sklearn's
+RandomForestRegressor, ``_fanova/_evaluator.py:132``; here the forest is
+the device histogram kernel :mod:`optuna_tpu.ops.forest`), then for each
+tree an exact functional-ANOVA first-order decomposition over the tree's
+split boxes (``_tree.py``):
 ``importance_j = E_trees[ Var_{x_j}(marginal_j) / Var(tree) ]``.
 """
 
@@ -111,7 +114,7 @@ class FanovaImportanceEvaluator(BaseImportanceEvaluator):
         *,
         target: Callable | None = None,
     ) -> dict[str, float]:
-        from sklearn.ensemble import RandomForestRegressor
+        from optuna_tpu.ops.forest import fit_forest
 
         trials, params = _get_filtered_trials(study, params, target)
         space = {p: trials[0].distributions[p] for p in params}
@@ -129,19 +132,18 @@ class FanovaImportanceEvaluator(BaseImportanceEvaluator):
         if len(np.unique(y)) == 1:
             return {p: 0.0 for p in params}
 
-        forest = RandomForestRegressor(
-            n_estimators=self._n_trees,
+        trees = fit_forest(
+            X, y,
+            n_trees=self._n_trees,
             max_depth=self._max_depth,
             min_samples_split=2,
-            min_samples_leaf=1,
-            random_state=self._seed,
+            seed=self._seed,
         )
-        forest.fit(X, y)
 
         groups = [np.asarray(cols) for cols in trans.column_to_encoded_columns]
         fractions = np.zeros(len(groups))
         n_used = 0
-        for tree in forest.estimators_:
+        for tree in trees:
             gv, tv = _tree_group_variances(tree, groups)
             if tv > 0:
                 fractions += gv / tv

@@ -1,6 +1,8 @@
 """Mean-decrease-impurity importance (reference
 ``optuna/importance/_mean_decrease_impurity.py``): the random forest's own
-``feature_importances_``, one-hot columns collapsed per parameter."""
+impurity-decrease importances, one-hot columns collapsed per parameter.
+The forest is the device histogram kernel (:mod:`optuna_tpu.ops.forest`);
+the reference wraps sklearn's ``feature_importances_``."""
 
 from __future__ import annotations
 
@@ -29,7 +31,7 @@ class MeanDecreaseImpurityImportanceEvaluator(BaseImportanceEvaluator):
         *,
         target: Callable | None = None,
     ) -> dict[str, float]:
-        from sklearn.ensemble import RandomForestRegressor
+        from optuna_tpu.ops.forest import fit_forest, forest_feature_importances
 
         trials, params = _get_filtered_trials(study, params, target)
         space = {p: trials[0].distributions[p] for p in params}
@@ -37,11 +39,10 @@ class MeanDecreaseImpurityImportanceEvaluator(BaseImportanceEvaluator):
         X = trans.encode_many([t.params for t in trials])
         y = _target_values(trials, target)
 
-        forest = RandomForestRegressor(
-            n_estimators=self._n_trees, max_depth=self._max_depth, random_state=self._seed
+        trees = fit_forest(
+            X, y, n_trees=self._n_trees, max_depth=self._max_depth, seed=self._seed
         )
-        forest.fit(X, y)
-        feat = forest.feature_importances_
+        feat = forest_feature_importances(trees, X.shape[1])
 
         importances = {p: 0.0 for p in params}
         for enc_col, col in enumerate(trans.encoded_column_to_column):

@@ -132,3 +132,28 @@ def zdt3(trial, dim: int = 30):
     g = _zdt_g(xs)
     f1 = float(xs[0])
     return f1, g * (1 - math.sqrt(f1 / g) - (f1 / g) * math.sin(10 * math.pi * f1))
+
+
+# -------------------------------------------------- high-dim mixed space
+
+
+def highdim_mixed(trial) -> float:
+    """30-parameter mixed search space (20 float — 5 of them log — plus 5 int
+    and 5 categorical). Exercises the per-trial sampler cost at realistic HPO
+    width: the reference's TPE fits each dimension in its own Python/NumPy
+    pass, while the fused univariate batch builds and samples every dimension
+    in one device program (``samplers/_tpe/sampler.py:200``)."""
+    total = 0.0
+    for i in range(15):
+        x = trial.suggest_float(f"x{i}", -3.0, 3.0)
+        total += (x - 0.3 * (i % 5)) ** 2
+    for i in range(5):
+        lr = trial.suggest_float(f"log{i}", 1e-5, 1e-1, log=True)
+        total += (math.log10(lr) + 2.0 + 0.2 * i) ** 2
+    for i in range(5):
+        k = trial.suggest_int(f"n{i}", 1, 64)
+        total += 0.01 * (k - 8 * (i + 1)) ** 2
+    for i in range(5):
+        c = trial.suggest_categorical(f"c{i}", ["a", "b", "c", "d"])
+        total += {"a": 0.0, "b": 0.3, "c": 0.6, "d": 0.9}[c]
+    return total

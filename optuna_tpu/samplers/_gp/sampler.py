@@ -12,6 +12,7 @@ the host only encodes/decodes params and sequences the pipeline.
 
 from __future__ import annotations
 
+import os
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
@@ -36,6 +37,162 @@ _logger = get_logger(__name__)
 
 _N_FANTASIES = 128
 _STABILIZING_NOISE = 1e-10
+
+# ------------------------------------------------------------- precompile pool
+# A single shared non-daemon worker runs ahead-of-bucket AOT compiles. The
+# worker never touches the device (``lower().compile()`` only), and shutdown
+# is explicit: queued jobs are dropped, an in-flight host-side compile is
+# joined, so the interpreter never tears the XLA runtime down under a live
+# thread (the r4 daemon-thread design aborted the process at exit).
+#
+# Cross-process dedup: tracing the fused chain programs is seconds of pure
+# GIL-holding Python, which on a small host competes with the main loop even
+# from a background thread. Once a job's executable is in the persistent
+# cache, later processes must not pay that trace again — each successful
+# compile drops a marker file (keyed by jax version, backend, a digest of
+# the kernel sources, and the job params) next to the cache entries, and
+# marked jobs are skipped before any thread is spawned.
+import threading as _threading
+
+_PRECOMPILE_MAX_QUEUE = 16
+_precompile_pool = None
+_precompile_pending = 0
+# Created at import: lazy creation would race under optimize(n_jobs > 1),
+# handing concurrent trial threads distinct locks that guard nothing.
+_precompile_lock = _threading.Lock()
+
+
+def _kernel_source_digest() -> str:
+    """Digest of the sources that shape the fused programs' HLO."""
+    import hashlib
+
+    h = hashlib.sha256()
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for rel in ("gp", "ops"):
+        folder = os.path.join(root, rel)
+        try:
+            names = sorted(os.listdir(folder))
+        except OSError:
+            continue
+        for name in names:
+            if name.endswith(".py"):
+                try:
+                    with open(os.path.join(folder, name), "rb") as f:
+                        h.update(f.read())
+                except OSError:
+                    pass
+    return h.hexdigest()[:16]
+
+
+def _precompile_marker_path(job_key: tuple) -> str | None:
+    """Marker file recording that ``job_key``'s executable is on disk."""
+    global _kernel_digest_cached
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir or os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR"
+        )
+        if not cache_dir:
+            return None
+        if _kernel_digest_cached is None:
+            _kernel_digest_cached = _kernel_source_digest()
+        import hashlib
+
+        payload = repr((jax.__version__, jax.default_backend(), _kernel_digest_cached, job_key))
+        name = "optuna-tpu-precompiled-" + hashlib.sha256(payload.encode()).hexdigest()[:24]
+        return os.path.join(cache_dir, name)
+    except Exception:  # pragma: no cover
+        return None
+
+
+_kernel_digest_cached: str | None = None
+
+
+def _submit_precompile(job_args: tuple) -> None:
+    global _precompile_pool, _precompile_pending
+
+    with _precompile_lock:
+        if _precompile_pending >= _PRECOMPILE_MAX_QUEUE:
+            return
+        if _precompile_pool is None:
+            import atexit
+            from concurrent.futures import ThreadPoolExecutor
+
+            _precompile_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="optuna-tpu-precompile"
+            )
+            atexit.register(_shutdown_precompile_pool)
+        _precompile_pending += 1
+        pool = _precompile_pool
+    try:
+        pool.submit(_precompile_job, *job_args)
+    except RuntimeError:  # pool torn down between check and submit
+        with _precompile_lock:
+            _precompile_pending -= 1
+
+
+def _shutdown_precompile_pool() -> None:
+    global _precompile_pool
+    with _precompile_lock:
+        pool, _precompile_pool = _precompile_pool, None
+    if pool is not None:
+        pool.shutdown(wait=True, cancel_futures=True)
+
+
+def _precompile_job(
+    dev, d: int, n_bucket: int, q: int, n_starts: int, fit_iters: int,
+    n_local: int, minimum_noise: float, marker: str | None,
+) -> None:
+    global _precompile_pending
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from optuna_tpu.gp.fused import gp_suggest_chain_fused, gp_suggest_fused
+
+        f32 = jnp.float32
+        starts = jax.ShapeDtypeStruct((n_starts, d + 2), f32)
+        Xp = jax.ShapeDtypeStruct((n_bucket, d), f32)
+        yp = jax.ShapeDtypeStruct((n_bucket,), f32)
+        maskp = jax.ShapeDtypeStruct((n_bucket,), f32)
+        inc = jax.ShapeDtypeStruct((4, d), f32)
+        key = jax.random.PRNGKey(0)
+        common = (
+            dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
+            dev.dim_onehot, dev.choice_grid, dev.choice_valid,
+        )
+        if q == 0:
+            lowered = gp_suggest_fused.lower(
+                starts, Xp, yp, dev.cat_mask, maskp, dev.sobol_base, inc,
+                key, minimum_noise, *common,
+                n_local_search=n_local, fit_iters=fit_iters,
+                has_sweep=dev.has_sweep,
+            )
+        else:
+            lowered = gp_suggest_chain_fused.lower(
+                starts, Xp, yp, dev.cat_mask, maskp,
+                jax.ShapeDtypeStruct((), jnp.int32), dev.sobol_base, inc,
+                key, minimum_noise, *common, q=q, n_local_search=n_local,
+                fit_iters=fit_iters, has_sweep=dev.has_sweep,
+            )
+        lowered.compile()
+        # Safe to mark unconditionally: every program in this family takes
+        # multi-second XLA compiles cold (well past jax's 1 s persistence
+        # threshold), so compile() returning at all means the executable is
+        # now on disk — either it just compiled (and persisted) or it
+        # deserialized from an existing cache entry.
+        if marker is not None:
+            try:
+                with open(marker, "w"):
+                    pass
+            except OSError:
+                pass
+    except BaseException:  # pragma: no cover - precompile is best-effort
+        _logger.debug("precompile-ahead failed", exc_info=True)
+    finally:
+        with _precompile_lock:
+            _precompile_pending -= 1
 
 
 class GPSampler(BaseSampler):
@@ -77,10 +234,10 @@ class GPSampler(BaseSampler):
         self._spec_sig: tuple | None = None
         self._spec_expected_n = -1
         # Speculative ahead-of-bucket compilation: while the study runs in
-        # history bucket N, a daemon thread compiles the bucket-2N program
-        # (and the warm-fit variant of the current bucket) so crossing a
-        # bucket boundary never blocks on XLA. Cuts cold-process wall time
-        # roughly in half on the n=1000 headline; the persistent cache
+        # history bucket N, a background worker AOT-compiles the bucket-2N
+        # program (and the warm-fit variant of the current bucket) so
+        # crossing a bucket boundary never blocks on XLA. Compile-only —
+        # nothing is dispatched to the device. The persistent cache
         # (utils/_compile_cache.py) then makes later processes fully warm.
         self._precompile_ahead = precompile_ahead
         self._precompiled: set[tuple] = set()
@@ -292,56 +449,30 @@ class GPSampler(BaseSampler):
     def _precompile_async(
         self, dev, d: int, n_bucket: int, q: int, n_starts: int, fit_iters: int
     ) -> None:
-        """Compile the (n_bucket, n_starts, fit_iters[, q]) fused program in a
-        daemon thread with shape-matched dummies. The jit compile lands in
-        the process-wide executable cache (and the persistent disk cache), so
-        the main loop's later dispatch at that bucket is a cache hit instead
-        of a blocking compile. Values are irrelevant — only shapes and static
-        args key the compile."""
+        """AOT-compile the (n_bucket, n_starts, fit_iters[, q]) fused program
+        on the shared background worker. ``jit(...).lower(...).compile()``
+        traces and compiles WITHOUT dispatching to the device, so the warm-up
+        never competes with the main loop for the chip; the executable lands
+        in XLA's persistent compile cache, turning the main loop's later
+        compile at this bucket into a fast deserialize. Values are irrelevant
+        — only shapes and static args key the compile."""
         key = (id(dev), n_bucket, q, n_starts, fit_iters)
         if not self._precompile_ahead or key in self._precompiled:
             return
         self._precompiled.add(key)
         n_local = self._n_local_search if q == 0 else min(self._n_local_search, 6)
         minimum_noise = 1e-7 if self._deterministic else 1e-5
-
-        def run() -> None:
-            try:
-                import jax
-                import jax.numpy as jnp
-
-                from optuna_tpu.gp.fused import gp_suggest_chain_fused, gp_suggest_fused
-
-                starts = jnp.zeros((n_starts, d + 2), jnp.float32)
-                Xp = jnp.zeros((n_bucket, d), jnp.float32)
-                yp = jnp.zeros((n_bucket,), jnp.float32)
-                maskp = jnp.zeros((n_bucket,), jnp.float32).at[:3].set(1.0)
-                inc = jnp.zeros((4, d), jnp.float32)
-                common = (
-                    dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
-                    dev.dim_onehot, dev.choice_grid, dev.choice_valid,
-                )
-                if q == 0:
-                    out = gp_suggest_fused(
-                        starts, Xp, yp, dev.cat_mask, maskp, dev.sobol_base, inc,
-                        jax.random.PRNGKey(0), minimum_noise, *common,
-                        n_local_search=n_local, fit_iters=fit_iters,
-                        has_sweep=dev.has_sweep,
-                    )
-                else:
-                    out = gp_suggest_chain_fused(
-                        starts, Xp, yp, dev.cat_mask, maskp, jnp.asarray(3, jnp.int32),
-                        dev.sobol_base, inc, jax.random.PRNGKey(0), minimum_noise,
-                        *common, q=q, n_local_search=n_local, fit_iters=fit_iters,
-                        has_sweep=dev.has_sweep,
-                    )
-                jax.block_until_ready(out)
-            except Exception:  # pragma: no cover - precompile is best-effort
-                pass
-
-        import threading
-
-        threading.Thread(target=run, daemon=True, name="optuna-tpu-precompile").start()
+        job_key = (
+            d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise,
+            bool(dev.has_sweep), tuple(dev.sobol_base.shape),
+            tuple(dev.dim_onehot.shape), tuple(dev.choice_grid.shape),
+        )
+        marker = _precompile_marker_path(job_key)
+        if marker is not None and os.path.exists(marker):
+            return  # executable already in the persistent cache; skip the trace
+        _submit_precompile(
+            (dev, d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise, marker)
+        )
 
     def _precompile_after_dispatch(self, dev, d: int, n_bucket: int, q: int, was_cold: bool) -> None:
         """After a real dispatch at ``n_bucket``: warm-fit variant of this

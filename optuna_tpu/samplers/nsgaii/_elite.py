@@ -35,19 +35,26 @@ def _constraint_penalty(trials: Sequence[FrozenTrial]) -> np.ndarray | None:
 
 
 def crowding_distance(values: np.ndarray) -> np.ndarray:
-    """Crowding distance per point (inf at objective extremes)."""
+    """Crowding distance per point (inf at objective extremes).
+
+    Fully vectorized over objectives: one (n, m) argsort, per-column gap
+    computation, and a scatter back to original order — no per-objective
+    Python loop."""
     n, m = values.shape
     if n <= 2:
         return np.full(n, np.inf)
-    dist = np.zeros(n)
-    for j in range(m):
-        order = np.argsort(values[:, j], kind="stable")
-        vmin, vmax = values[order[0], j], values[order[-1], j]
-        dist[order[0]] = dist[order[-1]] = np.inf
-        if vmax > vmin:
-            gaps = (values[order[2:], j] - values[order[:-2], j]) / (vmax - vmin)
-            dist[order[1:-1]] += gaps
-    return dist
+    order = np.argsort(values, axis=0, kind="stable")  # (n, m)
+    sorted_vals = np.take_along_axis(values, order, axis=0)
+    span = sorted_vals[-1] - sorted_vals[0]  # (m,)
+    contrib_sorted = np.zeros((n, m))
+    safe_span = np.where(span > 0, span, 1.0)
+    contrib_sorted[1:-1] = np.where(
+        span > 0, (sorted_vals[2:] - sorted_vals[:-2]) / safe_span, 0.0
+    )
+    contrib_sorted[0] = contrib_sorted[-1] = np.inf
+    contrib = np.zeros((n, m))
+    np.put_along_axis(contrib, order, contrib_sorted, axis=0)
+    return contrib.sum(axis=1)
 
 
 def select_elite_population(

@@ -8,15 +8,36 @@ production deployment story the reference never needs (its NumPy/torch
 samplers have no compile step) but a compiled framework must ship.
 
 Respecting the user: an explicitly configured cache (via the
-``JAX_COMPILATION_CACHE_DIR`` env var or ``jax.config``) is left alone,
-and ``OPTUNA_TPU_NO_COMPILE_CACHE=1`` opts out entirely.
+``JAX_COMPILATION_CACHE_DIR`` env var or ``jax.config``) is left entirely
+alone — directory AND thresholds — and ``OPTUNA_TPU_NO_COMPILE_CACHE=1``
+opts out. The default directory is scoped by a machine fingerprint
+(arch + CPU feature flags) because CPU-backend executables embed machine
+features: an entry written on one host can make another host's AOT
+loader throw, so foreign entries must never be visible in the first place.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 _done = False
+
+
+def _machine_token() -> str:
+    """Short digest of the machine features that key CPU-AOT executables."""
+    h = hashlib.sha256()
+    h.update(platform.machine().encode())
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    h.update(line.encode())
+                    break
+    except OSError:
+        pass
+    return h.hexdigest()[:12]
 
 
 def ensure_compile_cache() -> None:
@@ -32,7 +53,10 @@ def ensure_compile_cache() -> None:
 
         default_dir = os.environ.get(
             "OPTUNA_TPU_CACHE_DIR",
-            os.path.join(os.path.expanduser("~"), ".cache", "optuna_tpu", "xla"),
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "optuna_tpu",
+                "xla-" + _machine_token(),
+            ),
         )
         if "jax" not in sys.modules:
             # jax not imported yet: the env route avoids forcing the import
@@ -40,8 +64,6 @@ def ensure_compile_cache() -> None:
             if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
                 os.makedirs(default_dir, exist_ok=True)
                 os.environ["JAX_COMPILATION_CACHE_DIR"] = default_dir
-            os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-            os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
             return
         import jax
 
@@ -51,9 +73,5 @@ def ensure_compile_cache() -> None:
         ):
             os.makedirs(default_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", default_dir)
-        # Cache every program: sampler kernels are numerous and individually
-        # cheap-ish to compile, but a cold study pays for dozens of them.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # pragma: no cover - cache is an optimization only
         pass

@@ -87,6 +87,7 @@ def plot_optimization_history(
     error_bar: bool = False,
 ):
     studies = [study] if not isinstance(study, (list, tuple)) else list(study)
+    target_name = D.resolve_target_name(studies, target, target_name)
     series = D.optimization_history_data(studies, target, target_name, error_bar)
     data: list[dict] = []
     for s in series:
@@ -263,6 +264,7 @@ def plot_contour(
     n = len(matrix)
     data: list[dict] = []
     layout: dict[str, Any] = {"title": {"text": "Contour Plot"}}
+    reverse = D.is_reverse_scale(study, target)
 
     def add_cell(pair: D.ContourPair, ax_idx: int, show_scale: bool) -> None:
         suffix = "" if ax_idx == 1 else str(ax_idx)
@@ -276,6 +278,7 @@ def plot_contour(
                     for row in pair.grid_z
                 ],
                 "colorscale": "Blues",
+                "reversescale": reverse,
                 "connectgaps": True,
                 "showscale": show_scale,
                 "colorbar": {"title": {"text": target_name}} if show_scale else None,
@@ -412,28 +415,29 @@ def plot_param_importances(
     target: Callable | None = None,
     target_name: str = "Objective Value",
 ):
-    from optuna_tpu.importance import get_param_importances
-
-    importances = get_param_importances(
-        study, evaluator=evaluator, params=params, target=target
-    )
-    names = list(importances.keys())[::-1]
-    vals = [importances[n] for n in names]
-    data = [
-        {
-            "type": "bar",
-            "orientation": "h",
-            "x": vals,
-            "y": names,
-            "text": [f"{v:.2f}" for v in vals],
-            "name": target_name,
-        }
-    ]
+    infos = D.importances_data(study, evaluator, params, target, target_name)
+    data = []
+    for obj_name, importances in infos:
+        names = list(importances.keys())[::-1]
+        vals = [importances[n] for n in names]
+        data.append(
+            {
+                "type": "bar",
+                "orientation": "h",
+                "x": vals,
+                "y": names,
+                "text": [f"{v:.2f}" if v >= 0.01 else "<0.01" for v in vals],
+                "name": obj_name,
+            }
+        )
+    xlabel = infos[0][0] if len(infos) == 1 else "Objective Value"
     layout = {
         "title": {"text": "Hyperparameter Importances"},
-        "xaxis": _axis(f"Importance for {target_name}"),
+        "xaxis": _axis(f"Importance for {xlabel}"),
         "yaxis": _axis("Hyperparameter"),
     }
+    if len(infos) > 1:
+        layout["barmode"] = "group"
     return _figure(data, layout)
 
 
@@ -445,43 +449,51 @@ def plot_pareto_front(
     *,
     target_names: list[str] | None = None,
     include_dominated_trials: bool = True,
+    axis_order: list[int] | None = None,
+    constraints_func: Callable | None = None,
     targets: Callable | None = None,
 ):
-    pf = D.pareto_front_data(study, target_names, include_dominated_trials, targets)
-    scatter_type = "scatter3d" if pf.n_objectives == 3 else "scatter"
+    pf = D.pareto_front_data(
+        study, target_names, include_dominated_trials, targets, axis_order,
+        constraints_func,
+    )
+    order = pf.axis_order
+    is_3d = len(order) == 3
 
     def trace(values, numbers, name, color, size):
         t: dict[str, Any] = {
-            "type": scatter_type,
+            "type": "scatter3d" if is_3d else "scatter",
             "mode": "markers",
             "name": name,
             "marker": {"color": color, "size": size},
             "text": [f"Trial {n}" for n in numbers],
-            "x": [v[0] for v in values],
-            "y": [v[1] for v in values],
+            "x": [v[order[0]] for v in values],
+            "y": [v[order[1]] for v in values],
         }
-        if pf.n_objectives == 3:
-            t["z"] = [v[2] for v in values]
+        if is_3d:
+            t["z"] = [v[order[2]] for v in values]
         return t
 
     data = []
+    trial_label = "Trial"
     if pf.infeasible_values:
         data.append(
             trace(pf.infeasible_values, pf.infeasible_numbers, "Infeasible Trial", "#cccccc", 4)
         )
+        trial_label = "Feasible Trial"
     if pf.other_values:
-        data.append(trace(pf.other_values, pf.other_numbers, "Trial", "blue", 4))
+        data.append(trace(pf.other_values, pf.other_numbers, trial_label, "blue", 4))
     data.append(trace(pf.best_values, pf.best_numbers, "Best Trial", "red", 6))
     layout: dict[str, Any] = {"title": {"text": "Pareto-front Plot"}}
-    if pf.n_objectives == 3:
+    if is_3d:
         layout["scene"] = {
-            "xaxis": _axis(pf.target_names[0]),
-            "yaxis": _axis(pf.target_names[1]),
-            "zaxis": _axis(pf.target_names[2]),
+            "xaxis": _axis(pf.target_names[order[0]]),
+            "yaxis": _axis(pf.target_names[order[1]]),
+            "zaxis": _axis(pf.target_names[order[2]]),
         }
     else:
-        layout["xaxis"] = _axis(pf.target_names[0])
-        layout["yaxis"] = _axis(pf.target_names[1])
+        layout["xaxis"] = _axis(pf.target_names[order[0]])
+        layout["yaxis"] = _axis(pf.target_names[order[1]])
     return _figure(data, layout)
 
 

@@ -25,7 +25,10 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from optuna_tpu.distributions import CategoricalDistribution
-from optuna_tpu.study._multi_objective import _get_pareto_front_trials
+from optuna_tpu.study._multi_objective import (
+    _get_pareto_front_trials,
+    _get_pareto_front_trials_by_trials,
+)
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
 from optuna_tpu.trial._state import TrialState
@@ -87,6 +90,14 @@ class HistorySeries:
     best_values: list[float] | None  # None when target overrides the objective
     # error-bar mode only:
     stdev: list[float] | None = None
+
+
+def resolve_target_name(studies: Sequence[Any], target: Callable | None, target_name: str) -> str:
+    """:meth:`Study.set_metric_names` overrides the default label when the
+    raw objective is plotted (reference ``_optimization_history.py:107``)."""
+    if target is None and studies and getattr(studies[0], "metric_names", None):
+        return studies[0].metric_names[0]
+    return target_name
 
 
 def optimization_history_data(
@@ -478,6 +489,9 @@ class ParetoFrontData:
     other_numbers: list[int]
     infeasible_values: list[list[float]]
     infeasible_numbers: list[int]
+    # Axis permutation (reference ``_pareto_front.py`` ``axis_order``):
+    # axes[i] renders values[axis_order[i]].
+    axis_order: list[int] = field(default_factory=list)
 
 
 def pareto_front_data(
@@ -485,13 +499,42 @@ def pareto_front_data(
     target_names: list[str] | None,
     include_dominated_trials: bool,
     targets: Callable | None = None,
+    axis_order: list[int] | None = None,
+    constraints_func: Callable | None = None,
 ) -> ParetoFrontData:
     n_obj = len(study.directions)
     if targets is None and n_obj not in (2, 3):
         raise ValueError("plot_pareto_front works with 2 or 3 objectives.")
+    if targets is not None and axis_order is not None:
+        raise ValueError(
+            "Using both `targets` and `axis_order` is forbidden; "
+            "reorder the axes inside `targets` instead."
+        )
+    if targets is not None and target_names is None:
+        # The projection can change the axis count, so default per-objective
+        # names cannot label it (reference ``_pareto_front.py`` info builder).
+        raise ValueError("If `targets` is specified, `target_names` must be specified too.")
     trials = _completed(study)
-    feasible = [t for t in trials if _feasible(t)]
-    infeasible = [t for t in trials if not _feasible(t)]
+    if constraints_func is not None:
+        # Plot-time feasibility override (reference's deprecated-but-supported
+        # ``constraints_func``): evaluate constraints on each frozen trial
+        # instead of reading the sampler-recorded system attrs, and recompute
+        # the front over the feasible subset (a study-front trial the
+        # override marks infeasible must yield its place to the trials it
+        # dominated).
+        def ok(t: FrozenTrial) -> bool:
+            try:
+                return all(float(c) <= 0.0 for c in constraints_func(t))
+            except Exception:
+                return False
+
+        feasible = [t for t in trials if ok(t)]
+        infeasible = [t for t in trials if not ok(t)]
+        front_trials = _get_pareto_front_trials_by_trials(feasible, study.directions)
+    else:
+        feasible = [t for t in trials if _feasible(t)]
+        infeasible = [t for t in trials if not _feasible(t)]
+        front_trials = _get_pareto_front_trials(study, consider_constraint=True)
 
     def vals(t: FrozenTrial) -> list[float]:
         if targets is not None:
@@ -499,12 +542,25 @@ def pareto_front_data(
             return [float(v) for v in (out if isinstance(out, (list, tuple)) else [out])]
         return [float(v) for v in t.values]
 
-    front = {t.number for t in _get_pareto_front_trials(study, consider_constraint=True)}
+    front = {t.number for t in front_trials}
     best = [t for t in feasible if t.number in front]
     other = [t for t in feasible if t.number not in front] if include_dominated_trials else []
     names = target_names or (
         study.metric_names or [f"Objective {i}" for i in range(n_obj)]
     )
+    sample = (
+        [vals(t) for t in best[:1]] or [vals(t) for t in other[:1]]
+        or [vals(t) for t in infeasible[:1]]
+    )
+    n_axes = len(sample[0]) if sample else n_obj
+    if axis_order is None:
+        order = list(range(n_axes))
+    else:
+        order = [int(i) for i in axis_order]
+        if sorted(order) != list(range(n_axes)):
+            raise ValueError(
+                f"axis_order must be a permutation of 0..{n_axes - 1}, got {axis_order}."
+            )
     return ParetoFrontData(
         n_objectives=n_obj,
         target_names=list(names),
@@ -514,7 +570,57 @@ def pareto_front_data(
         other_numbers=[t.number for t in other],
         infeasible_values=[vals(t) for t in infeasible],
         infeasible_numbers=[t.number for t in infeasible],
+        axis_order=order,
     )
+
+
+# ------------------------------------------------------------ importances
+
+
+def importances_data(
+    study,
+    evaluator,
+    params: list[str] | None,
+    target: Callable | None,
+    target_name: str,
+) -> list[tuple[str, dict[str, float]]]:
+    """(target_name, importances) per objective (reference
+    ``_param_importances.py:83-110``): a multi-objective study with no
+    ``target`` yields one entry per objective, and
+    :meth:`Study.set_metric_names` overrides ``target_name``."""
+    from optuna_tpu.importance import get_param_importances
+
+    metric_names = study.metric_names
+    if target is not None or not study._is_multi_objective():
+        if target is None and metric_names:
+            target_name = metric_names[0]
+        return [
+            (
+                target_name,
+                get_param_importances(
+                    study, evaluator=evaluator, params=params, target=target
+                ),
+            )
+        ]
+    n_obj = len(study.directions)
+    names = metric_names or [f"Objective {i}" for i in range(n_obj)]
+    return [
+        (
+            names[i],
+            get_param_importances(
+                study, evaluator=evaluator, params=params,
+                target=(lambda t, i=i: t.values[i]),
+            ),
+        )
+        for i in range(n_obj)
+    ]
+
+
+def is_reverse_scale(study, target: Callable | None) -> bool:
+    """Colormap direction (reference ``_utils.py:169``): reversed when a
+    custom target is plotted or the objective is minimized, so 'better' is
+    always the darker end."""
+    return target is not None or study.direction == StudyDirection.MINIMIZE
 
 
 # ------------------------------------------------------------------- timeline

@@ -55,7 +55,9 @@ def plot_optimization_history(
     ax=None,
 ) -> "Axes":
     ax = _axes(ax)
-    series = D.optimization_history_data(_studies(study), target, target_name, error_bar)
+    studies = _studies(study)
+    target_name = D.resolve_target_name(studies, target, target_name)
+    series = D.optimization_history_data(studies, target, target_name, error_bar)
     multi = len(series) > 1
     for s in series:
         # s.stdev marks the aggregated error-bar series (single combined
@@ -147,12 +149,15 @@ def plot_contour(
 
     matrix = D.contour_data(study, params, target)
     n = len(matrix)
+    # Better values render darker regardless of direction (reference
+    # ``_utils.py:169`` reverse-scale rule).
+    cmap = "Blues_r" if D.is_reverse_scale(study, target) else "Blues"
 
     def render(ax: "Axes", pair: D.ContourPair, colorbar: bool) -> None:
         masked = np.ma.masked_invalid(pair.grid_z)
         if masked.count():
             cf = ax.contourf(
-                pair.grid_x, pair.grid_y, masked, levels=14, cmap="Blues_r", alpha=0.9
+                pair.grid_x, pair.grid_y, masked, levels=14, cmap=cmap, alpha=0.9
             )
             if colorbar:
                 plt.colorbar(cf, ax=ax, label=target_name)
@@ -255,17 +260,35 @@ def plot_param_importances(
     study: "Study", *, evaluator=None, params: list[str] | None = None,
     target: Callable | None = None, target_name: str = "Objective Value", ax=None
 ) -> "Axes":
-    from optuna_tpu.importance import get_param_importances
+    import matplotlib.pyplot as plt
 
     ax = _axes(ax)
-    importances = get_param_importances(study, evaluator=evaluator, params=params, target=target)
-    names = list(importances.keys())[::-1]
-    vals = [importances[n] for n in names]
-    ax.barh(names, vals, color="steelblue")
-    for y, v in enumerate(vals):
-        ax.text(v, y, f" {v:.2f}", va="center", fontsize=8)
-    ax.set_xlabel(f"Importance for {target_name}")
+    infos = D.importances_data(study, evaluator, params, target, target_name)
+    # Multi-objective: grouped horizontal bars, one color per objective
+    # (reference ``matplotlib/_param_importances.py:95-126``). Every
+    # objective's bars share ONE param order (objective 0's ranking) so a
+    # y position always means the same hyperparameter.
+    names = list(infos[0][1].keys())[::-1]
+    height = 0.8 / len(infos)
+    cmap = plt.get_cmap("tab20c")
+    pos = np.arange(len(names), dtype=float)
+    for obj_id, (obj_name, importances) in enumerate(infos):
+        vals = [importances[n] for n in names]
+        offset = height * obj_id
+        ax.barh(
+            pos + offset, vals, height=height, align="center", label=obj_name,
+            color=cmap(obj_id) if len(infos) > 1 else "steelblue",
+        )
+        for y, v in zip(pos + offset, vals):
+            ax.text(v, y, f" {v:.2f}" if v >= 0.01 else " <0.01", va="center", fontsize=8)
+    ax.set_yticks(list(pos + (0.8 - height) / 2 if len(infos) > 1 else pos))
+    ax.set_yticklabels(names)
+    xlabel = infos[0][0] if len(infos) == 1 else "Objective Value"
+    ax.set_xlabel(f"Importance for {xlabel}")
+    ax.set_ylabel("Hyperparameter")
     ax.set_title("Hyperparameter Importances")
+    if len(infos) > 1:
+        ax.legend(loc="best")
     return ax
 
 
@@ -274,15 +297,20 @@ def plot_param_importances(
 
 def plot_pareto_front(
     study: "Study", *, target_names: list[str] | None = None, ax=None,
-    include_dominated_trials: bool = True, targets: Callable | None = None,
+    include_dominated_trials: bool = True, axis_order: list[int] | None = None,
+    constraints_func: Callable | None = None, targets: Callable | None = None,
 ) -> "Axes":
-    pf = D.pareto_front_data(study, target_names, include_dominated_trials, targets)
+    pf = D.pareto_front_data(
+        study, target_names, include_dominated_trials, targets, axis_order,
+        constraints_func,
+    )
     # Plot dimensionality follows the actual value vectors: a `targets`
     # callable may project an N-objective study down to 2 or 3 axes.
-    all_vals = pf.best_values or pf.other_values or pf.infeasible_values
-    n_axes = len(all_vals[0]) if all_vals else pf.n_objectives
+    order = pf.axis_order
+    n_axes = len(order)
     if n_axes not in (2, 3):
         raise ValueError(f"plot_pareto_front renders 2 or 3 axes, got {n_axes}.")
+    trial_label = "Feasible Trial" if pf.infeasible_values else "Trial"
     if n_axes == 3:
         import matplotlib.pyplot as plt
 
@@ -297,26 +325,27 @@ def plot_pareto_front(
 
         def scat3(vals, **kw):
             if vals:
-                ax.scatter(*np.asarray(vals).T, **kw)
+                arr = np.asarray(vals)[:, order]
+                ax.scatter(*arr.T, **kw)
 
         scat3(pf.infeasible_values, s=8, alpha=0.4, label="Infeasible Trial", color="#cccccc")
-        scat3(pf.other_values, s=12, alpha=0.4, label="Trial", color="steelblue")
+        scat3(pf.other_values, s=12, alpha=0.4, label=trial_label, color="steelblue")
         scat3(pf.best_values, s=22, label="Best Trial", color="crimson")
         if len(pf.target_names) > 2:
-            ax.set_zlabel(pf.target_names[2])
+            ax.set_zlabel(pf.target_names[order[2]])
     else:
         ax = _axes(ax)
 
         def scat(vals, **kw):
             if vals:
                 arr = np.asarray(vals)
-                ax.scatter(arr[:, 0], arr[:, 1], **kw)
+                ax.scatter(arr[:, order[0]], arr[:, order[1]], **kw)
 
         scat(pf.infeasible_values, s=8, alpha=0.4, label="Infeasible Trial", color="#cccccc")
-        scat(pf.other_values, s=12, alpha=0.4, label="Trial", color="steelblue")
+        scat(pf.other_values, s=12, alpha=0.4, label=trial_label, color="steelblue")
         scat(pf.best_values, s=22, label="Best Trial", color="crimson")
-    ax.set_xlabel(pf.target_names[0])
-    ax.set_ylabel(pf.target_names[1])
+    ax.set_xlabel(pf.target_names[order[0]])
+    ax.set_ylabel(pf.target_names[order[1]])
     ax.set_title("Pareto-front Plot")
     ax.legend()
     return ax

@@ -1,0 +1,127 @@
+"""Measure the host↔device crossover for the multi-objective routing layer.
+
+VERDICT r4 #6: the thresholds in ``study/_multi_objective.py`` (non-domination
+rank ≥512) and ``hypervolume/__init__.py`` (per-M front minima) must be backed
+by a committed measurement, not judgment. This script times both paths on the
+live backend across realistic population sizes and writes
+``bench_results/mo_crossover.json``; the routing constants cite it.
+
+Run on the TPU: ``python scripts/measure_mo_crossover.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _time(fn, reps: int = 5) -> float:
+    fn()  # warm (compile / cache)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def _host_rank(values: np.ndarray) -> np.ndarray:
+    """The host peeling loop from study/_multi_objective.py, full ranking."""
+    n = len(values)
+    ranks = np.full(n, -1, dtype=np.int64)
+    remaining = np.arange(n)
+    rank = 0
+    while len(remaining) > 0:
+        vals = values[remaining]
+        leq = np.all(vals[:, None, :] <= vals[None, :, :], axis=2)
+        lt = np.any(vals[:, None, :] < vals[None, :, :], axis=2)
+        dominated = np.any(leq & lt, axis=0)
+        ranks[remaining[~dominated]] = rank
+        remaining = remaining[dominated]
+        rank += 1
+    return ranks
+
+
+def main() -> None:
+    import jax
+
+    from optuna_tpu.hypervolume.wfg import compute_hypervolume as hv_host
+    from optuna_tpu.ops.pareto import non_domination_rank_np
+
+    backend = jax.default_backend()
+    rng = np.random.RandomState(0)
+    out: dict = {"backend": backend, "results": {}}
+
+    print(f"backend={backend}", file=sys.stderr)
+
+    # ---- non-domination rank: host peel vs device Pallas/XLA kernel
+    rank_rows = []
+    for m in (2, 3, 5):
+        for n in (50, 128, 256, 512, 1024, 2048, 4096):
+            vals = rng.rand(n, m)
+            t_host = _time(lambda: _host_rank(vals))
+            t_dev = _time(lambda: non_domination_rank_np(vals))
+            rank_rows.append(
+                {"n": n, "m": m, "host_ms": round(t_host * 1e3, 3),
+                 "device_ms": round(t_dev * 1e3, 3),
+                 "device_wins": bool(t_dev < t_host)}
+            )
+            print(f"rank n={n} m={m}: host {t_host*1e3:.2f}ms dev {t_dev*1e3:.2f}ms",
+                  file=sys.stderr)
+    out["results"]["non_domination_rank"] = rank_rows
+
+    # ---- hypervolume: host recursion vs device kernels (route internals)
+    hv_rows = []
+    from optuna_tpu.ops.hypervolume import hypervolume_nd
+    from optuna_tpu.ops.wfg import hypervolume_wfg_nd
+
+    for m, sizes in ((3, (64, 256, 1024, 2048)), (4, (64, 128, 256)),
+                     (5, (32, 48, 96)), (6, (48, 80))):
+        for n in sizes:
+            pts = rng.rand(n * 4, m)
+            # keep only the pareto subset so both sides see a real front
+            from optuna_tpu.hypervolume.wfg import _pareto_filter
+
+            front = _pareto_filter(pts)[: n]
+            if len(front) < 8:
+                continue
+            ref = np.full(m, 1.1)
+            t_host = _time(lambda: hv_host(front, ref, assume_pareto=True), reps=3)
+            if m >= 5:
+                t_dev = _time(lambda: hypervolume_wfg_nd(front, ref), reps=3)
+            else:
+                t_dev = _time(lambda: hypervolume_nd(front, ref), reps=3)
+            hv_rows.append(
+                {"front": len(front), "m": m, "host_ms": round(t_host * 1e3, 3),
+                 "device_ms": round(t_dev * 1e3, 3),
+                 "device_wins": bool(t_dev < t_host)}
+            )
+            print(f"hv m={m} front={len(front)}: host {t_host*1e3:.2f}ms "
+                  f"dev {t_dev*1e3:.2f}ms", file=sys.stderr)
+    out["results"]["hypervolume"] = hv_rows
+
+    # crossover summary per family: smallest n where the device won
+    def _cross(rows, key):
+        wins = {}
+        for r in rows:
+            if r["device_wins"]:
+                wins.setdefault(r["m"], []).append(r[key])
+        return {m: min(v) for m, v in sorted(wins.items())}
+
+    out["crossover"] = {
+        "non_domination_rank_min_n_device_wins": _cross(rank_rows, "n"),
+        "hypervolume_min_front_device_wins": _cross(hv_rows, "front"),
+    }
+    path = os.path.join(os.path.dirname(__file__), "..", "bench_results",
+                        "mo_crossover.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["crossover"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Run every BASELINE bench config on the live backend and capture results +
+# stderr into bench_results/ (VERDICT r4 #2: zero vs_baseline:null).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_results
+for cfg in "$@"; do
+  echo "=== $cfg ($(date +%H:%M:%S)) ===" >&2
+  timeout 5400 python bench.py --config "$cfg" \
+    > "bench_results/$cfg.json" 2> "bench_results/_stderr_$cfg.log"
+  rc=$?
+  echo "--- $cfg exit=$rc: $(cat bench_results/$cfg.json 2>/dev/null)" >&2
+done

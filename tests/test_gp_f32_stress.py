@@ -1,0 +1,243 @@
+"""f32 ill-conditioning stress suite for the GP core (SURVEY §7 risk item).
+
+The reference fits its GP in torch float64 (``optuna/_gp/gp.py:269-303``);
+optuna_tpu runs f32 on device. This suite pins the masked-Cholesky path
+against an unpadded float64 NumPy oracle of the SAME model (Matern-5/2 ARD +
+noise + jitter) under the conditions where f32 actually breaks:
+
+* n≈1000 with near-duplicate rows (Gram matrix nearly rank-deficient),
+* lengthscale extremes (K → I and K → rank-one all-ones),
+* 1e6 target-scale ratios (standardization is the compensation),
+
+and encodes the tolerance contract documented in ``optuna_tpu/gp/gp.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu.gp.gp import (
+    _JITTER,
+    GPParams,
+    GPState,
+    _bucket,
+    _kernel_with_noise,
+    fit_gp,
+    marginal_log_likelihood,
+    posterior,
+)
+
+
+# ------------------------------------------------------------- float64 oracle
+
+
+def _oracle_kernel(X1, X2, inv_sq_ls, scale, cat_mask):
+    diff = X1[:, None, :] - X2[None, :, :]
+    sq = np.where(cat_mask[None, None, :], (diff != 0.0).astype(np.float64), diff * diff)
+    d2 = np.sum(sq * inv_sq_ls, axis=-1)
+    d = np.sqrt(np.maximum(d2, 0.0))
+    sqrt5d = np.sqrt(5.0) * d
+    return scale * (1.0 + sqrt5d + (5.0 / 3.0) * d2) * np.exp(-sqrt5d)
+
+
+def _oracle(X, y, inv_sq_ls, scale, noise, cat_mask, Xq):
+    """Unpadded float64 MLL + posterior, same model as the device path."""
+    n = len(X)
+    K = _oracle_kernel(X, X, inv_sq_ls, scale, cat_mask)
+    K[np.diag_indices(n)] += noise + _JITTER
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+    mll = -0.5 * (
+        y @ alpha + 2.0 * np.sum(np.log(np.diag(L))) + n * np.log(2.0 * np.pi)
+    )
+    k_star = _oracle_kernel(Xq, X, inv_sq_ls, scale, cat_mask)
+    mean = k_star @ alpha
+    v = np.linalg.solve(L, k_star.T)
+    var = np.maximum(scale - np.sum(v * v, axis=0), 1e-10)
+    return mll, mean, var
+
+
+def _device_state(X, y, inv_sq_ls, scale, noise):
+    """Pad to the bucket and build the f32 GPState at FIXED params (the
+    contract under test is the linear algebra, not the stochastic fit)."""
+    n, d = X.shape
+    N = _bucket(n)
+    Xp = np.zeros((N, d), np.float32)
+    Xp[:n] = X
+    yp = np.zeros(N, np.float32)
+    yp[:n] = y
+    maskp = np.zeros(N, np.float32)
+    maskp[:n] = 1.0
+    params = GPParams(
+        inv_sq_lengthscales=jnp.asarray(inv_sq_ls, jnp.float32),
+        scale=jnp.asarray(scale, jnp.float32),
+        noise=jnp.asarray(noise, jnp.float32),
+    )
+    cat = jnp.zeros((d,), bool)
+    Kn = _kernel_with_noise(jnp.asarray(Xp), params, cat, jnp.asarray(maskp))
+    L = jnp.linalg.cholesky(Kn)
+    alpha = jax.scipy.linalg.cho_solve((L, True), jnp.asarray(yp))
+    state = GPState(
+        params=params, X=jnp.asarray(Xp), y=jnp.asarray(yp),
+        mask=jnp.asarray(maskp), L=L, alpha=alpha,
+    )
+    mll = marginal_log_likelihood(
+        params, jnp.asarray(Xp), jnp.asarray(yp), cat, jnp.asarray(maskp)
+    )
+    return state, cat, float(mll)
+
+
+def _compare(X, y, inv_sq_ls, scale, noise, Xq, mll_rtol, mean_atol, var_rtol):
+    d = X.shape[1]
+    cat_np = np.zeros((d,), bool)
+    mll64, mean64, var64 = _oracle(
+        X.astype(np.float64), y.astype(np.float64),
+        np.asarray(inv_sq_ls, np.float64), float(scale), float(noise), cat_np,
+        Xq.astype(np.float64),
+    )
+    state, cat, mll32 = _device_state(X, y, inv_sq_ls, scale, noise)
+    mean32, var32 = posterior(state, jnp.asarray(Xq, jnp.float32), cat)
+    mean32, var32 = np.asarray(mean32, np.float64), np.asarray(var32, np.float64)
+
+    y_scale = max(float(np.std(y)), 1e-12)
+    assert np.isfinite(mll32)
+    assert abs(mll32 - mll64) <= mll_rtol * max(abs(mll64), 1.0), (
+        f"MLL drift {mll32} vs f64 {mll64}"
+    )
+    np.testing.assert_allclose(mean32 / y_scale, mean64 / y_scale, atol=mean_atol)
+    np.testing.assert_allclose(var32, var64, rtol=var_rtol, atol=var_rtol * scale)
+
+
+def _problem(n, d, seed, dup_frac=0.0, dup_eps=1e-6, y_scale=1.0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, d).astype(np.float32)
+    if dup_frac:
+        k = int(n * dup_frac)
+        X[n - k:] = X[:k] + dup_eps * rng.randn(k, d).astype(np.float32)
+        X = np.clip(X, 0.0, 1.0)
+    f = np.sin(3.0 * X).sum(axis=1) + 0.1 * (X ** 2).sum(axis=1)
+    y = (y_scale * (f - f.mean()) / (f.std() + 1e-12)).astype(np.float32)
+    Xq = rng.rand(64, d).astype(np.float32)
+    return X, y, Xq
+
+
+# ---------------------------------------------------------------- stress cases
+
+
+def test_near_duplicate_rows_n1000() -> None:
+    """Half the rows are 1e-6-perturbed duplicates: the Gram matrix is within
+    f32 eps of rank n/2. The noise floor + jitter must keep the masked
+    Cholesky stable at north-star scale."""
+    X, y, Xq = _problem(n=1000, d=8, seed=0, dup_frac=0.5)
+    _compare(
+        X, y, inv_sq_ls=np.full(8, 4.0), scale=1.0, noise=1e-4, Xq=Xq,
+        mll_rtol=5e-3, mean_atol=5e-3, var_rtol=0.1,
+    )
+
+
+def test_near_duplicate_rows_small_noise() -> None:
+    """Same near-rank-deficiency at the sampler's deterministic noise floor
+    (1e-7 + 1e-6 jitter): the hardest conditioning the production path can
+    request."""
+    X, y, Xq = _problem(n=512, d=8, seed=1, dup_frac=0.5)
+    _compare(
+        X, y, inv_sq_ls=np.full(8, 1.0), scale=1.0, noise=1e-5, Xq=Xq,
+        mll_rtol=2e-2, mean_atol=2e-2, var_rtol=0.25,
+    )
+
+
+def test_tiny_lengthscales() -> None:
+    """lengthscale 0.01 (inv_sq_ls=1e4): K ≈ (scale+noise)·I, perfectly
+    conditioned — f32 should be near machine-exact."""
+    X, y, Xq = _problem(n=256, d=6, seed=2)
+    _compare(
+        X, y, inv_sq_ls=np.full(6, 1e4), scale=1.0, noise=1e-4, Xq=Xq,
+        mll_rtol=1e-3, mean_atol=1e-3, var_rtol=2e-2,
+    )
+
+
+def test_huge_lengthscales_rank_one() -> None:
+    """lengthscale 100 (inv_sq_ls=1e-4): K → scale·11ᵀ, condition number
+    ~ n·scale/noise ≈ 2.6e6. The classic f32 breaking point; jitter +
+    noise floor must keep the factorization finite and the posterior sane.
+    Measured worst case: posterior mean drifts up to ~7e-2 of the target std
+    (f32 cancellation against the near-constant kernel) — the widest
+    tolerance in the contract, documented in ``gp/gp.py``."""
+    X, y, Xq = _problem(n=256, d=6, seed=3)
+    _compare(
+        X, y, inv_sq_ls=np.full(6, 1e-4), scale=1.0, noise=1e-4, Xq=Xq,
+        mll_rtol=2e-2, mean_atol=0.1, var_rtol=0.5,
+    )
+
+
+def test_mixed_lengthscale_extremes() -> None:
+    """ARD with 6 orders of magnitude spread across dims in one kernel."""
+    X, y, Xq = _problem(n=256, d=6, seed=4)
+    inv_sq_ls = np.array([1e-3, 1e-2, 1.0, 1.0, 1e2, 1e3])
+    _compare(
+        X, y, inv_sq_ls=inv_sq_ls, scale=1.0, noise=1e-4, Xq=Xq,
+        mll_rtol=1e-2, mean_atol=1e-2, var_rtol=0.2,
+    )
+
+
+def test_large_scale_ratio_raw() -> None:
+    """scale=1e4 with noise 1e-2 (1e6 variance ratio), y amplitudes ~1e2 —
+    what the device path would see WITHOUT standardization."""
+    X, y, Xq = _problem(n=256, d=6, seed=5, y_scale=1e2)
+    _compare(
+        X, y, inv_sq_ls=np.full(6, 4.0), scale=1e4, noise=1e-2, Xq=Xq,
+        mll_rtol=2e-2, mean_atol=2e-2, var_rtol=0.2,
+    )
+
+
+def test_standardization_compensates_scale() -> None:
+    """The production compensation for extreme target scales: the sampler
+    standardizes y before fitting (``samplers/_gp/sampler.py``), so a 1e6
+    amplitude change must produce the SAME standardized posterior."""
+    X, y, Xq = _problem(n=256, d=6, seed=6)
+    state1, cat, _ = _device_state(X, y, np.full(6, 4.0), 1.0, 1e-4)
+    m1, v1 = posterior(state1, jnp.asarray(Xq), cat)
+    y_big = (y.astype(np.float64) * 1e6).astype(np.float32)
+    y_std = ((y_big - y_big.mean()) / y_big.std()).astype(np.float32)
+    state2, cat, _ = _device_state(X, y_std, np.full(6, 4.0), 1.0, 1e-4)
+    m2, v2 = posterior(state2, jnp.asarray(Xq), cat)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=2e-4)
+
+
+@pytest.mark.parametrize("dup_frac", [0.0, 0.5])
+def test_fit_stays_finite_under_stress(dup_frac: float) -> None:
+    """End-to-end MAP fit (multi-start device L-BFGS) on stressed data must
+    return finite params within the raw bounds and a usable posterior."""
+    X, y, Xq = _problem(n=300, d=5, seed=7, dup_frac=dup_frac)
+    state, raw = fit_gp(X, y, np.zeros(5, bool))
+    assert np.all(np.isfinite(raw)) and np.all(np.abs(raw) <= 15.0)
+    mean, var = posterior(state, jnp.asarray(Xq), jnp.zeros((5,), bool))
+    assert np.all(np.isfinite(np.asarray(mean)))
+    assert np.all(np.asarray(var) > 0.0)
+    # The fit must actually explain the (noiseless, smooth) data: posterior
+    # mean at the training points tracks y.
+    mean_tr, _ = posterior(state, state.X[: len(X)], jnp.zeros((5,), bool))
+    resid = np.asarray(mean_tr) - y
+    assert float(np.sqrt(np.mean(resid ** 2))) < 0.3
+
+
+def test_mll_grid_parity() -> None:
+    """MLL parity across a param grid — the surface the L-BFGS fit actually
+    walks. Guards against f32 drift that would silently move the MAP point."""
+    X, y, Xq = _problem(n=200, d=4, seed=8)
+    cat_np = np.zeros((4,), bool)
+    for ls in (0.1, 1.0, 10.0):
+        for noise in (1e-5, 1e-3, 1e-1):
+            mll64, _, _ = _oracle(
+                X.astype(np.float64), y.astype(np.float64),
+                np.full(4, ls), 1.0, noise, cat_np, Xq.astype(np.float64),
+            )
+            _, _, mll32 = _device_state(X, y, np.full(4, ls), 1.0, noise)
+            assert abs(mll32 - mll64) <= 1e-2 * max(abs(mll64), 1.0), (
+                f"ls={ls} noise={noise}: {mll32} vs {mll64}"
+            )

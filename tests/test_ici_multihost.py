@@ -201,9 +201,8 @@ def test_two_studies_one_pod_bus_stay_consistent():
 
 
 @pytest.mark.skipif(
-    os.environ.get("OPTUNA_TPU_TEST_MULTIHOST") != "1",
-    reason="real multi-process allgather smoke is opt-in (OPTUNA_TPU_TEST_MULTIHOST=1), "
-    "mirroring the reference's TEST_DB_URL-gated server tests",
+    os.environ.get("OPTUNA_TPU_SKIP_MULTIHOST") == "1",
+    reason="real multi-process allgather smoke disabled by OPTUNA_TPU_SKIP_MULTIHOST=1",
 )
 def test_real_two_process_allgather_exchange(tmp_path):
     """Two real ``jax.distributed`` CPU processes push distinct ops through the

@@ -164,3 +164,20 @@ def test_edf_multiple_studies_share_grid(study):
     assert len(ax.lines) == 2
     x0, x1 = ax.lines[0].get_xdata(), ax.lines[1].get_xdata()
     np.testing.assert_allclose(x0, x1)
+
+
+def test_pareto_front_axis_order_swaps_axes(mo_study):
+    ax = mvis.plot_pareto_front(mo_study, axis_order=[1, 0])
+    assert ax.get_xlabel() == "Objective 1" and ax.get_ylabel() == "Objective 0"
+
+
+def test_param_importances_multi_objective_grouped(mo_study):
+    ax = mvis.plot_param_importances(mo_study)
+    # Two objectives -> two bar groups sharing each y position.
+    labels = [t.get_text() for t in ax.get_legend().get_texts()]
+    assert labels == ["Objective 0", "Objective 1"]
+
+
+def test_contour_direction_aware_colormap(study):
+    axes = mvis.plot_contour(study, params=list(study.best_trial.params)[:2])
+    assert axes is not None  # renders without error under the reverse scale

@@ -1,0 +1,129 @@
+"""Unit tests for the device histogram forest (``ops/forest.py``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from optuna_tpu.ops.forest import DeviceTree, fit_forest, forest_feature_importances
+
+
+def _predict_tree(tree: DeviceTree, x: np.ndarray) -> float:
+    t = tree.tree_
+    node = 0
+    depth = 0
+    while t.children_left[node] != -1:
+        node = (
+            t.children_left[node]
+            if x[t.feature[node]] < t.threshold[node]
+            else t.children_right[node]
+        )
+        depth += 1
+        assert depth < 64
+    return float(t.value[node])
+
+
+def _predict(trees, X):
+    return np.array([np.mean([_predict_tree(t, x) for t in trees]) for x in X])
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.RandomState(0)
+    X = rng.rand(300, 6)
+    y = 3 * X[:, 0] ** 2 + 0.5 * X[:, 1] + 0.05 * rng.randn(300)
+    return X, y
+
+
+def test_structure_invariants(problem):
+    X, y = problem
+    trees = fit_forest(X, y, n_trees=8, seed=1)
+    assert len(trees) == 8
+    for tree in trees:
+        t = tree.tree_
+        internal = t.children_left >= 0
+        assert internal.any()  # non-degenerate data must split
+        # children point inside the heap and leaves have sklearn sentinels
+        assert (t.children_left[internal] < len(t.children_left)).all()
+        assert (t.feature[internal] >= 0).all()
+        assert (t.feature[~internal] == -2).all()
+        assert np.isfinite(t.threshold[internal]).all()
+        # root count equals the bootstrap mass (= n draws)
+        assert t.n_node_samples[0] == pytest.approx(len(X))
+
+
+def test_fit_quality_matches_sklearn(problem):
+    """The forest must approximate the target about as well as sklearn's —
+    the tolerance contract for replacing it."""
+    X, y = problem
+    ours = _predict(fit_forest(X, y, n_trees=32, seed=0), X)
+    from sklearn.ensemble import RandomForestRegressor
+
+    ref = RandomForestRegressor(n_estimators=32, random_state=0).fit(X, y).predict(X)
+    var = np.var(y)
+    r2_ours = 1 - np.mean((ours - y) ** 2) / var
+    r2_ref = 1 - np.mean((ref - y) ** 2) / var
+    assert r2_ours > 0.9
+    assert r2_ours > r2_ref - 0.05
+
+
+def test_mdi_importances_match_sklearn(problem):
+    X, y = problem
+    imp = forest_feature_importances(fit_forest(X, y, n_trees=32, seed=0), X.shape[1])
+    from sklearn.ensemble import RandomForestRegressor
+
+    ref = RandomForestRegressor(n_estimators=32, random_state=0).fit(X, y)
+    assert imp.sum() == pytest.approx(1.0, abs=1e-6)
+    np.testing.assert_allclose(imp, ref.feature_importances_, atol=0.05)
+    assert imp[0] > imp[1] > max(imp[2:])
+
+
+def test_constant_target_single_leaf():
+    rng = np.random.RandomState(2)
+    X = rng.rand(50, 3)
+    y = np.full(50, 1.25)
+    trees = fit_forest(X, y, n_trees=4, seed=0)
+    for tree in trees:
+        t = tree.tree_
+        assert t.children_left[0] == -1  # root is a leaf
+        assert t.value[0] == pytest.approx(1.25)
+
+
+def test_bootstrap_varies_across_trees(problem):
+    X, y = problem
+    trees = fit_forest(X, y, n_trees=4, seed=3)
+    roots = {(int(t.tree_.feature[0]), round(float(t.tree_.threshold[0]), 6)) for t in trees}
+    values = {float(t.tree_.value[0]) for t in trees}
+    assert len(values) > 1  # bootstrap produced different root means
+
+
+def test_importance_evaluators_run_without_sklearn(problem, monkeypatch):
+    """fANOVA/MDI must not import sklearn anymore (it is optional)."""
+    import builtins
+    import sys
+
+    real_import = builtins.__import__
+
+    def deny_sklearn(name, *a, **k):
+        if name.startswith("sklearn"):
+            raise ImportError("sklearn blocked for this test")
+        return real_import(name, *a, **k)
+
+    for mod in [m for m in sys.modules if m.startswith("sklearn")]:
+        monkeypatch.delitem(sys.modules, mod)
+    monkeypatch.setattr(builtins, "__import__", deny_sklearn)
+
+    import optuna_tpu
+    from optuna_tpu.samplers import RandomSampler
+
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.optimize(
+        lambda t: t.suggest_float("a", -1, 1) ** 2 + 0.1 * t.suggest_float("b", -1, 1),
+        n_trials=40,
+    )
+    for ev in (
+        optuna_tpu.importance.FanovaImportanceEvaluator(seed=0),
+        optuna_tpu.importance.MeanDecreaseImpurityImportanceEvaluator(seed=0),
+    ):
+        imp = optuna_tpu.importance.get_param_importances(study, evaluator=ev)
+        assert imp["a"] > imp["b"]

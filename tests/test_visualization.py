@@ -295,3 +295,103 @@ def test_figures_jsonable(study, mo_study):
     ]
     for fig in figs:
         json.dumps(_fig_dict(fig))
+
+
+# ------------------------------------------- r5 option-depth additions
+
+
+def test_pareto_front_axis_order(mo_study):
+    fig = _fig_dict(vis.plot_pareto_front(mo_study, axis_order=[1, 0]))
+    default = _fig_dict(vis.plot_pareto_front(mo_study))
+    best = next(t for t in fig["data"] if t["name"] == "Best Trial")
+    best_default = next(t for t in default["data"] if t["name"] == "Best Trial")
+    assert best["x"] == best_default["y"] and best["y"] == best_default["x"]
+    assert fig["layout"]["xaxis"]["title"]["text"] == "Objective 1"
+    assert fig["layout"]["yaxis"]["title"]["text"] == "Objective 0"
+
+
+def test_pareto_front_axis_order_validation(mo_study):
+    with pytest.raises(ValueError, match="permutation"):
+        vis.plot_pareto_front(mo_study, axis_order=[0, 0])
+    with pytest.raises(ValueError, match="forbidden"):
+        vis.plot_pareto_front(
+            mo_study, axis_order=[1, 0], targets=lambda t: t.values
+        )
+    # targets can change the axis count, so names must come with it
+    # (reference behavior).
+    with pytest.raises(ValueError, match="target_names"):
+        vis.plot_pareto_front(mo_study, targets=lambda t: t.values)
+    fig = _fig_dict(
+        vis.plot_pareto_front(
+            mo_study,
+            targets=lambda t: (t.values[0], t.values[1], t.values[0] + t.values[1]),
+            target_names=["f0", "f1", "f0+f1"],
+        )
+    )
+    assert fig["layout"]["scene"]["zaxis"]["title"]["text"] == "f0+f1"
+
+
+def test_pareto_front_plot_time_constraints_func(mo_study):
+    fig = _fig_dict(
+        vis.plot_pareto_front(
+            mo_study, constraints_func=lambda t: (t.params["a"] - 0.5,)
+        )
+    )
+    by_name = {t["name"]: t for t in fig["data"]}
+    assert "Infeasible Trial" in by_name
+    assert all(x > 0.5 for x in by_name["Infeasible Trial"]["x"])
+    # With infeasibles present, feasible non-best points relabel.
+    assert "Feasible Trial" in by_name or list(by_name) == ["Infeasible Trial", "Best Trial"]
+    # The front is RECOMPUTED over the feasible subset: best trials are the
+    # non-dominated feasible points, not the unconstrained study front.
+    from optuna_tpu.study._multi_objective import _is_pareto_front
+
+    feas = [t for t in mo_study.trials if t.params["a"] <= 0.5]
+    vals = np.asarray([t.values for t in feas])
+    expect = {
+        (round(v[0], 9), round(v[1], 9))
+        for v, m in zip(vals, _is_pareto_front(vals)) if m
+    }
+    got = {
+        (round(x, 9), round(y, 9))
+        for x, y in zip(by_name["Best Trial"]["x"], by_name["Best Trial"]["y"])
+    }
+    assert got == expect
+    assert all(x <= 0.5 for x in by_name["Best Trial"]["x"])
+
+
+def test_param_importances_multi_objective(mo_study):
+    fig = _fig_dict(vis.plot_param_importances(mo_study))
+    assert len(fig["data"]) == 2  # one bar group per objective
+    assert fig["layout"].get("barmode") == "group"
+    assert [t["name"] for t in fig["data"]] == ["Objective 0", "Objective 1"]
+    for bar in fig["data"]:
+        assert abs(sum(bar["x"]) - 1.0) < 1e-6
+
+
+def test_metric_names_override_labels():
+    s = optuna_tpu.create_study(sampler=RandomSampler(seed=3))
+    s.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=6)
+    s.set_metric_names(["latency-ms"])
+    fig = _fig_dict(vis.plot_optimization_history(s))
+    assert fig["layout"]["yaxis"]["title"]["text"] == "latency-ms"
+    fig = _fig_dict(vis.plot_param_importances(s))
+    assert "latency-ms" in fig["layout"]["xaxis"]["title"]["text"]
+
+
+def test_contour_reverse_scale_follows_direction(study):
+    fig = _fig_dict(vis.plot_contour(study, params=["x", "lr"]))
+    contour = next(t for t in fig["data"] if t["type"] == "contour")
+    assert contour["reversescale"] is True  # minimize -> reversed
+
+    smax = optuna_tpu.create_study(direction="maximize", sampler=RandomSampler(seed=4))
+    smax.optimize(
+        lambda t: t.suggest_float("x", 0, 1) + t.suggest_float("y", 0, 1), n_trials=8
+    )
+    fig = _fig_dict(vis.plot_contour(smax))
+    contour = next(t for t in fig["data"] if t["type"] == "contour")
+    assert contour["reversescale"] is False
+    # A custom target always reverses (reference _utils.py:169).
+    fig = _fig_dict(vis.plot_contour(smax, target=lambda t: t.params["x"]))
+    contour = next(t for t in fig["data"] if t["type"] == "contour")
+    assert contour["reversescale"] is True
