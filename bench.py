@@ -84,11 +84,13 @@ def _prewarm_gp(d: int, n_max: int, chain: int, n_startup: int) -> None:
 
 
 # Reference GPSampler wall time for the full n=1000 Hartmann-20D study,
-# measured in THIS process image on THIS host (2026-07-29, torch/scipy on
-# CPU — the reference has no TPU path; see bench_results/gp_n1000_parity.json
-# for the paired capture, best value -3.322365). Re-measure live with
-# OPTUNA_TPU_BENCH_FULL_BASELINE=1 (costs ~56 min).
-_PINNED_GP_BASELINE = {"n": 1000, "wall_s": 3338.5, "best": -3.322364882027747}
+# measured in THIS process image on THIS host with the box otherwise idle
+# (2026-07-30 round-5 recapture, torch/scipy on CPU — the reference has no
+# TPU path; bench_results/gp_live_r5.json is the paired capture). NOTE: the
+# r1-era pin was 3338.5 s; the fresh idle-box measurement halved it, so all
+# pre-r5 ratios overstate by ~2x. Re-measure live with
+# OPTUNA_TPU_BENCH_FULL_BASELINE=1 (costs ~28 min).
+_PINNED_GP_BASELINE = {"n": 1000, "wall_s": 1691.4, "best": -3.322364882027747}
 
 
 def run_ours_gp(
@@ -224,18 +226,10 @@ def run_ours_mlp_vectorized(
         p, losses = jax.lax.scan(step, p, None, length=_MLP_SGD_STEPS)
         return cross_entropy(mlp_forward(p, x), yl)
 
-    device_seconds = [0.0]
     raw_fn = jax.jit(lambda params: jax.vmap(train_one)(params["lr"], params["init_scale"]))
 
-    def timed_fn(params):
-        t0 = time.perf_counter()
-        out = raw_fn(params)
-        jax.block_until_ready(out)
-        device_seconds[0] += time.perf_counter() - t0
-        return out
-
     obj = VectorizedObjective(
-        fn=timed_fn,
+        fn=raw_fn,
         search_space={
             "lr": FloatDistribution(1e-3, 1.0, log=True),
             "init_scale": FloatDistribution(0.3, 3.0),
@@ -245,18 +239,37 @@ def run_ours_mlp_vectorized(
         sampler=TPESampler(seed=0, multivariate=True, constant_liar=True, n_startup_trials=10)
     )
     optimize_vectorized(study, obj, n_trials=n_warmup, batch_size=batch_size)
-    device_seconds[0] = 0.0
     t0 = time.time()
     optimize_vectorized(study, obj, n_trials=n_timed, batch_size=batch_size)
     dt = time.time() - t0
+    # Device span per batch, measured directly on the warm program (timing a
+    # closure inside optimize_vectorized is impossible — it re-jits the
+    # objective, so Python timing code would only run at trace time).
+    probe = {
+        "lr": jnp.full((batch_size,), 0.1, jnp.float32),
+        "init_scale": jnp.ones((batch_size,), jnp.float32),
+    }
+    jax.block_until_ready(raw_fn(probe))  # warm the probe shape
+    t1 = time.perf_counter()
+    jax.block_until_ready(raw_fn(probe))
+    t_batch = time.perf_counter() - t1
+    device_seconds = t_batch * (n_timed / batch_size)
     # FLOPs: fwd 2*(in*hid + hid*out) MACs/example; value_and_grad ~3x fwd;
     # per trial: steps * 3 * 2 * batch * (in*hid + hid*out) + final fwd.
     macs = n_batch * (n_in * n_hidden + n_hidden * n_out)
     flops_per_trial = 2 * macs * (3 * _MLP_SGD_STEPS + 1)
-    util = {
-        "device_duty_cycle": round(device_seconds[0] / dt, 3),
-        "achieved_gflops_per_sec": round(n_timed * flops_per_trial / max(device_seconds[0], 1e-9) / 1e9, 1),
-    }
+    if device_seconds <= 1e-6:
+        # A zero/degenerate probe means the measurement is broken — emit
+        # nulls instead of a clamped absurdity (an r5 review catch: the old
+        # max(x, 1e-9) clamp published 8e11 "GFLOP/s").
+        util = {"device_duty_cycle": None, "achieved_gflops_per_sec": None}
+    else:
+        util = {
+            "device_duty_cycle": round(device_seconds / dt, 3),
+            "achieved_gflops_per_sec": round(
+                n_timed * flops_per_trial / device_seconds / 1e9, 1
+            ),
+        }
     return n_timed / dt, study.best_value, util
 
 

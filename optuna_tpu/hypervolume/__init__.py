@@ -19,16 +19,17 @@ from optuna_tpu.hypervolume.hssp import solve_hssp as _solve_hssp_host
 from optuna_tpu.hypervolume.wfg import _pareto_filter
 from optuna_tpu.hypervolume.wfg import compute_hypervolume as _compute_hypervolume_host
 
-# Device routing thresholds, set so the device path wins even across a
-# tunneled (~100 ms/dispatch) TPU: the host recursion is O(front^2)-ish at
-# M=3 but blows up combinatorially at M=4 (measured: 2.4 s for a 256-point
-# 4D front vs 73 ms on device). M >= 5 routes to the WFG stack machine in
-# :mod:`optuna_tpu.ops.wfg` (the slicing pipeline's deterministic
-# O(N^{M-1}) exponent blows up there); measured on TPU: 5D front of 52
-# points — host 429 ms vs device 223 ms; 6D front of 78 — host 2.17 s vs
-# device 1.05 s. Below ~48 front points, tunnel dispatch dominates.
-_DEVICE_MIN_FRONT = {3: 1024, 4: 128}
-_DEVICE_MIN_FRONT_WFG = 48  # applies to every M >= 5
+# Device routing thresholds, measured on the live TPU by
+# ``scripts/measure_mo_crossover.py`` (committed capture:
+# ``bench_results/mo_crossover.json``, r5). The host recursion is
+# O(front^2)-ish at M=3 (still microseconds at front 61, so the device
+# engages only at large fronts there) but blows up combinatorially with M:
+# the measured host-vs-device crossover is front≈61 at M=4 (host 173 ms vs
+# 70 ms), 32 at M=5, and <=48 at M=6 (host 747 ms vs 367 ms). M >= 5
+# routes to the WFG stack machine in :mod:`optuna_tpu.ops.wfg`. Below the
+# thresholds the ~70 ms tunnel dispatch dominates and host wins.
+_DEVICE_MIN_FRONT = {3: 1024, 4: 64}
+_DEVICE_MIN_FRONT_WFG = 32  # applies to every M >= 5
 
 
 def _normalize_for_device(

@@ -12,7 +12,6 @@ the host only encodes/decodes params and sequences the pipeline.
 
 from __future__ import annotations
 
-import os
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
@@ -45,68 +44,21 @@ _STABILIZING_NOISE = 1e-10
 # joined, so the interpreter never tears the XLA runtime down under a live
 # thread (the r4 daemon-thread design aborted the process at exit).
 #
-# Cross-process dedup: tracing the fused chain programs is seconds of pure
-# GIL-holding Python, which on a small host competes with the main loop even
-# from a background thread. Once a job's executable is in the persistent
-# cache, later processes must not pay that trace again — each successful
-# compile drops a marker file (keyed by jax version, backend, a digest of
-# the kernel sources, and the job params) next to the cache entries, and
-# marked jobs are skipped before any thread is spawned.
+# The worker hands its finished AOT executables to the main loop through
+# ``_aot_executables``: a dispatch that finds its (shapes, statics) key here
+# calls the compiled object directly, skipping BOTH the trace (seconds of
+# GIL-holding Python) and the compile/deserialize it would otherwise pay at
+# every bucket crossing. The persistent disk cache still backs the worker's
+# own ``compile()`` across processes.
 import threading as _threading
 
 _PRECOMPILE_MAX_QUEUE = 16
 _precompile_pool = None
 _precompile_pending = 0
+_aot_executables: dict[tuple, Any] = {}
 # Created at import: lazy creation would race under optimize(n_jobs > 1),
 # handing concurrent trial threads distinct locks that guard nothing.
 _precompile_lock = _threading.Lock()
-
-
-def _kernel_source_digest() -> str:
-    """Digest of the sources that shape the fused programs' HLO."""
-    import hashlib
-
-    h = hashlib.sha256()
-    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    for rel in ("gp", "ops"):
-        folder = os.path.join(root, rel)
-        try:
-            names = sorted(os.listdir(folder))
-        except OSError:
-            continue
-        for name in names:
-            if name.endswith(".py"):
-                try:
-                    with open(os.path.join(folder, name), "rb") as f:
-                        h.update(f.read())
-                except OSError:
-                    pass
-    return h.hexdigest()[:16]
-
-
-def _precompile_marker_path(job_key: tuple) -> str | None:
-    """Marker file recording that ``job_key``'s executable is on disk."""
-    global _kernel_digest_cached
-    try:
-        import jax
-
-        cache_dir = jax.config.jax_compilation_cache_dir or os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR"
-        )
-        if not cache_dir:
-            return None
-        if _kernel_digest_cached is None:
-            _kernel_digest_cached = _kernel_source_digest()
-        import hashlib
-
-        payload = repr((jax.__version__, jax.default_backend(), _kernel_digest_cached, job_key))
-        name = "optuna-tpu-precompiled-" + hashlib.sha256(payload.encode()).hexdigest()[:24]
-        return os.path.join(cache_dir, name)
-    except Exception:  # pragma: no cover
-        return None
-
-
-_kernel_digest_cached: str | None = None
 
 
 def _submit_precompile(job_args: tuple) -> None:
@@ -141,8 +93,8 @@ def _shutdown_precompile_pool() -> None:
 
 
 def _precompile_job(
-    dev, d: int, n_bucket: int, q: int, n_starts: int, fit_iters: int,
-    n_local: int, minimum_noise: float, marker: str | None,
+    exec_key: tuple, dev, d: int, n_bucket: int, q: int, n_starts: int,
+    fit_iters: int, n_local: int, minimum_noise: float,
 ) -> None:
     global _precompile_pending
     try:
@@ -176,18 +128,15 @@ def _precompile_job(
                 key, minimum_noise, *common, q=q, n_local_search=n_local,
                 fit_iters=fit_iters, has_sweep=dev.has_sweep,
             )
-        lowered.compile()
-        # Safe to mark unconditionally: every program in this family takes
-        # multi-second XLA compiles cold (well past jax's 1 s persistence
-        # threshold), so compile() returning at all means the executable is
-        # now on disk — either it just compiled (and persisted) or it
-        # deserialized from an existing cache entry.
-        if marker is not None:
-            try:
-                with open(marker, "w"):
-                    pass
-            except OSError:
-                pass
+        compiled = lowered.compile()
+        with _precompile_lock:
+            # Bounded: a long-lived service cycling many spaces/buckets must
+            # not pin every executable forever — evict oldest (dict preserves
+            # insertion order); evicted programs fall back to the jit path,
+            # which the persistent disk cache keeps cheap.
+            while len(_aot_executables) >= 32:
+                _aot_executables.pop(next(iter(_aot_executables)))
+            _aot_executables[exec_key] = compiled
     except BaseException:  # pragma: no cover - precompile is best-effort
         _logger.debug("precompile-ahead failed", exc_info=True)
     finally:
@@ -446,33 +395,58 @@ class GPSampler(BaseSampler):
             fit_iters,
         )
 
+    def _exec_key(
+        self, dev, d: int, n_bucket: int, q: int, n_starts: int, fit_iters: int
+    ) -> tuple:
+        """Identity of one fused-program specialization: every input shape
+        and static argument, so a handed-off executable is only ever called
+        with exactly the signature it was lowered for."""
+        n_local = self._n_local_search if q == 0 else min(self._n_local_search, 6)
+        minimum_noise = 1e-7 if self._deterministic else 1e-5
+        return (
+            d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise,
+            bool(dev.has_sweep), tuple(dev.sobol_base.shape),
+            tuple(dev.dim_onehot.shape), tuple(dev.choice_grid.shape),
+            tuple(dev.choice_valid.shape),
+        )
+
     def _precompile_async(
         self, dev, d: int, n_bucket: int, q: int, n_starts: int, fit_iters: int
     ) -> None:
         """AOT-compile the (n_bucket, n_starts, fit_iters[, q]) fused program
         on the shared background worker. ``jit(...).lower(...).compile()``
         traces and compiles WITHOUT dispatching to the device, so the warm-up
-        never competes with the main loop for the chip; the executable lands
-        in XLA's persistent compile cache, turning the main loop's later
-        compile at this bucket into a fast deserialize. Values are irrelevant
-        — only shapes and static args key the compile."""
+        never competes with the device for the chip; the finished executable
+        is handed to the main loop through ``_aot_executables`` (and lands in
+        the persistent disk cache for later processes), so a bucket crossing
+        pays neither the trace nor the compile. Values are irrelevant — only
+        shapes and static args key the compile."""
         key = (id(dev), n_bucket, q, n_starts, fit_iters)
         if not self._precompile_ahead or key in self._precompiled:
             return
         self._precompiled.add(key)
+        exec_key = self._exec_key(dev, d, n_bucket, q, n_starts, fit_iters)
+        with _precompile_lock:
+            if exec_key in _aot_executables:
+                return
         n_local = self._n_local_search if q == 0 else min(self._n_local_search, 6)
         minimum_noise = 1e-7 if self._deterministic else 1e-5
-        job_key = (
-            d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise,
-            bool(dev.has_sweep), tuple(dev.sobol_base.shape),
-            tuple(dev.dim_onehot.shape), tuple(dev.choice_grid.shape),
-        )
-        marker = _precompile_marker_path(job_key)
-        if marker is not None and os.path.exists(marker):
-            return  # executable already in the persistent cache; skip the trace
         _submit_precompile(
-            (dev, d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise, marker)
+            (exec_key, dev, d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise)
         )
+
+    @staticmethod
+    def _aot_call(exec_key: tuple, args: tuple):
+        """Call a handed-off AOT executable; None when absent or unusable."""
+        with _precompile_lock:
+            compiled = _aot_executables.get(exec_key)
+        if compiled is None:
+            return None
+        try:
+            return compiled(*args)
+        except Exception:  # pragma: no cover - shape/aval drift falls back
+            _logger.debug("AOT executable call failed; jit fallback", exc_info=True)
+            return None
 
     def _precompile_after_dispatch(self, dev, d: int, n_bucket: int, q: int, was_cold: bool) -> None:
         """After a real dispatch at ``n_bucket``: warm-fit variant of this
@@ -496,16 +470,25 @@ class GPSampler(BaseSampler):
         starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
             study, space, X, trials, warm
         )
-        x_best, _, raw = gp_suggest_fused(
+        minimum_noise = 1e-7 if self._deterministic else 1e-5
+        args = (
             starts, Xp, yp, dev.cat_mask, maskp, dev.sobol_base, inc,
-            jax.random.PRNGKey(seed),
-            1e-7 if self._deterministic else 1e-5,
+            jax.random.PRNGKey(seed), minimum_noise,
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
-            n_local_search=self._n_local_search,
-            fit_iters=fit_iters,
-            has_sweep=dev.has_sweep,
         )
+        out = self._aot_call(
+            self._exec_key(dev, X.shape[1], Xp.shape[0], 0, starts.shape[0], fit_iters),
+            args,
+        )
+        if out is None:
+            out = gp_suggest_fused(
+                *args,
+                n_local_search=self._n_local_search,
+                fit_iters=fit_iters,
+                has_sweep=dev.has_sweep,
+            )
+        x_best, _, raw = out
         self._kernel_params_cache[sig] = [np.asarray(raw)]
         self._precompile_after_dispatch(
             dev, X.shape[1], Xp.shape[0], 0, was_cold=warm is None or not len(warm)
@@ -528,18 +511,26 @@ class GPSampler(BaseSampler):
         starts, Xp, yp, maskp, inc, n, fit_iters = self._fused_inputs(
             study, space, X, trials, warm, pad_extra=q
         )
-        xs, _, raw = gp_suggest_chain_fused(
+        minimum_noise = 1e-7 if self._deterministic else 1e-5
+        args = (
             starts, Xp, yp, dev.cat_mask, maskp, jnp.asarray(n, jnp.int32),
-            dev.sobol_base, inc,
-            jax.random.PRNGKey(seed),
-            1e-7 if self._deterministic else 1e-5,
+            dev.sobol_base, inc, jax.random.PRNGKey(seed), minimum_noise,
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
-            q=q,
-            n_local_search=min(self._n_local_search, 6),
-            fit_iters=fit_iters,
-            has_sweep=dev.has_sweep,
         )
+        out = self._aot_call(
+            self._exec_key(dev, X.shape[1], Xp.shape[0], q, starts.shape[0], fit_iters),
+            args,
+        )
+        if out is None:
+            out = gp_suggest_chain_fused(
+                *args,
+                q=q,
+                n_local_search=min(self._n_local_search, 6),
+                fit_iters=fit_iters,
+                has_sweep=dev.has_sweep,
+            )
+        xs, _, raw = out
         self._kernel_params_cache[sig] = [np.asarray(raw)]
         self._precompile_after_dispatch(
             dev, X.shape[1], Xp.shape[0], q, was_cold=warm is None or not len(warm)

@@ -100,157 +100,6 @@ def _sample_from(
 
     return x_num, x_cat
 
-
-@partial(jax.jit, static_argnames=("n_samples",))
-def sample_and_score(
-    seed: jnp.ndarray,
-    below: dict[str, jnp.ndarray],
-    above: dict[str, jnp.ndarray],
-    n_samples: int,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """TPE acquisition: draw from l(x), return argmax of log l(x) - log g(x).
-
-    EI is monotone in the density ratio (reference `_tpe/sampler.py:648-657`),
-    so the winner is the candidate maximizing ``log l - log g``. ``seed`` is a
-    traced uint32 so the PRNG key materializes INSIDE the graph — no separate
-    host-side PRNGKey dispatch.
-    """
-    key = jax.random.PRNGKey(seed)
-    x_num, x_cat = _sample_from(key, below, n_samples)
-    log_l = _component_log_pdf(x_num, x_cat, below)
-    log_g = _component_log_pdf(x_num, x_cat, above)
-    best = jnp.argmax(log_l - log_g)
-    return x_num[best], x_cat[best], (log_l - log_g)[best]
-
-
-@partial(jax.jit, static_argnames=("n_samples", "k"))
-def sample_and_score_topk(
-    seed: jnp.ndarray,
-    below: dict[str, jnp.ndarray],
-    above: dict[str, jnp.ndarray],
-    n_samples: int,
-    k: int,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Batch-ask: the k best-scoring candidates from one draw — one dispatch
-    proposes a whole batch of trials for the vectorized optimizer."""
-    key = jax.random.PRNGKey(seed)
-    x_num, x_cat = _sample_from(key, below, n_samples)
-    score = _component_log_pdf(x_num, x_cat, below) - _component_log_pdf(
-        x_num, x_cat, above
-    )
-    _, idx = jax.lax.top_k(score, k)
-    return x_num[idx], x_cat[idx]
-
-
-@jax.jit
-def log_pdf(
-    x_num: jnp.ndarray, x_cat: jnp.ndarray, pack: dict[str, jnp.ndarray]
-) -> jnp.ndarray:
-    """Mixture log-density of explicit samples (used by tests & MOTPE weights)."""
-    return _component_log_pdf(x_num, x_cat, pack)
-
-
-@partial(jax.jit, static_argnames=("n_samples",))
-def sample_and_score_univariate_batch(
-    seed: jnp.ndarray,
-    below: dict[str, jnp.ndarray],
-    above: dict[str, jnp.ndarray],
-    n_samples: int,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Classic (univariate) TPE for EVERY dimension in one dispatch.
-
-    Each dim is its own independent 1-D TPE problem; the packs here carry a
-    leading dim axis (numeric dims: mus/sigmas (D, B); categorical dims:
-    cat_log_probs (D, B, C)) and the 1-D sample->score->argmax is vmapped
-    across it. Identical math to calling the 1-D kernel D times — but one
-    device round-trip per *trial* instead of per *parameter*, which is the
-    difference between 2 and 6+ dispatches of latency on every suggestion.
-
-    Returns (winning numeric values (Dn,), winning categorical indices (Dc,)).
-    """
-
-    def one_num_dim(key, b_logw, b_mu, b_sigma, a_logw, a_mu, a_sigma, low, high, step):
-        bpack = {
-            "log_weights": b_logw,
-            "mus": b_mu[:, None],
-            "sigmas": b_sigma[:, None],
-            "lows": low[None],
-            "highs": high[None],
-            "steps": step[None],
-            "cat_log_probs": jnp.zeros((b_logw.shape[0], 0, 1)),
-        }
-        apack = {
-            "log_weights": a_logw,
-            "mus": a_mu[:, None],
-            "sigmas": a_sigma[:, None],
-            "lows": low[None],
-            "highs": high[None],
-            "steps": step[None],
-            "cat_log_probs": jnp.zeros((a_logw.shape[0], 0, 1)),
-        }
-        x_num, x_cat = _sample_from(key, bpack, n_samples)
-        score = _component_log_pdf(x_num, x_cat, bpack) - _component_log_pdf(
-            x_num, x_cat, apack
-        )
-        return x_num[jnp.argmax(score), 0]
-
-    def one_cat_dim(key, b_logw, b_probs, a_logw, a_probs):
-        bpack = {
-            "log_weights": b_logw,
-            "mus": jnp.zeros((b_logw.shape[0], 0)),
-            "sigmas": jnp.ones((b_logw.shape[0], 0)),
-            "lows": jnp.zeros(0),
-            "highs": jnp.zeros(0),
-            "steps": jnp.zeros(0),
-            "cat_log_probs": b_probs[:, None, :],
-        }
-        apack = {
-            "log_weights": a_logw,
-            "mus": jnp.zeros((a_logw.shape[0], 0)),
-            "sigmas": jnp.ones((a_logw.shape[0], 0)),
-            "lows": jnp.zeros(0),
-            "highs": jnp.zeros(0),
-            "steps": jnp.zeros(0),
-            "cat_log_probs": a_probs[:, None, :],
-        }
-        x_num, x_cat = _sample_from(key, bpack, n_samples)
-        score = _component_log_pdf(x_num, x_cat, bpack) - _component_log_pdf(
-            x_num, x_cat, apack
-        )
-        return x_cat[jnp.argmax(score), 0]
-
-    key = jax.random.PRNGKey(seed)
-    Dn = below["mus"].shape[0] if below["mus"].ndim == 2 else 0
-    Dc = below["cat_log_probs"].shape[0] if below["cat_log_probs"].ndim == 3 else 0
-
-    num_out = jnp.zeros(0)
-    cat_out = jnp.zeros(0, dtype=jnp.int32)
-    if Dn > 0:
-        keys = jax.random.split(key, Dn)
-        num_out = jax.vmap(one_num_dim)(
-            keys,
-            below["num_log_weights"],
-            below["mus"],
-            below["sigmas"],
-            above["num_log_weights"],
-            above["mus"],
-            above["sigmas"],
-            below["lows"],
-            below["highs"],
-            below["steps"],
-        )
-    if Dc > 0:
-        keys = jax.random.split(jax.random.fold_in(key, 1), Dc)
-        cat_out = jax.vmap(one_cat_dim)(
-            keys,
-            below["cat_log_weights"],
-            below["cat_log_probs"],
-            above["cat_log_weights"],
-            above["cat_log_probs"],
-        )
-    return num_out, cat_out
-
-
 # --------------------------------------------------------------------------
 # In-graph KDE build: the bandwidth heuristic, prior component, and
 # categorical smoothing computed INSIDE the XLA program from raw (padded)
@@ -298,9 +147,15 @@ def _build_num_dim(obs, n, low, high, consider_endpoints, magic_clip, n_k):
     return mus, sigmas
 
 
-def _build_cat_dim(obs, n, n_choices, prior_weight, n_comp, Cmax):
-    """(B, Cmax) log-probability table for one categorical dim (no distance
-    kernel; that case stays on the host build)."""
+def _build_cat_dim(obs, n, n_choices, prior_weight, n_comp, Cmax, dist_mat=None, has_dist=None):
+    """(B, Cmax) log-probability table for one categorical dim.
+
+    With ``dist_mat`` (Cmax, Cmax) and ``has_dist`` true, observed rows use
+    the categorical-distance kernel (reference ``parzen_estimator.py:152-160``):
+    row i is REPLACED by exp(-(d(obs_i, ·)/row_max)² · coef) with
+    coef = log(n_comp/prior_weight) · log(C) / log(6). The user's distance
+    callable is evaluated once per space into the matrix on the host; the
+    per-trial build stays entirely in-graph."""
     B = obs.shape[0]
     idx = jnp.arange(B)
     obs_mask = idx < n
@@ -309,6 +164,22 @@ def _build_cat_dim(obs, n, n_choices, prior_weight, n_comp, Cmax):
     base = prior_weight / jnp.maximum(n_comp, 1.0)
     onehot = (choice[None, :] == obs[:, None]) & obs_mask[:, None] & choice_mask[None, :]
     probs = jnp.where(choice_mask[None, :], base, 0.0) + onehot.astype(jnp.float32)
+    if dist_mat is not None:
+        d_rows = dist_mat[obs]  # (B, Cmax)
+        coef = (
+            jnp.log(jnp.maximum(n_comp, 1.0) / prior_weight)
+            * jnp.log(n_choices.astype(jnp.float32))
+            / jnp.log(6.0)
+        )
+        row_max = jnp.max(
+            jnp.where(choice_mask[None, :], d_rows, -jnp.inf), axis=1, keepdims=True
+        )
+        row_max = jnp.maximum(row_max, EPS_BUILD)
+        kern = jnp.exp(-((d_rows / row_max) ** 2) * coef) * choice_mask[None, :]
+        probs_dist = jnp.where(
+            obs_mask[:, None], kern, jnp.where(choice_mask[None, :], base, 0.0)
+        )
+        probs = jnp.where(has_dist, probs_dist, probs)
     row_sums = probs.sum(axis=1, keepdims=True)
     probs = probs / jnp.where(row_sums == 0, 1.0, row_sums)
     return jnp.where(
@@ -340,6 +211,8 @@ def sample_univariate_from_obs(
     steps: jnp.ndarray,  # (Dn,)
     n_choices: jnp.ndarray,  # (Dc,) int32
     prior_weight: jnp.ndarray,  # f32 scalar
+    dist_mats: jnp.ndarray,  # (Dc, Cmax, Cmax) per-choice distances
+    has_dist: jnp.ndarray,  # (Dc,) bool: dim uses the distance kernel
     n_samples: int,
     consider_endpoints: bool,
     magic_clip: bool,
@@ -360,8 +233,10 @@ def sample_univariate_from_obs(
 
     def build_cat(obs, n, n_k):
         return jax.vmap(
-            lambda o, c: _build_cat_dim(o, n, c, prior_weight, n_k, cat_cmax)
-        )(obs, n_choices)
+            lambda o, c, dm, hd: _build_cat_dim(
+                o, n, c, prior_weight, n_k, cat_cmax, dm, hd
+            )
+        )(obs, n_choices, dist_mats, has_dist)
 
     def one_num_dim(key, b_logw, b_mu, b_sigma, a_logw, a_mu, a_sigma, low, high, step):
         bpack = {
@@ -447,7 +322,7 @@ def sample_univariate_from_obs(
 
 def _make_joint_pack(
     obs_num, obs_cat, log_w, n, n_k, lows, highs, steps, n_choices,
-    prior_weight, consider_endpoints, magic_clip, cat_cmax,
+    prior_weight, dist_mats, has_dist, consider_endpoints, magic_clip, cat_cmax,
 ):
     """In-graph build of the JOINT (multivariate) mixture pack: per-dim
     bandwidths are identical to the univariate case (the reference has no
@@ -468,8 +343,10 @@ def _make_joint_pack(
         sigmas = jnp.ones((B, 0))
     if Dc > 0:
         probs_d = jax.vmap(
-            lambda o, c: _build_cat_dim(o, n, c, prior_weight, n_k, cat_cmax)
-        )(obs_cat, n_choices)  # (Dc, B, C)
+            lambda o, c, dm, hd: _build_cat_dim(
+                o, n, c, prior_weight, n_k, cat_cmax, dm, hd
+            )
+        )(obs_cat, n_choices, dist_mats, has_dist)  # (Dc, B, C)
         cat_log_probs = jnp.transpose(probs_d, (1, 0, 2))  # (B, Dc, C)
     else:
         cat_log_probs = jnp.zeros((B, 0, 1))
@@ -492,7 +369,7 @@ def sample_and_score_from_obs(
     seed,
     b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k,
     a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k,
-    lows, highs, steps, n_choices, prior_weight,
+    lows, highs, steps, n_choices, prior_weight, dist_mats, has_dist,
     n_samples: int, consider_endpoints: bool, magic_clip: bool, cat_cmax: int,
 ):
     """Multivariate TPE from raw observations: joint-KDE build + draw +
@@ -500,11 +377,13 @@ def sample_and_score_from_obs(
     key = jax.random.PRNGKey(seed)
     below = _make_joint_pack(
         b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k, lows, highs, steps,
-        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+        n_choices, prior_weight, dist_mats, has_dist,
+        consider_endpoints, magic_clip, cat_cmax,
     )
     above = _make_joint_pack(
         a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k, lows, highs, steps,
-        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+        n_choices, prior_weight, dist_mats, has_dist,
+        consider_endpoints, magic_clip, cat_cmax,
     )
     x_num, x_cat = _sample_from(key, below, n_samples)
     score = _component_log_pdf(x_num, x_cat, below) - _component_log_pdf(
@@ -522,18 +401,20 @@ def sample_and_score_topk_from_obs(
     seed,
     b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k,
     a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k,
-    lows, highs, steps, n_choices, prior_weight,
+    lows, highs, steps, n_choices, prior_weight, dist_mats, has_dist,
     n_samples: int, k: int, consider_endpoints: bool, magic_clip: bool, cat_cmax: int,
 ):
     """Batch-ask variant: top-k scoring joint candidates, one dispatch."""
     key = jax.random.PRNGKey(seed)
     below = _make_joint_pack(
         b_obs_num, b_obs_cat, b_log_w, b_n, b_n_k, lows, highs, steps,
-        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+        n_choices, prior_weight, dist_mats, has_dist,
+        consider_endpoints, magic_clip, cat_cmax,
     )
     above = _make_joint_pack(
         a_obs_num, a_obs_cat, a_log_w, a_n, a_n_k, lows, highs, steps,
-        n_choices, prior_weight, consider_endpoints, magic_clip, cat_cmax,
+        n_choices, prior_weight, dist_mats, has_dist,
+        consider_endpoints, magic_clip, cat_cmax,
     )
     x_num, x_cat = _sample_from(key, below, n_samples)
     score = _component_log_pdf(x_num, x_cat, below) - _component_log_pdf(

@@ -28,10 +28,7 @@ from optuna_tpu.samplers._base import (
 from optuna_tpu.samplers._lazy_random_state import LazyRandomState
 from optuna_tpu.samplers._random import RandomSampler
 from optuna_tpu.samplers._tpe import _kernels
-from optuna_tpu.samplers._tpe.parzen_estimator import (
-    _ParzenEstimator,
-    _ParzenEstimatorParameters,
-)
+from optuna_tpu.samplers._tpe.parzen_estimator import _ParzenEstimatorParameters
 from optuna_tpu.search_space import IntersectionSearchSpace, _GroupDecomposedSearchSpace
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -204,10 +201,6 @@ class TPESampler(BaseSampler):
         search_space: dict[str, BaseDistribution],
     ) -> dict[str, Any]:
         """All per-dim independent TPE problems in one fused dispatch."""
-        import jax.numpy as jnp
-
-        from optuna_tpu.distributions import CategoricalDistribution
-
         states: tuple[TrialState, ...]
         if self._constant_liar:
             states = (TrialState.COMPLETE, TrialState.PRUNED, TrialState.RUNNING)
@@ -219,99 +212,12 @@ class TPESampler(BaseSampler):
         below_trials, above_trials = _split_trials(
             study, trials, self._gamma(n_finished), self._constraints_func is not None
         )
-
-        # Fast path: KDE build happens INSIDE the jit program from raw
-        # observations (one small transfer + one dispatch per trial). The
-        # categorical distance kernel is host-only, so that case keeps the
-        # host _ParzenEstimator build below.
-        if not self._uses_distance_kernel(search_space):
-            return self._sample_univariate_fused(
-                study, search_space, below_trials, above_trials
-            )
-
-        num_names = [n for n, d in search_space.items() if not isinstance(d, CategoricalDistribution)]
-        cat_names = [n for n, d in search_space.items() if isinstance(d, CategoricalDistribution)]
-
-        def build(trial_set: list[FrozenTrial], below: bool):
-            weights = None
-            if below and study._is_multi_objective():
-                # Loop-invariant: one HSSP-contribution computation per set.
-                weights = _calculate_weights_below_for_multi_objective(study, trial_set)
-            estimators = {}
-            for name in search_space:
-                obs = {
-                    name: np.asarray(
-                        [t.distributions[name].to_internal_repr(t.params[name]) for t in trial_set],
-                        dtype=np.float64,
-                    )
-                }
-                estimators[name] = _ParzenEstimator(
-                    obs, {name: search_space[name]}, self._parzen_estimator_parameters, weights
-                )
-            return estimators
-
-        below_est = build(below_trials, True)
-        above_est = build(above_trials, False)
-
-        def stack(estimators, names):
-            packs = [estimators[n].pack() for n in names]
-            out: dict[str, np.ndarray] = {}
-            num = [p for p in packs if p["mus"].shape[1] == 1]
-            cat = [p for p in packs if p["cat_log_probs"].shape[1] == 1]
-            if num:
-                out["num_log_weights"] = np.stack([p["log_weights"] for p in num])
-                out["mus"] = np.stack([p["mus"][:, 0] for p in num])
-                out["sigmas"] = np.stack([p["sigmas"][:, 0] for p in num])
-                out["lows"] = np.stack([p["lows"][0] for p in num])
-                out["highs"] = np.stack([p["highs"][0] for p in num])
-                out["steps"] = np.stack([p["steps"][0] for p in num])
-            else:
-                out["num_log_weights"] = np.zeros((0, 1))
-                out["mus"] = np.zeros((0, 1))
-                out["sigmas"] = np.ones((0, 1))
-                out["lows"] = np.zeros(0)
-                out["highs"] = np.ones(0)
-                out["steps"] = np.zeros(0)
-            if cat:
-                cmax = max(p["cat_log_probs"].shape[2] for p in cat)
-                probs = np.full((len(cat), cat[0]["cat_log_probs"].shape[0], cmax), -np.inf)
-                for i, p in enumerate(cat):
-                    c = p["cat_log_probs"].shape[2]
-                    probs[i, :, :c] = p["cat_log_probs"][:, 0, :]
-                out["cat_log_weights"] = np.stack([p["log_weights"] for p in cat])
-                out["cat_log_probs"] = probs
-            else:
-                out["cat_log_weights"] = np.zeros((0, 1))
-                out["cat_log_probs"] = np.zeros((0, 1, 1))
-            return out
-
-        import jax
-
-        ordered = num_names + cat_names
-        below_pack = stack(below_est, ordered)
-        above_pack = stack(above_est, ordered)
-        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
-        from optuna_tpu._device_policy import small_kernel_scope
-
-        with small_kernel_scope():  # KDE kernels are dispatch-latency-bound
-            num_out, cat_out = _kernels.sample_and_score_univariate_batch(
-                seed,
-                {k: jnp.asarray(v) for k, v in below_pack.items()},
-                {k: jnp.asarray(v) for k, v in above_pack.items()},
-                self._n_ei_candidates,
-            )
-        num_out, cat_out = jax.device_get((num_out, cat_out))
-        num_out = np.asarray(num_out)
-        cat_out = np.asarray(cat_out)
-
-        params: dict[str, Any] = {}
-        for i, name in enumerate(num_names):
-            internal = below_est[name].decode(num_out[i : i + 1], np.zeros(0))[name]
-            params[name] = search_space[name].to_external_repr(internal)
-        for i, name in enumerate(cat_names):
-            internal = below_est[name].decode(np.zeros(0), cat_out[i : i + 1])[name]
-            params[name] = search_space[name].to_external_repr(internal)
-        return params
+        # The KDE build happens INSIDE the jit program from raw observations
+        # (one small transfer + one dispatch per trial). Categorical-distance
+        # kernels ride along as precomputed (C, C) matrices in the space spec.
+        return self._sample_univariate_fused(
+            study, search_space, below_trials, above_trials
+        )
 
     def _univariate_space_spec(self, search_space: dict[str, BaseDistribution]):
         """Cached per-space-signature static arrays for the fused kernel.
@@ -347,6 +253,23 @@ class TPESampler(BaseSampler):
                 ),
                 "cat_cmax": max((len(d.choices) for _, d in cat_items), default=1),
             }
+            # Categorical-distance kernel: the user callable is evaluated
+            # ONCE per space into a (C, C) matrix here; every per-trial KDE
+            # build then happens in-graph (_kernels._build_cat_dim).
+            cmax = spec["cat_cmax"]
+            dist_funcs = self._parzen_estimator_parameters.categorical_distance_func
+            dist_mats = np.zeros((len(cat_items), cmax, cmax), np.float32)
+            has_dist = np.zeros(len(cat_items), bool)
+            for d, (name, dist) in enumerate(cat_items):
+                fn = dist_funcs.get(name)
+                if fn is None:
+                    continue
+                has_dist[d] = True
+                for i, ci in enumerate(dist.choices):
+                    for j, cj in enumerate(dist.choices):
+                        dist_mats[d, i, j] = float(fn(ci, cj))
+            spec["dist_mats"] = dist_mats
+            spec["has_dist"] = has_dist
             self._univariate_space_specs[key] = spec
         return spec
 
@@ -396,21 +319,29 @@ class TPESampler(BaseSampler):
         return obs_num, obs_cat, log_w, np.int32(n), np.float32(n + (1 if effective_prior else 0))
 
     def _fused_obs_inputs(self, study, spec, below_trials, above_trials):
-        """Device-resident argument tree for the *_from_obs kernels (ONE
-        batched host->device transfer)."""
+        """Argument tree for the *_from_obs kernels.
+
+        On an accelerator the ~18 leaves go through one batched
+        ``device_put`` so the tunnel sees a single transfer; when the small-
+        kernel policy routes to the host CPU backend the explicit put is pure
+        overhead (~3 ms/trial of pytree staging, measured) — the jit call's
+        own C++ conversion path absorbs NumPy args faster."""
         import jax
 
         p = self._parzen_estimator_parameters
         b_pack = self._pack_observations(study, spec, below_trials, below=True)
         a_pack = self._pack_observations(study, spec, above_trials, below=False)
         seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
-        return jax.device_put(
-            (
-                seed, *b_pack, *a_pack,
-                spec["lows"], spec["highs"], spec["steps"], spec["n_choices"],
-                np.float32(p.prior_weight),
-            )
+        args = (
+            seed, *b_pack, *a_pack,
+            spec["lows"], spec["highs"], spec["steps"], spec["n_choices"],
+            np.float32(p.prior_weight), spec["dist_mats"], spec["has_dist"],
         )
+        from optuna_tpu._device_policy import small_kernel_device
+
+        if small_kernel_device() is not None or jax.default_backend() == "cpu":
+            return args
+        return jax.device_put(args)
 
     def _decode_fused(self, spec, num_out, cat_out) -> dict[str, Any]:
         from optuna_tpu.samplers._tpe.parzen_estimator import _from_transformed
@@ -422,12 +353,6 @@ class TPESampler(BaseSampler):
         for d, (name, dist) in enumerate(spec["cat_items"]):
             params[name] = dist.to_external_repr(float(int(cat_out[d])))
         return params
-
-    def _uses_distance_kernel(self, search_space: dict[str, BaseDistribution]) -> bool:
-        return any(
-            name in self._parzen_estimator_parameters.categorical_distance_func
-            for name in search_space
-        )
 
     def _sample_univariate_fused(
         self,
@@ -509,62 +434,22 @@ class TPESampler(BaseSampler):
 
         from optuna_tpu._device_policy import small_kernel_scope
 
-        if not self._uses_distance_kernel(search_space):
-            # Joint KDE with the build in-graph (same bandwidths as the
-            # univariate case; the reference has no separate multivariate
-            # bandwidth branch).
-            p = self._parzen_estimator_parameters
-            spec = self._univariate_space_spec(search_space)
-            with small_kernel_scope():
-                dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
-                x_num, x_cat = _kernels.sample_and_score_from_obs(
-                    *dev,
-                    n_samples=self._n_ei_candidates,
-                    consider_endpoints=p.consider_endpoints,
-                    magic_clip=p.consider_magic_clip,
-                    cat_cmax=spec["cat_cmax"],
-                )
-                x_num, x_cat = jax.device_get((x_num, x_cat))
-            return self._decode_fused(spec, x_num, x_cat)
-
-        below = self._build_parzen(below_trials, study, search_space, below=True)
-        above = self._build_parzen(above_trials, study, search_space, below=False)
-
-        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
+        # Joint KDE with the build in-graph (same bandwidths as the
+        # univariate case; the reference has no separate multivariate
+        # bandwidth branch). Distance kernels are in-graph too.
+        p = self._parzen_estimator_parameters
+        spec = self._univariate_space_spec(search_space)
         with small_kernel_scope():
-            x_num, x_cat, _ = _kernels.sample_and_score(
-                seed,
-                {k: jnp.asarray(v) for k, v in below.pack().items()},
-                {k: jnp.asarray(v) for k, v in above.pack().items()},
-                self._n_ei_candidates,
+            dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
+            x_num, x_cat = _kernels.sample_and_score_from_obs(
+                *dev,
+                n_samples=self._n_ei_candidates,
+                consider_endpoints=p.consider_endpoints,
+                magic_clip=p.consider_magic_clip,
+                cat_cmax=spec["cat_cmax"],
             )
-        x_num, x_cat = jax.device_get((x_num, x_cat))
-        internal = below.decode(np.asarray(x_num), np.asarray(x_cat))
-        return {
-            name: search_space[name].to_external_repr(internal[name])
-            for name in param_names
-        }
-
-    def _build_parzen(
-        self,
-        trials: list[FrozenTrial],
-        study: "Study",
-        search_space: dict[str, BaseDistribution],
-        below: bool,
-    ) -> _ParzenEstimator:
-        observations = {
-            name: np.asarray(
-                [t.distributions[name].to_internal_repr(t.params[name]) for t in trials],
-                dtype=np.float64,
-            )
-            for name in search_space
-        }
-        weights = None
-        if below and study._is_multi_objective():
-            weights = _calculate_weights_below_for_multi_objective(study, trials)
-        return _ParzenEstimator(
-            observations, search_space, self._parzen_estimator_parameters, weights
-        )
+            x_num, x_cat = jax.device_get((x_num, x_cat))
+        return self._decode_fused(spec, x_num, x_cat)
 
     def sample_relative_batch(
         self,
@@ -594,44 +479,20 @@ class TPESampler(BaseSampler):
         )
         from optuna_tpu._device_policy import small_kernel_scope
 
-        if not self._uses_distance_kernel(search_space):
-            p = self._parzen_estimator_parameters
-            spec = self._univariate_space_spec(search_space)
-            with small_kernel_scope():
-                dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
-                x_num, x_cat = _kernels.sample_and_score_topk_from_obs(
-                    *dev,
-                    n_samples=max(self._n_ei_candidates, 4 * n),
-                    k=n,
-                    consider_endpoints=p.consider_endpoints,
-                    magic_clip=p.consider_magic_clip,
-                    cat_cmax=spec["cat_cmax"],
-                )
-                x_num, x_cat = jax.device_get((x_num, x_cat))
-            return [self._decode_fused(spec, x_num[i], x_cat[i]) for i in range(n)]
-
-        below = self._build_parzen(below_trials, study, search_space, below=True)
-        above = self._build_parzen(above_trials, study, search_space, below=False)
-        seed = np.uint32(self._rng.rng.randint(0, 2**31 - 1))
+        p = self._parzen_estimator_parameters
+        spec = self._univariate_space_spec(search_space)
         with small_kernel_scope():
-            x_num, x_cat = _kernels.sample_and_score_topk(
-                seed,
-                {k: jnp.asarray(v) for k, v in below.pack().items()},
-                {k: jnp.asarray(v) for k, v in above.pack().items()},
-                max(self._n_ei_candidates, 4 * n),
-                n,
+            dev = self._fused_obs_inputs(study, spec, below_trials, above_trials)
+            x_num, x_cat = _kernels.sample_and_score_topk_from_obs(
+                *dev,
+                n_samples=max(self._n_ei_candidates, 4 * n),
+                k=n,
+                consider_endpoints=p.consider_endpoints,
+                magic_clip=p.consider_magic_clip,
+                cat_cmax=spec["cat_cmax"],
             )
-        x_num, x_cat = jax.device_get((x_num, x_cat))
-        out = []
-        for i in range(n):
-            internal = below.decode(np.asarray(x_num[i]), np.asarray(x_cat[i]))
-            out.append(
-                {
-                    name: search_space[name].to_external_repr(internal[name])
-                    for name in search_space
-                }
-            )
-        return out
+            x_num, x_cat = jax.device_get((x_num, x_cat))
+        return [self._decode_fused(spec, x_num[i], x_cat[i]) for i in range(n)]
 
     def after_trial(
         self,

@@ -126,7 +126,17 @@ class SqliteDialect:
         con.executescript(schema_template.format(**self.ddl_types()))
 
     def execute_ddl(self, con: Any, stmt: str) -> None:
-        con.execute(stmt)  # sqlite DDL uses IF NOT EXISTS natively
+        # CREATE statements use IF NOT EXISTS natively, but sqlite has no
+        # ALTER TABLE ... ADD COLUMN IF NOT EXISTS — tolerate already-applied
+        # steps so a migration interrupted after a DDL prefix (or a database
+        # touched by a newer process) completes idempotently on retry, the
+        # same contract the MySQL dialect provides.
+        try:
+            con.execute(stmt)
+        except sqlite3.OperationalError as err:
+            msg = str(err).lower()
+            if "duplicate column name" not in msg and "already exists" not in msg:
+                raise
 
     def insert_id(self, con: Any, sql: str, args: Sequence[Any], id_col: str) -> int:
         return int(con.execute(sql, args).lastrowid)

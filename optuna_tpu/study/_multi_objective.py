@@ -102,7 +102,11 @@ def _fast_non_domination_rank(
     # Tier 1: feasible points ranked by non-domination. Large populations go
     # through the tiled Pallas/XLA kernel (ops/pareto.py) — the O(n^2 m)
     # dominance comparisons are the FLOP body; host NumPy keeps small n where
-    # dispatch latency would dominate. The device result is a full ranking, a
+    # dispatch latency would dominate. The 512 threshold is the measured
+    # crossover on the live TPU (bench_results/mo_crossover.json: at n=512
+    # host 188 ms vs device 67 ms for m=2; host wins below — 32 ms at n=256
+    # vs the ~70 ms tunnel dispatch — so default NSGA-II populations of 50
+    # genuinely belong on host). The device result is a full ranking, a
     # strict refinement of the host path's early-stopped one: every consumer
     # iterates ranks from 0 and stops at its own budget, so both agree on the
     # prefix that matters.

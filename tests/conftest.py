@@ -29,3 +29,19 @@ import pytest  # noqa: E402
 pytest.register_assert_rewrite(
     "optuna_tpu.testing.pytest_storages", "optuna_tpu.testing.pytest_samplers"
 )
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled-program state at module boundaries.
+
+    A monolithic ~1000-test run accumulates thousands of live XLA:CPU
+    executables (each holds JIT'd code pages); past a threshold the next
+    backend compile segfaults inside XLA (reproduced deterministically at
+    ~test 490, while any per-file or half-suite run is green). Dropping the
+    jit caches per module keeps the live-executable population bounded; the
+    persistent on-disk cache makes the recompiles cheap."""
+    yield
+    import jax
+
+    jax.clear_caches()

@@ -125,3 +125,50 @@ def test_server_dialect_without_driver_raises_with_guidance(url):
     assert "pip install" in msg
     assert "run_grpc_proxy_server" in msg
     assert "README" in msg
+
+
+# ------------------------------------------------- r5 multi-version assets
+
+FIXTURE_V2 = os.path.join(os.path.dirname(__file__), "fixtures", "rdb_v2.db")
+
+
+def test_head_fixture_opens_without_upgrade(tmp_path):
+    """The committed head-version (v2) asset opens directly; upgrade() is a
+    no-op; legacy rows read back (reference keeps one asset per historic
+    schema under tests/storages_tests/rdb_tests/test_upgrade_assets)."""
+    path = str(tmp_path / "head.db")
+    shutil.copy(FIXTURE_V2, path)
+    storage = RDBStorage(f"sqlite:///{path}")
+    assert storage.get_current_version() == storage.get_head_version()
+    storage.upgrade()  # no-op at head
+    study = optuna_tpu.load_study(study_name="fixture-v2", storage=storage)
+    assert len(study.trials) == 3
+    assert study.best_value == pytest.approx(0.026563666574867997)
+    assert study.user_attrs["era"] == "round5"
+    # The storage is fully writable post-open: append one more trial.
+    study.sampler = optuna_tpu.samplers.RandomSampler(seed=1)
+    study.optimize(lambda t: t.suggest_float("x", -1, 1) ** 2, n_trials=1)
+    assert len(study.trials) == 4
+
+
+def test_crashed_mid_upgrade_recovers(v1_db):
+    """A v1->v2 upgrade that died after applying a DDL prefix (possible on
+    MySQL, whose DDL implicit-commits) must complete on retry: the steps are
+    tolerant of already-applied statements."""
+    con = sqlite3.connect(v1_db)
+    con.execute("ALTER TABLE studies ADD COLUMN created_at TEXT")  # step 1 of 2
+    con.commit()
+    con.close()
+    storage = RDBStorage(f"sqlite:///{v1_db}", skip_compatibility_check=True)
+    assert storage.get_current_version() == "v1"  # version row never advanced
+    storage.upgrade()
+    assert storage.get_current_version() == storage.get_head_version()
+    con = sqlite3.connect(v1_db)
+    indexes = {r[1] for r in con.execute("PRAGMA index_list(trials)")}
+    assert "ix_trials_study_state" in indexes
+    con.close()
+    # And the storage works.
+    study = optuna_tpu.create_study(storage=storage)
+    study.sampler = optuna_tpu.samplers.RandomSampler(seed=2)
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=2)
+    assert len(study.trials) == 2
