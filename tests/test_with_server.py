@@ -93,6 +93,11 @@ def test_multiprocess_optimize_race(grpc_server, tmp_path):
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # Workers must stay on the host CPU backend: the parent's conftest only
+    # pins jax.config in-process, and a child that inherits a remote
+    # accelerator platform hangs the race test whenever the tunnel blips.
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
     n_procs, per_proc = 3, 8
     procs = [
         subprocess.Popen(
