@@ -101,3 +101,63 @@ def test_mixed_space_chain_snaps_discrete():
     for t in study.trials:
         assert isinstance(t.params["k"], int)
         assert t.params["c"] in ("a", "b", "c")
+
+
+def test_precompile_worker_hands_off_aot_executables():
+    """r5: the background precompile worker AOT-compiles ahead-of-bucket
+    programs and publishes them for the dispatch path; after a study crosses
+    a bucket boundary the shared table must hold executables whose keys
+    carry this sampler's static signature."""
+    import time
+
+    from optuna_tpu.samplers._gp import sampler as gp_mod
+
+    sampler = GPSampler(seed=3, n_startup_trials=5)
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(lambda t: (t.suggest_float("x", -1, 1) - 0.3) ** 2, n_trials=20)
+    # The worker is asynchronous: give queued compile jobs a moment to land.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        with gp_mod._precompile_lock:
+            keys = list(gp_mod._aot_executables)
+        if any(k[0] == 1 for k in keys):  # d=1 programs from this study
+            break
+        time.sleep(0.5)
+    assert any(k[0] == 1 for k in keys), f"no handed-off executables: {keys}"
+    # And the dispatch path accepts a live lookup (exercises _aot_call).
+    study.optimize(lambda t: (t.suggest_float("x", -1, 1) - 0.3) ** 2, n_trials=2)
+    assert len(study.trials) == 22
+
+
+def test_gp_process_exits_cleanly_after_precompile(tmp_path):
+    """Regression guard for the r4 daemon-thread abort: a short-lived
+    process that uses GPSampler (spawning precompile work) must exit 0 —
+    no 'terminate called' / 'FATAL: exception not rethrown' at teardown."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "short.py"
+    script.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import optuna_tpu\n"
+        "from optuna_tpu.samplers import GPSampler\n"
+        "s = optuna_tpu.create_study(sampler=GPSampler(seed=0, n_startup_trials=4))\n"
+        "s.optimize(lambda t: t.suggest_float('x', -1, 1) ** 2, n_trials=8)\n"
+        "print('SHORT-OK', len(s.trials))\n"
+    )
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True, timeout=600,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SHORT-OK 8" in proc.stdout
+    assert "terminate called" not in proc.stderr
+    assert "FATAL" not in proc.stderr
