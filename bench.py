@@ -584,7 +584,10 @@ def _ensure_responsive_backend() -> None:
     mistaken for an accelerator result."""
     if os.environ.get("OPTUNA_TPU_BENCH_CPU_FALLBACK"):
         return
-    retries = max(1, int(os.environ.get("OPTUNA_TPU_BENCH_PROBE_RETRIES", "3")))
+    # 5 x (180 s probe + 20 s backoff): the tunnel was observed flapping in
+    # multi-minute cycles (2026-07-30); three attempts often missed every
+    # up-window while five catches one without stalling a healthy run.
+    retries = max(1, int(os.environ.get("OPTUNA_TPU_BENCH_PROBE_RETRIES", "5")))
     for attempt in range(retries):
         _log_probe_event(f"probe_start attempt={attempt + 1}/{retries}")
         ok, detail = _probe_backend_once(timeout_s=180)

@@ -112,6 +112,10 @@ def test_precompile_worker_hands_off_aot_executables():
 
     from optuna_tpu.samplers._gp import sampler as gp_mod
 
+    # Start from an empty table so residue from earlier tests cannot make
+    # this pass vacuously (evicted programs just fall back to the jit path).
+    with gp_mod._precompile_lock:
+        gp_mod._aot_executables.clear()
     sampler = GPSampler(seed=3, n_startup_trials=5)
     study = optuna_tpu.create_study(sampler=sampler)
     study.optimize(lambda t: (t.suggest_float("x", -1, 1) - 0.3) ** 2, n_trials=20)
