@@ -605,6 +605,13 @@ def _ensure_responsive_backend() -> None:
     env["JAX_PLATFORMS"] = "cpu"
     # sitecustomize only engages when PALLAS_AXON_POOL_IPS is truthy.
     env["PALLAS_AXON_POOL_IPS"] = ""
+    # Separate cache namespace: entries compiled in the accelerator-context
+    # process carry different CPU machine-feature preferences, and loading
+    # them here makes XLA warn about (or worse, execute) mismatched AOT code.
+    env["JAX_COMPILATION_CACHE_DIR"] = (
+        env.get("JAX_COMPILATION_CACHE_DIR", "/tmp/optuna_tpu_jax_cache")
+        + "_cpufallback"
+    )
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
 
 

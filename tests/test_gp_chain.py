@@ -114,8 +114,15 @@ def test_precompile_worker_hands_off_aot_executables():
 
     # Start from an empty table so residue from earlier tests cannot make
     # this pass vacuously (evicted programs just fall back to the jit path).
-    with gp_mod._precompile_lock:
-        gp_mod._aot_executables.clear()
+    # Drain first: a job queued by an earlier test would otherwise land a
+    # key AFTER the clear and satisfy the assertion by itself.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        with gp_mod._precompile_lock:
+            if gp_mod._precompile_pending == 0:
+                gp_mod._aot_executables.clear()
+                break
+        time.sleep(0.2)
     sampler = GPSampler(seed=3, n_startup_trials=5)
     study = optuna_tpu.create_study(sampler=sampler)
     study.optimize(lambda t: (t.suggest_float("x", -1, 1) - 0.3) ** 2, n_trials=20)
