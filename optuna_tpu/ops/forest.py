@@ -33,7 +33,15 @@ from functools import partial
 
 import numpy as np
 
+from optuna_tpu.logging import get_logger
+
+_logger = get_logger(__name__)
+
 _EPS = 1e-12
+
+# Fixed-shape level growth allocates the full heap frontier (2^depth nodes)
+# up front, so depth is hard-capped; sklearn's default 64 means "unbounded".
+_MAX_DEVICE_DEPTH = 10
 
 
 @dataclass
@@ -195,9 +203,20 @@ def fit_forest(
 
     n, d = X.shape
     # Fixed-shape level growth: depth beyond log2(n) only chases singleton
-    # leaves, so cap it (10 ≈ fully grown for the trial counts importance
-    # analysis sees; sklearn's 64 means "unbounded").
-    depth = int(min(max_depth, 10, max(2, int(np.ceil(np.log2(max(n, 4)))) + 2)))
+    # leaves, so the data-driven cap is lossless; the hard _MAX_DEVICE_DEPTH
+    # cap is not, and a caller asking for more (e.g.
+    # FanovaImportanceEvaluator(max_depth=64) expecting sklearn's effectively
+    # unbounded trees) must hear about it rather than silently get shallower
+    # trees once n outgrows 2**_MAX_DEVICE_DEPTH samples.
+    data_cap = max(2, int(np.ceil(np.log2(max(n, 4)))) + 2)
+    depth = int(min(max_depth, _MAX_DEVICE_DEPTH, data_cap))
+    if min(max_depth, data_cap) > _MAX_DEVICE_DEPTH:
+        _logger.warning(
+            f"fit_forest: requested max_depth={max_depth} clamped to the device "
+            f"cap of {_MAX_DEVICE_DEPTH} (n={n} samples could use depth "
+            f"{min(max_depth, data_cap)}); importances may differ slightly from "
+            "an unbounded-depth reference forest."
+        )
     n_bins = int(min(n_bins, max(4, n + 1)))
     bins_np, thresholds = _make_bins(np.asarray(X, np.float64), n_bins)
     # Standardized targets keep the f32 split scores (Σy)²/n well away from

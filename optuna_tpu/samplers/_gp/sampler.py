@@ -61,12 +61,14 @@ _aot_executables: dict[tuple, Any] = {}
 _precompile_lock = _threading.Lock()
 
 
-def _submit_precompile(job_args: tuple) -> None:
+def _submit_precompile(job_args: tuple) -> bool:
+    """Queue one AOT compile job; returns False when the job was dropped
+    (queue full or pool torn down) so the caller knows to try again later."""
     global _precompile_pool, _precompile_pending
 
     with _precompile_lock:
         if _precompile_pending >= _PRECOMPILE_MAX_QUEUE:
-            return
+            return False
         if _precompile_pool is None:
             import atexit
             from concurrent.futures import ThreadPoolExecutor
@@ -79,9 +81,11 @@ def _submit_precompile(job_args: tuple) -> None:
         pool = _precompile_pool
     try:
         pool.submit(_precompile_job, *job_args)
+        return True
     except RuntimeError:  # pool torn down between check and submit
         with _precompile_lock:
             _precompile_pending -= 1
+        return False
 
 
 def _shutdown_precompile_pool() -> None:
@@ -424,16 +428,20 @@ class GPSampler(BaseSampler):
         key = (id(dev), n_bucket, q, n_starts, fit_iters)
         if not self._precompile_ahead or key in self._precompiled:
             return
-        self._precompiled.add(key)
         exec_key = self._exec_key(dev, d, n_bucket, q, n_starts, fit_iters)
         with _precompile_lock:
             if exec_key in _aot_executables:
+                self._precompiled.add(key)
                 return
         n_local = self._n_local_search if q == 0 else min(self._n_local_search, 6)
         minimum_noise = 1e-7 if self._deterministic else 1e-5
-        _submit_precompile(
+        # Mark the bucket done only when the job actually queued: a drop (full
+        # queue, torn-down pool) leaves the key unmarked so the next ask for
+        # this bucket retries instead of silently never compiling it.
+        if _submit_precompile(
             (exec_key, dev, d, n_bucket, q, n_starts, fit_iters, n_local, minimum_noise)
-        )
+        ):
+            self._precompiled.add(key)
 
     @staticmethod
     def _aot_call(exec_key: tuple, args: tuple):

@@ -11,11 +11,19 @@ from optuna_tpu.storages._callbacks import (
 )
 from optuna_tpu.storages._heartbeat import BaseHeartbeat, fail_stale_trials
 from optuna_tpu.storages._in_memory import InMemoryStorage
+from optuna_tpu.storages._retry import (
+    RetryingStorage,
+    RetryPolicy,
+    TransientStorageError,
+)
 
 __all__ = [
     "BaseStorage",
     "BaseHeartbeat",
     "InMemoryStorage",
+    "RetryPolicy",
+    "RetryingStorage",
+    "TransientStorageError",
     "RDBStorage",
     "JournalStorage",
     "GrpcStorageProxy",

@@ -27,6 +27,17 @@ from optuna_tpu.distributions import distribution_to_json, json_to_distribution
 SERVICE_NAME = "optuna_tpu.StorageProxy"
 WIRE_VERSION = 1
 
+# Reserved kwarg carrying a client-generated idempotency token on
+# replay-unsafe RPCs (trial creates, state/param writes). The server strips
+# it before invoking the storage and replays the recorded response for a
+# repeated token, so a client retrying after a transport failure cannot
+# double-apply the write. Riding in kwargs keeps the wire format (and
+# WIRE_VERSION) unchanged for old clients against this server; the reverse
+# skew (a token-sending client against a pre-token server) would TypeError
+# on the storage call — both halves ship together in this repo, so no such
+# server exists, but a future wire change must bump WIRE_VERSION instead.
+OP_TOKEN_KEY = "__op_token"
+
 
 class WireVersionError(RuntimeError):
     """Peer speaks an unknown wire version."""

@@ -6,33 +6,76 @@ becomes one RPC; server-side exceptions are re-raised locally.
 
 from __future__ import annotations
 
+import uuid
 from typing import Any, Callable, Container, Sequence
 
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
+    OP_TOKEN_KEY,
     SERVICE_NAME,
     decode_response,
     encode_request,
 )
 from optuna_tpu.storages._heartbeat import BaseHeartbeat
+from optuna_tpu.storages._retry import REPLAY_UNSAFE_METHODS, RetryPolicy
 from optuna_tpu.study._frozen import FrozenStudy
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
 from optuna_tpu.trial._state import TrialState
 
 
+# Per-attempt RPC bound used when the policy's overall deadline is disabled
+# (deadline=None): a single attempt against a wedged server must still fail
+# in bounded time so the retry loop can engage.
+_UNBOUNDED_ATTEMPT_TIMEOUT = 120.0
+
+
+def _default_retry_policy() -> RetryPolicy:
+    # UNAVAILABLE during a proxy-server restart resolves in seconds; five
+    # full-jitter attempts cover ~4s of outage without hammering the server.
+    return RetryPolicy(max_attempts=5, initial_backoff=0.1, max_backoff=2.0, deadline=60.0)
+
+
 class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
-    def __init__(self, *, host: str = "localhost", port: int = 13000) -> None:
+    """BaseStorage over a gRPC channel, resilient to transient transport
+    failures: calls that die with UNAVAILABLE / DEADLINE_EXCEEDED are replayed
+    under ``retry_policy`` (reconnecting the channel between attempts), and
+    replay-unsafe writes carry a client-generated op token the server dedupes,
+    so a retried create cannot mint a duplicate trial while the server process
+    lives (the dedupe memory is in-process; a server crash inside the narrow
+    committed-but-unacked window remains a single-trial risk). Pass
+    ``retry_policy=RetryPolicy(max_attempts=1)`` to disable retries."""
+
+    def __init__(
+        self,
+        *,
+        host: str = "localhost",
+        port: int = 13000,
+        retry_policy: RetryPolicy | None = None,
+    ) -> None:
         self._host = host
         self._port = port
         self._channel = None
+        self._retry_policy = retry_policy if retry_policy is not None else _default_retry_policy()
         self._setup()
 
     def _setup(self) -> None:
         import grpc
 
         self._channel = grpc.insecure_channel(f"{self._host}:{self._port}")
+
+    def _reconnect(self) -> None:
+        """Drop the (possibly wedged) channel and dial a fresh one — a
+        restarted server presents a new connection the old channel's HTTP/2
+        session does not always recover on its own."""
+        old, self._channel = self._channel, None
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        self._setup()
 
     def __getstate__(self) -> dict[str, Any]:
         state = self.__dict__.copy()
@@ -44,13 +87,45 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
         self._setup()
 
     def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
-        assert self._channel is not None
-        rpc = self._channel.unary_unary(
-            f"/{SERVICE_NAME}/{method}",
-            request_serializer=None,
-            response_deserializer=None,
+        import grpc
+
+        if method in REPLAY_UNSAFE_METHODS:
+            # One token per *logical* call, minted before the retry loop, so
+            # every replay carries the same token and the server's dedupe
+            # cache collapses them into one execution.
+            kwargs = {**kwargs, OP_TOKEN_KEY: uuid.uuid4().hex}
+        request = encode_request(method, args, kwargs)
+
+        def once() -> bytes:
+            if self._channel is None:
+                self._setup()
+            rpc = self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{method}",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+            # Per-attempt deadline: without it a wedged server (connection
+            # up, storage stalled) would hang this call forever and the
+            # policy's between-attempts deadline would never engage. A
+            # policy with deadline=None disables the *overall* budget, not
+            # the per-attempt bound — that must never be infinite.
+            attempt_timeout = self._retry_policy.deadline or _UNBOUNDED_ATTEMPT_TIMEOUT
+            return rpc(request, timeout=attempt_timeout)
+
+        def transient(err: BaseException) -> bool:
+            return isinstance(err, grpc.RpcError) and err.code() in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+            )
+
+        ok, payload = decode_response(
+            self._retry_policy.call(
+                once,
+                describe=f"gRPC {method} to {self._host}:{self._port}",
+                is_retryable=transient,
+                on_retry=lambda err, attempt, delay: self._reconnect(),
+            )
         )
-        ok, payload = decode_response(rpc(encode_request(method, args, kwargs)))
         if not ok:
             raise payload
         return payload

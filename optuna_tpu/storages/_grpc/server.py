@@ -7,6 +7,8 @@ the real storage, so the backing store sees a single client.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from concurrent import futures
 from typing import TYPE_CHECKING
 
@@ -14,6 +16,7 @@ from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
     METHODS,
+    OP_TOKEN_KEY,
     SERVICE_NAME,
     WireVersionError,
     decode_request,
@@ -24,6 +27,11 @@ if TYPE_CHECKING:
     import grpc
 
 _logger = get_logger(__name__)
+
+# Completed-op replay memory: enough to cover any plausible in-flight retry
+# window (a client retries within seconds; thousands of creates/sec would
+# still keep a token alive for minutes) without unbounded growth.
+_OP_TOKEN_CACHE_SIZE = 8192
 
 
 def _make_handler(storage: BaseStorage):
@@ -36,6 +44,16 @@ def _make_handler(storage: BaseStorage):
         "get_failed_trial_callback": None,
     }
 
+    # token -> encoded successful response. Replaying the recorded bytes (not
+    # re-executing) makes client retries of replay-unsafe writes exactly-
+    # once: the first execution's trial id comes back on every replay.
+    # `token_in_flight` coalesces a retry that arrives while the original is
+    # STILL EXECUTING (connection died mid-call): the latecomer waits for the
+    # owner to finish instead of racing it into a double-apply.
+    token_cache: "OrderedDict[str, bytes]" = OrderedDict()
+    token_in_flight: dict = {}  # token -> threading.Event
+    token_lock = threading.Lock()
+
     def handle(request_bytes: bytes, context) -> bytes:
         try:
             method_name, args, kwargs = decode_request(request_bytes)
@@ -45,14 +63,51 @@ def _make_handler(storage: BaseStorage):
             return encode_response(False, ValueError(f"Malformed request: {e}"))
         if method_name not in METHODS:
             return encode_response(False, ValueError(f"Unknown method {method_name!r}"))
+        op_token = kwargs.pop(OP_TOKEN_KEY, None) if isinstance(kwargs, dict) else None
+        if op_token is not None:
+            while True:
+                with token_lock:
+                    replay = token_cache.get(op_token)
+                    pending = None
+                    if replay is None:
+                        pending = token_in_flight.get(op_token)
+                        if pending is None:
+                            # We own this token's execution.
+                            token_in_flight[op_token] = threading.Event()
+                if replay is not None:
+                    _logger.info(
+                        f"Replaying recorded response for retried {method_name} "
+                        f"(op token {op_token[:8]}...)."
+                    )
+                    return replay
+                if pending is None:
+                    break  # owner: fall through and execute
+                # Original attempt still executing; wait, then re-check the
+                # cache (a failed original is not cached — re-loop claims
+                # ownership and re-executes, matching the error semantics).
+                pending.wait(timeout=120.0)
         if method_name in _HEARTBEAT_DEFAULTS and not hasattr(storage, method_name):
             # Backing storage without heartbeat support: behave as disabled.
             return encode_response(True, _HEARTBEAT_DEFAULTS[method_name])
+        response = error_response = None
         try:
             result = getattr(storage, method_name)(*args, **kwargs)
-            return encode_response(True, result)
+            response = encode_response(True, result)
         except Exception as e:  # noqa: BLE001 — exceptions ride the wire
-            return encode_response(False, e)
+            # Failures are NOT recorded: a retry after an app-level error
+            # should re-execute, not replay the error.
+            error_response = encode_response(False, e)
+        finally:
+            if op_token is not None:
+                with token_lock:
+                    if response is not None:
+                        token_cache[op_token] = response
+                        while len(token_cache) > _OP_TOKEN_CACHE_SIZE:
+                            token_cache.popitem(last=False)
+                    waiter = token_in_flight.pop(op_token, None)
+                if waiter is not None:
+                    waiter.set()
+        return response if response is not None else error_response
 
     class Handler(grpc.GenericRpcHandler):
         def service(self, handler_call_details):
@@ -84,10 +139,38 @@ def run_grpc_proxy_server(
     host: str = "localhost",
     port: int = 13000,
     thread_pool_size: int = 10,
+    drain_grace: float | None = 15.0,
 ) -> None:
-    """Blocking server entry point (reference ``server.py:38``)."""
+    """Blocking server entry point (reference ``server.py:38``).
+
+    SIGTERM/SIGINT trigger a graceful drain: the listener stops accepting new
+    RPCs immediately, in-flight calls get ``drain_grace`` seconds to finish
+    (then are cancelled), and only afterwards does the process return —
+    clients see clean completions or UNAVAILABLE-on-connect, which their
+    retry policy absorbs, never a half-written response.
+    """
+    import signal
+
     server = make_grpc_server(storage, host, port, thread_pool_size)
     server.start()
     _logger.info(f"Server started at {host}:{port}")
     _logger.info("Listening...")
+
+    def _drain(signum: int, frame) -> None:
+        _logger.info(
+            f"Signal {signum}: draining (refusing new RPCs, "
+            f"up to {drain_grace}s for in-flight calls)..."
+        )
+        server.stop(grace=drain_grace)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _drain)
+        except ValueError:
+            pass  # not the main thread; caller owns signal handling
     server.wait_for_termination()
+    try:
+        storage.remove_session()
+    except Exception:
+        pass
+    _logger.info("Server drained; storage session released.")

@@ -99,6 +99,16 @@ def is_heartbeat_enabled(storage: BaseStorage) -> bool:
     return isinstance(storage, BaseHeartbeat) and storage.get_heartbeat_interval() is not None
 
 
+# Registered (not subclassed) so BaseHeartbeat's abstract methods don't block
+# instantiating a wrapper around a heartbeat-less backend, while
+# `isinstance(wrapper, BaseHeartbeat)` — the check `is_heartbeat_enabled` and
+# `fail_stale_trials` rely on — still passes. The wrapper degrades the four
+# methods to "heartbeat disabled" when its backend lacks them.
+from optuna_tpu.storages._base import _ForwardingStorage  # noqa: E402
+
+BaseHeartbeat.register(_ForwardingStorage)
+
+
 def fail_stale_trials(study: "Study") -> None:
     """Mark dead workers' RUNNING trials FAIL, then fire the retry callback
     (reference ``_heartbeat.py:156-203``). Called at each ``_run_trial`` start."""

@@ -88,6 +88,9 @@ class _ParsedUrl:
         self.query = dict(parse_qsl(parts.query))
 
 
+_ADD_COLUMN_RE = re.compile(r"\s*ALTER\s+TABLE\s+\S+\s+ADD\s+(COLUMN\s+)?\S+", re.IGNORECASE)
+
+
 class SqliteDialect:
     """Identity dialect: canonical SQL runs as written."""
 
@@ -127,15 +130,22 @@ class SqliteDialect:
 
     def execute_ddl(self, con: Any, stmt: str) -> None:
         # CREATE statements use IF NOT EXISTS natively, but sqlite has no
-        # ALTER TABLE ... ADD COLUMN IF NOT EXISTS — tolerate already-applied
-        # steps so a migration interrupted after a DDL prefix (or a database
-        # touched by a newer process) completes idempotently on retry, the
-        # same contract the MySQL dialect provides.
+        # ALTER TABLE ... ADD COLUMN IF NOT EXISTS — tolerate an
+        # already-applied ADD COLUMN so a migration interrupted after a DDL
+        # prefix (or a database touched by a newer process) completes
+        # idempotently on retry. ONLY that shape is swallowed: an
+        # 'already exists' from any other statement means a genuinely
+        # conflicting stale schema (e.g. a CREATE without IF NOT EXISTS
+        # colliding with a leftover table) and must surface, not no-op.
         try:
             con.execute(stmt)
         except sqlite3.OperationalError as err:
             msg = str(err).lower()
-            if "duplicate column name" not in msg and "already exists" not in msg:
+            is_add_column = _ADD_COLUMN_RE.match(stmt) is not None
+            if not (
+                is_add_column
+                and ("duplicate column name" in msg or "already exists" in msg)
+            ):
                 raise
 
     def insert_id(self, con: Any, sql: str, args: Sequence[Any], id_col: str) -> int:

@@ -67,6 +67,9 @@ def _steal_stale_lock(lockfile: str, grace_period: float) -> bool:
 
 
 class BaseJournalFileLock(abc.ABC):
+    #: Hard wall on one acquire() call — a wedged lock fails loudly, never hangs.
+    _ACQUIRE_TIMEOUT = 300.0
+
     @abc.abstractmethod
     def acquire(self) -> bool:
         raise NotImplementedError
@@ -74,6 +77,43 @@ class BaseJournalFileLock(abc.ABC):
     @abc.abstractmethod
     def release(self) -> None:
         raise NotImplementedError
+
+    def _acquire_with_takeover(self, try_lock) -> bool:
+        """Shared acquire loop for both lock primitives: try, steal stale
+        locks past the grace period, and back off with full jitter between
+        polls (the :class:`~optuna_tpu.storages._retry.RetryPolicy` schedule —
+        jitter decorrelates a herd of workers hammering one NFS lockfile).
+
+        ``try_lock`` returns True on success, False while the lock is held,
+        and raises on real errors.
+        """
+        from optuna_tpu.storages._retry import RetryPolicy
+
+        schedule = RetryPolicy(initial_backoff=0.002, max_backoff=0.05, multiplier=1.5)
+        attempt = 0
+        start = time.time()
+        while True:
+            if try_lock():
+                self._owns = True
+                return True
+            # The timeout gates EVERY path, including repeated takeover
+            # attempts — a steal that keeps failing (filesystem flipped
+            # read-only under a stale lock) must raise, not spin.
+            if time.time() - start > self._ACQUIRE_TIMEOUT:
+                raise TimeoutError(
+                    f"Could not acquire {self._lockfile} in {self._ACQUIRE_TIMEOUT:.0f}s."
+                )
+            if self._grace_period is not None and self._lock_expired():
+                # Grace-period takeover: a dead worker's stale lock is
+                # broken after grace_period seconds.
+                if _steal_stale_lock(self._lockfile, self._grace_period):
+                    _logger.warning(
+                        f"Lock {self._lockfile} expired (> {self._grace_period}s);"
+                        " taking over."
+                    )
+                    continue  # we freed it — grab it before anyone else
+            attempt += 1
+            time.sleep(schedule.next_delay(attempt))
 
     def __enter__(self) -> None:
         self.acquire()
@@ -93,30 +133,16 @@ class JournalFileSymlinkLock(BaseJournalFileLock):
         self._owns = False
 
     def acquire(self) -> bool:
-        sleep_secs = 0.001
-        start = time.time()
-        while True:
+        def try_lock() -> bool:
             try:
                 os.symlink(self._lock_target_file, self._lockfile)
-                self._owns = True
                 return True
             except OSError as err:
                 if err.errno in (errno.EEXIST, errno.EACCES):
-                    # Grace-period takeover: a dead worker's stale lock is
-                    # broken after grace_period seconds.
-                    if self._grace_period is not None and self._lock_expired():
-                        if _steal_stale_lock(self._lockfile, self._grace_period):
-                            _logger.warning(
-                                f"Lock {self._lockfile} expired (> {self._grace_period}s);"
-                                " taking over."
-                            )
-                        continue
-                    time.sleep(min(sleep_secs, 0.05))
-                    sleep_secs *= 1.5
-                    if time.time() - start > 300:
-                        raise TimeoutError(f"Could not acquire {self._lockfile} in 300s.")
-                    continue
+                    return False
                 raise
+
+        return self._acquire_with_takeover(try_lock)
 
     def _lock_expired(self) -> bool:
         try:
@@ -143,29 +169,17 @@ class JournalFileOpenLock(BaseJournalFileLock):
         self._owns = False
 
     def acquire(self) -> bool:
-        sleep_secs = 0.001
-        start = time.time()
-        while True:
+        def try_lock() -> bool:
             try:
                 fd = os.open(self._lockfile, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                 os.close(fd)
-                self._owns = True
                 return True
             except OSError as err:
                 if err.errno == errno.EEXIST:
-                    if self._grace_period is not None and self._lock_expired():
-                        if _steal_stale_lock(self._lockfile, self._grace_period):
-                            _logger.warning(
-                                f"Lock {self._lockfile} expired (> {self._grace_period}s);"
-                                " taking over."
-                            )
-                        continue
-                    time.sleep(min(sleep_secs, 0.05))
-                    sleep_secs *= 1.5
-                    if time.time() - start > 300:
-                        raise TimeoutError(f"Could not acquire {self._lockfile} in 300s.")
-                    continue
+                    return False
                 raise
+
+        return self._acquire_with_takeover(try_lock)
 
     def _lock_expired(self) -> bool:
         try:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import atexit
 import os
+import re
 import sqlite3
 import tempfile
 import threading
@@ -82,19 +83,39 @@ def _downgrade(sql: str) -> str:
     )
 
 
+# sqlite grew RETURNING in 3.35; older runtimes (several LTS distro pythons)
+# reject it. The storage stack only ever uses `INSERT ... RETURNING <id_col>`
+# to read back an autoincrement id, which lastrowid answers exactly, so the
+# clause is stripped and emulated rather than failing the whole fakepg mode.
+_HAS_NATIVE_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
+_RETURNING_RE = re.compile(r"\s+RETURNING\s+(\w+)\s*$", re.IGNORECASE)
+
+
 class _Cursor:
     def __init__(self, raw: sqlite3.Connection) -> None:
         self._cur = raw.cursor()
+        self._emulated_returning_row: tuple | None = None
 
     def execute(self, sql: str, args: Sequence[Any] = ()) -> "_Cursor":
+        self._emulated_returning_row = None
+        if not _HAS_NATIVE_RETURNING:
+            m = _RETURNING_RE.search(sql)
+            if m is not None:
+                self._cur.execute(_downgrade(sql[: m.start()]), tuple(args))
+                self._emulated_returning_row = (self._cur.lastrowid,)
+                return self
         self._cur.execute(_downgrade(sql), tuple(args))
         return self
 
     def executemany(self, sql: str, seq: Sequence[Sequence[Any]]) -> "_Cursor":
+        self._emulated_returning_row = None
         self._cur.executemany(_downgrade(sql), [tuple(a) for a in seq])
         return self
 
     def fetchone(self):
+        if self._emulated_returning_row is not None:
+            row, self._emulated_returning_row = self._emulated_returning_row, None
+            return row
         return self._cur.fetchone()
 
     def fetchall(self):

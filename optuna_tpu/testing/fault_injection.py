@@ -1,0 +1,186 @@
+"""Fault-injection harness: chaos-test the storage stack end to end.
+
+The per-layer resilience pieces — heartbeat failover, NFS lock stealing,
+sqlite busy-retry, gRPC reconnect — only earn trust when something *injects*
+the faults they claim to absorb. This module provides:
+
+* :class:`FaultPlan` / :class:`FaultInjectorStorage` — a transparent
+  :class:`BaseStorage` proxy that injects transient exceptions, latency
+  spikes, and hard "worker died mid-call" kills, driven by per-method
+  probability and/or an explicit call-index schedule. Faults strike *before*
+  the backing call executes, so a retried call is semantically safe — which
+  is exactly the contract :class:`~optuna_tpu.storages._retry.RetryingStorage`
+  needs to replay them.
+* Filesystem chaos helpers for the journal backend:
+  :func:`tear_journal_tail` (simulate a crash mid-append: torn final record)
+  and :func:`plant_stale_lock` (simulate a SIGKILL'd lock holder).
+
+Typical chaos test::
+
+    plan = FaultPlan(transient_rate=0.1, seed=7)
+    storage = RetryingStorage(
+        FaultInjectorStorage(InMemoryStorage(), plan),
+        RetryPolicy(max_attempts=10, sleep=lambda _: None),
+        retry_non_idempotent=True,  # faults strike before the backend commits
+    )
+    study = optuna_tpu.create_study(storage=storage)
+    study.optimize(objective, n_trials=50)   # must match the fault-free run
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+from optuna_tpu.logging import get_logger
+from optuna_tpu.storages._base import BaseStorage, _ForwardingStorage
+from optuna_tpu.storages._retry import TransientStorageError
+
+_logger = get_logger(__name__)
+
+
+class SimulatedWorkerDeath(BaseException):
+    """Raised by a scheduled kill: the 'process got SIGKILL'd mid-call' stand-in.
+
+    Deliberately a ``BaseException`` (like ``SystemExit``): the optimize
+    loop's objective-error handling catches ``Exception`` and would convert a
+    mere ``Exception`` into a clean FAIL tell — but a dead worker never gets
+    to tell, so the kill must punch through every handler and leave the trial
+    RUNNING for heartbeat failover to find.
+    """
+
+
+@dataclass
+class FaultPlan:
+    """Declarative description of what to inject, and when.
+
+    ``transient_rate``/``latency_rate`` are per-call probabilities (seeded —
+    a plan replays identically); ``schedule`` and ``kill_schedule`` map a
+    method name to the 0-based call indices (counted per method) that MUST
+    fault, for deterministic scenarios. ``methods`` limits probabilistic
+    faults to a subset (scheduled faults always apply); ``max_faults`` caps
+    the total injected so a finite retry budget always wins eventually.
+    """
+
+    transient_rate: float = 0.0
+    latency_rate: float = 0.0
+    latency_s: float = 0.01
+    methods: frozenset[str] | None = None
+    schedule: Mapping[str, Sequence[int]] = field(default_factory=dict)
+    kill_schedule: Mapping[str, Sequence[int]] = field(default_factory=dict)
+    max_faults: int | None = None
+    seed: int = 0
+    exception_factory: Callable[[str], Exception] = field(
+        default=lambda method: TransientStorageError(
+            f"injected transient fault in {method}"
+        )
+    )
+
+
+class FaultInjectorStorage(_ForwardingStorage):
+    """Wrap any storage and inject faults per a :class:`FaultPlan`.
+
+    Thread-safe; per-method call counts and the injected-fault total are
+    exposed as ``calls`` / ``faults_injected`` for assertions. All faults are
+    raised *before* delegating, so the backing storage never observes a
+    half-applied call and retries cannot double-apply.
+    """
+
+    def __init__(self, backend: BaseStorage, plan: FaultPlan | None = None) -> None:
+        super().__init__(backend)
+        self.plan = plan if plan is not None else FaultPlan()
+        self.calls: dict[str, int] = {}
+        self.faults_injected = 0
+        self.kills_injected = 0
+        self._rng = random.Random(self.plan.seed)
+        self._mutex = threading.Lock()
+
+    def _forward(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        delay = self._maybe_fault(method)
+        if delay is not None:
+            time.sleep(delay)
+        return super()._forward(method, *args, **kwargs)
+
+    def _maybe_fault(self, method: str) -> float | None:
+        """Raise per the plan, or return a latency to sleep (outside the lock)."""
+        plan = self.plan
+        with self._mutex:
+            index = self.calls.get(method, 0)
+            self.calls[method] = index + 1
+            if index in tuple(plan.kill_schedule.get(method, ())):
+                self.kills_injected += 1
+                raise SimulatedWorkerDeath(
+                    f"scheduled worker death at {method} call #{index}"
+                )
+            if index in tuple(plan.schedule.get(method, ())):
+                self.faults_injected += 1
+                raise plan.exception_factory(method)
+            if plan.methods is not None and method not in plan.methods:
+                return None
+            budget_open = plan.max_faults is None or self.faults_injected < plan.max_faults
+            if (
+                budget_open
+                and plan.transient_rate > 0.0
+                and self._rng.random() < plan.transient_rate
+            ):
+                self.faults_injected += 1
+                raise plan.exception_factory(method)
+            if plan.latency_rate > 0.0 and self._rng.random() < plan.latency_rate:
+                return plan.latency_s
+        return None
+
+
+# ---------------------------------------------------------- filesystem chaos
+
+
+def tear_journal_tail(file_path: str, keep_bytes: int = 7) -> int:
+    """Truncate the journal's final record mid-line — a crash during append.
+
+    Keeps ``keep_bytes`` bytes of the last record (no trailing newline), the
+    on-disk state a power cut between ``write`` and ``fsync`` leaves behind.
+    Returns the number of bytes removed. No-op (returns 0) on an empty file.
+    """
+    with open(file_path, "rb+") as f:
+        data = f.read()
+        if not data:
+            return 0
+        body = data.rstrip(b"\n")
+        last_nl = body.rfind(b"\n")
+        record_start = last_nl + 1  # 0 when the file holds a single record
+        keep = min(record_start + keep_bytes, len(body) - 1 if len(body) else 0)
+        f.truncate(keep)
+        removed = len(data) - keep
+    _logger.info(f"tore {removed} bytes off the journal tail of {file_path}")
+    return removed
+
+
+def plant_stale_lock(
+    file_path: str, age_s: float = 3600.0, *, flavor: str = "symlink"
+) -> str:
+    """Create the lockfile a SIGKILL'd worker would leave: already held, with
+    an mtime ``age_s`` seconds in the past so grace-period takeover applies.
+
+    ``flavor`` matches the two lock primitives in
+    :mod:`optuna_tpu.storages.journal._file`: ``"symlink"``
+    (JournalFileSymlinkLock) or ``"open"`` (JournalFileOpenLock).
+    Returns the lockfile path.
+    """
+    from optuna_tpu.storages.journal._file import LOCK_FILE_SUFFIX
+
+    lockfile = file_path + LOCK_FILE_SUFFIX
+    if flavor == "symlink":
+        os.symlink(file_path, lockfile)
+        stamp = time.time() - age_s
+        os.utime(lockfile, (stamp, stamp), follow_symlinks=False)
+    elif flavor == "open":
+        fd = os.open(lockfile, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+        stamp = time.time() - age_s
+        os.utime(lockfile, (stamp, stamp))
+    else:
+        raise ValueError(f"Unknown lock flavor {flavor!r} (want 'symlink' or 'open').")
+    return lockfile

@@ -1,0 +1,107 @@
+"""The bench driver must be un-timeout-able.
+
+Round 5's `BENCH_r05.json: rc=124, parsed=null` postmortem: the driver hung
+inside a device dispatch, `timeout` escalated SIGTERM -> SIGKILL, and the
+round published no number at all. These tests drive `bench.py` exactly the
+way the harness does (SIGTERM while the main thread is wedged) and assert the
+watchdog emits one well-formed partial JSON line before dying —
+``parsed=null`` is structurally impossible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+def _run_and_sigterm(env_extra: dict, term_after: float = 2.0) -> str:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    proc = subprocess.Popen(
+        [sys.executable, _BENCH, "--config", "gp", "--quick"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    time.sleep(term_after)
+    proc.send_signal(signal.SIGTERM)  # what `timeout -k 10 30` sends first
+    try:
+        out, _ = proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError("bench did not exit after SIGTERM — still timeout-able")
+    return out.decode()
+
+
+def _assert_single_partial_line(out: str) -> dict:
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one JSON line, got: {lines!r}"
+    payload = json.loads(lines[0])
+    assert payload["partial"] is True
+    assert "partial_reason" in payload and "phase" in payload
+    return payload
+
+
+def test_sigterm_during_simulated_hang_yields_partial_json() -> None:
+    """The r5 failure mode, reproduced: main thread wedged (never reaches a
+    bytecode boundary, so an ordinary signal handler could not run)."""
+    out = _run_and_sigterm({"OPTUNA_TPU_BENCH_TEST_HANG": "1"})
+    payload = _assert_single_partial_line(out)
+    assert "SIGTERM" in payload["partial_reason"]
+
+
+def test_sigterm_during_real_startup_yields_partial_json() -> None:
+    """SIGTERM landing during real work (probe/import phase) also emits."""
+    out = _run_and_sigterm({}, term_after=3.0)
+    _assert_single_partial_line(out)
+
+
+def test_uncaught_exception_still_emits_partial_json() -> None:
+    """A plain crash (device OOM, XLA error) must leave one parseable line
+    too, not just signal/hang paths."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "OPTUNA_TPU_BENCH_TEST_CRASH": "1",
+    }
+    proc = subprocess.Popen(
+        [sys.executable, _BENCH, "--config", "gp", "--quick"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    out, _ = proc.communicate(timeout=30)
+    payload = _assert_single_partial_line(out.decode())
+    assert "exception" in payload["partial_reason"]
+    assert proc.returncode != 0  # the crash still fails the run loudly
+
+
+def test_phase_deadline_emits_partial_without_any_signal() -> None:
+    """A silently hung phase trips the per-phase deadline on its own."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "OPTUNA_TPU_BENCH_TEST_HANG": "1",
+        "OPTUNA_TPU_BENCH_PHASE_DEADLINE_S": "2",
+    }
+    proc = subprocess.Popen(
+        [sys.executable, _BENCH, "--config", "gp", "--quick"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    try:
+        out, _ = proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise AssertionError("phase deadline never fired")
+    payload = _assert_single_partial_line(out.decode())
+    assert "deadline" in payload["partial_reason"]
+    assert proc.returncode == 124

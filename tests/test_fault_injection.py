@@ -1,0 +1,410 @@
+"""Chaos tests: the resilience subsystem proven end to end.
+
+The per-layer pieces (heartbeat failover, retry callback, journal torn-tail
+healing, lock takeover, retry policy) each have unit coverage; these tests
+inject actual faults and assert the *composition* holds:
+
+* an optimize loop over a fault-injecting storage converges identically to
+  the fault-free run (retries are exactly-once);
+* a killed worker's RUNNING trial is failed by heartbeat and re-enqueued by
+  ``RetryFailedTrialCallback`` — both for an in-process simulated kill and a
+  real SIGKILL'd OS process;
+* a journal with a torn final record replays cleanly and heals on append;
+* a stale lockfile (dead holder) is taken over within the grace period.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu.samplers import TPESampler
+from optuna_tpu.storages import (
+    InMemoryStorage,
+    RetryFailedTrialCallback,
+    RetryingStorage,
+    RetryPolicy,
+    TransientStorageError,
+)
+from optuna_tpu.storages._rdb.storage import RDBStorage
+from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+from optuna_tpu.storages.journal._file import (
+    JournalFileOpenLock,
+    JournalFileSymlinkLock,
+)
+from optuna_tpu.testing.fault_injection import (
+    FaultInjectorStorage,
+    FaultPlan,
+    SimulatedWorkerDeath,
+    plant_stale_lock,
+    tear_journal_tail,
+)
+from optuna_tpu.trial._state import TrialState
+
+
+def _objective(trial) -> float:
+    x = trial.suggest_float("x", -5.0, 5.0)
+    y = trial.suggest_int("y", 0, 4)
+    trial.report(x * x, 0)
+    return (x - 1.0) ** 2 + 0.1 * y
+
+
+def _fast_retry(**kw) -> RetryPolicy:
+    kw.setdefault("max_attempts", 12)
+    kw.setdefault("sleep", lambda _s: None)
+    return RetryPolicy(**kw)
+
+
+# ----------------------------------------------------------- injector basics
+
+
+def test_scheduled_fault_hits_exact_call_index() -> None:
+    inner = InMemoryStorage()
+    storage = FaultInjectorStorage(
+        inner, FaultPlan(schedule={"create_new_study": [1]})
+    )
+    storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])  # call 0: clean
+    with pytest.raises(TransientStorageError):
+        storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    # Call 2 is clean again, and the failed call never reached the backend.
+    storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    assert len(inner.get_all_studies()) == 2
+    assert storage.faults_injected == 1
+
+
+def test_probabilistic_faults_are_seeded_and_bounded() -> None:
+    plan = FaultPlan(transient_rate=0.5, seed=11, max_faults=3)
+    storage = FaultInjectorStorage(InMemoryStorage(), plan)
+    outcomes = []
+    for _ in range(40):
+        try:
+            storage.get_all_studies()
+            outcomes.append(True)
+        except TransientStorageError:
+            outcomes.append(False)
+    assert storage.faults_injected == 3  # max_faults caps the chaos
+    # Same plan, fresh wrapper: identical fault positions (seeded).
+    storage2 = FaultInjectorStorage(InMemoryStorage(), FaultPlan(**{**plan.__dict__}))
+    outcomes2 = []
+    for _ in range(40):
+        try:
+            storage2.get_all_studies()
+            outcomes2.append(True)
+        except TransientStorageError:
+            outcomes2.append(False)
+    assert outcomes == outcomes2
+
+
+def test_retrying_storage_refuses_non_idempotent_by_default() -> None:
+    faulty = FaultInjectorStorage(
+        InMemoryStorage(), FaultPlan(schedule={"create_new_trial": [0]})
+    )
+    storage = RetryingStorage(faulty, _fast_retry())
+    sid = storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    with pytest.raises(TransientStorageError):
+        storage.create_new_trial(sid)  # not replayed: could double-create
+    # Opting in (faults strike before the backend commits) retries it.
+    retrying = RetryingStorage(faulty, _fast_retry(), retry_non_idempotent=True)
+    tid = retrying.create_new_trial(sid)
+    assert retrying.get_trial(tid).state == TrialState.RUNNING
+
+
+def test_retry_policy_bounded_attempts_and_full_jitter() -> None:
+    import random
+
+    sleeps: list[float] = []
+    now = [0.0]
+    policy = RetryPolicy(
+        max_attempts=4,
+        initial_backoff=0.1,
+        max_backoff=0.4,
+        multiplier=2.0,
+        deadline=100.0,
+        sleep=sleeps.append,
+        clock=lambda: now[0],
+        rng=random.Random(0),
+    )
+    calls = [0]
+
+    def always_fails() -> None:
+        calls[0] += 1
+        raise TransientStorageError("down")
+
+    with pytest.raises(TransientStorageError):
+        policy.call(always_fails)
+    assert calls[0] == 4  # bounded: no retry storm
+    assert len(sleeps) == 3
+    for k, delay in enumerate(sleeps, start=1):
+        assert 0.0 <= delay <= min(0.4, 0.1 * 2 ** (k - 1))  # full-jitter window
+    assert any(d > 0 for d in sleeps)  # jitter actually drawn, not zeros
+
+
+def test_retry_policy_deadline_beats_attempt_budget() -> None:
+    now = [0.0]
+
+    def sleep(s: float) -> None:
+        now[0] += s
+
+    policy = RetryPolicy(
+        max_attempts=100,
+        initial_backoff=10.0,
+        max_backoff=10.0,
+        deadline=25.0,
+        sleep=sleep,
+        clock=lambda: now[0],
+    )
+    calls = [0]
+
+    def always_fails() -> None:
+        calls[0] += 1
+        now[0] += 1.0  # each attempt costs wall time
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        policy.call(always_fails)
+    assert calls[0] < 100  # the deadline cut the budget short
+    assert now[0] <= 40.0
+
+
+def test_retry_policy_backoff_cap_never_overflows() -> None:
+    # The journal lock polls through this schedule with an unbounded attempt
+    # counter; multiplier**attempt must clamp, not raise OverflowError.
+    policy = RetryPolicy(initial_backoff=0.002, max_backoff=0.05, multiplier=1.5)
+    assert policy.backoff_cap(5000) == 0.05
+    assert 0.0 <= policy.next_delay(5000) <= 0.05
+    huge = RetryPolicy(initial_backoff=1.0, max_backoff=2.0, multiplier=1e6)
+    assert huge.backoff_cap(10_000) == 2.0
+
+
+def test_retry_policy_accepts_a_bare_exception_class() -> None:
+    policy = RetryPolicy(retryable=ConnectionError)
+    assert policy.is_retryable(ConnectionError("down"))
+    assert not policy.is_retryable(ValueError("not transient"))
+
+
+def test_retry_policy_passes_through_non_retryable() -> None:
+    policy = _fast_retry()
+    calls = [0]
+
+    def raises_key_error() -> None:
+        calls[0] += 1
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        policy.call(raises_key_error)
+    assert calls[0] == 1
+
+
+# ------------------------------------------------------- chaos: optimize loop
+
+
+def test_chaos_study_converges_identically_to_fault_free() -> None:
+    """≥5% transient faults on every storage method; best value must match
+    the fault-free run exactly (every logical op executes exactly once)."""
+
+    def run(storage) -> list[float]:
+        study = optuna_tpu.create_study(
+            storage=storage, sampler=TPESampler(seed=7, n_startup_trials=8)
+        )
+        study.optimize(_objective, n_trials=50)
+        return [t.value for t in study.trials]
+
+    clean_values = run(InMemoryStorage())
+
+    injector = FaultInjectorStorage(
+        InMemoryStorage(), FaultPlan(transient_rate=0.08, latency_rate=0.02, seed=3)
+    )
+    chaotic = RetryingStorage(
+        injector, _fast_retry(max_attempts=20), retry_non_idempotent=True
+    )
+    chaos_values = run(chaotic)
+
+    assert injector.faults_injected > 0, "the plan injected nothing — test is vacuous"
+    assert chaos_values == clean_values
+
+
+def test_simulated_worker_death_leaves_trial_running_then_heartbeat_retries(
+    tmp_path,
+) -> None:
+    """In-process kill: the worker dies mid-trial (storage call never
+    returns), the trial stays RUNNING, and the next worker's
+    ``fail_stale_trials`` fails it and re-enqueues a retry clone."""
+    url = f"sqlite:///{tmp_path}/chaos.db"
+    storage = RDBStorage(
+        url,
+        heartbeat_interval=60,
+        grace_period=120,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=2),
+    )
+    injector = FaultInjectorStorage(
+        storage, FaultPlan(kill_schedule={"set_trial_intermediate_value": [0]})
+    )
+    study = optuna_tpu.create_study(storage=injector, sampler=TPESampler(seed=0))
+    with pytest.raises(SimulatedWorkerDeath):
+        study.optimize(_objective, n_trials=5)  # first report() kills the worker
+    [running] = [t for t in study.trials if t.state == TrialState.RUNNING]
+
+    # The dead worker's last heartbeat recedes past the grace period.
+    con = storage._conn()
+    con.execute("UPDATE trial_heartbeats SET heartbeat = heartbeat - 1000")
+    con.commit()
+
+    from optuna_tpu.storages._heartbeat import fail_stale_trials
+
+    survivor = optuna_tpu.load_study(study_name=study.study_name, storage=storage)
+    fail_stale_trials(survivor)
+
+    trials = survivor.trials
+    assert trials[running.number].state == TrialState.FAIL
+    retries = [
+        t
+        for t in trials
+        if t.system_attrs.get("failed_trial") == running.number
+    ]
+    assert len(retries) == 1
+    assert retries[0].state == TrialState.WAITING
+    # The clone re-runs the same parameters.
+    assert retries[0].system_attrs["fixed_params"] == running.params
+
+
+_KILLED_WORKER = """
+import sys, time
+import optuna_tpu
+from optuna_tpu.storages._rdb.storage import RDBStorage
+
+url, ready_path = sys.argv[1], sys.argv[2]
+storage = RDBStorage(url, heartbeat_interval=1, grace_period=2)
+study = optuna_tpu.load_study(study_name="chaos-kill", storage=storage)
+
+def objective(trial):
+    trial.suggest_float("x", 0, 1)
+    open(ready_path, "w").write(str(trial.number))
+    time.sleep(120)  # SIGKILL arrives here, mid-trial
+    return 0.0
+
+study.optimize(objective, n_trials=1)
+"""
+
+
+@pytest.mark.slow
+def test_sigkilled_worker_failed_over_within_one_grace_period(tmp_path) -> None:
+    """A real OS worker is SIGKILL'd mid-trial; heartbeat failover fails its
+    RUNNING trial and the retry callback re-enqueues it within one grace
+    period of the kill."""
+    url = f"sqlite:///{tmp_path}/kill.db"
+    ready = str(tmp_path / "ready")
+    supervisor = RDBStorage(
+        url,
+        heartbeat_interval=1,
+        grace_period=2,
+        failed_trial_callback=RetryFailedTrialCallback(),
+    )
+    optuna_tpu.create_study(study_name="chaos-kill", storage=supervisor)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILLED_WORKER, url, ready],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        deadline = time.time() + 60
+        while not os.path.exists(ready):
+            assert proc.poll() is None, "worker died before starting its trial"
+            assert time.time() < deadline, "worker never started its trial"
+            time.sleep(0.05)
+        proc.kill()  # SIGKILL: no cleanup, no tell — the heartbeat just stops
+        proc.wait()
+
+        study = optuna_tpu.load_study(study_name="chaos-kill", storage=supervisor)
+        from optuna_tpu.storages._heartbeat import fail_stale_trials
+
+        killed_number = int(open(ready).read())
+        deadline = time.time() + 10  # one grace period (2s) + polling slack
+        while time.time() < deadline:
+            fail_stale_trials(study)
+            if study.trials[killed_number].state == TrialState.FAIL:
+                break
+            time.sleep(0.25)
+        trials = study.trials
+        assert trials[killed_number].state == TrialState.FAIL
+        retries = [
+            t for t in trials if t.system_attrs.get("failed_trial") == killed_number
+        ]
+        assert len(retries) == 1 and retries[0].state == TrialState.WAITING
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+# --------------------------------------------------------- filesystem chaos
+
+
+def test_torn_journal_tail_replays_cleanly_and_heals(tmp_path) -> None:
+    path = str(tmp_path / "study.journal")
+    storage = JournalStorage(JournalFileBackend(path))
+    study = optuna_tpu.create_study(storage=storage)
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=5)
+    n_complete = len(study.trials)
+
+    removed = tear_journal_tail(path)
+    assert removed > 0
+
+    # A fresh reader replays without error; only the torn record is lost.
+    reread = JournalStorage(JournalFileBackend(path))
+    survivor = optuna_tpu.load_study(study_name=study.study_name, storage=reread)
+    trials = survivor.trials
+    assert len(trials) == n_complete
+    assert sum(t.state == TrialState.COMPLETE for t in trials) == n_complete - 1
+
+    # Appending through the torn tail heals the file: the writer re-terminates
+    # the partial record and new ops land intact.
+    survivor.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=2)
+    rereread = JournalStorage(JournalFileBackend(path))
+    final = optuna_tpu.load_study(study_name=study.study_name, storage=rereread)
+    assert len(final.trials) == n_complete + 2
+
+
+@pytest.mark.parametrize("flavor,lock_cls", [
+    ("symlink", JournalFileSymlinkLock),
+    ("open", JournalFileOpenLock),
+])
+def test_stale_lock_taken_over_within_grace(tmp_path, flavor, lock_cls) -> None:
+    path = str(tmp_path / "locked.journal")
+    open(path, "w").close()
+    plant_stale_lock(path, age_s=3600.0, flavor=flavor)
+    lock = lock_cls(path, grace_period=5.0)
+    t0 = time.monotonic()
+    assert lock.acquire()
+    assert time.monotonic() - t0 < 5.0  # stole the stale lock, didn't wait it out
+    lock.release()
+
+
+def test_fresh_lock_is_not_stolen(tmp_path) -> None:
+    path = str(tmp_path / "held.journal")
+    open(path, "w").close()
+    plant_stale_lock(path, age_s=0.0)  # a LIVE holder's lock
+    lock = JournalFileSymlinkLock(path, grace_period=30.0)
+    lock._ACQUIRE_TIMEOUT = 0.5  # don't wait the full five minutes in a test
+    with pytest.raises(TimeoutError):
+        lock.acquire()
+
+
+def test_stale_lock_does_not_wedge_a_real_study(tmp_path) -> None:
+    """End to end: a dead worker's lockfile must not block a new study."""
+    path = str(tmp_path / "wedged.journal")
+    open(path, "w").close()
+    plant_stale_lock(path, age_s=3600.0)
+    lock = JournalFileSymlinkLock(path, grace_period=2.0)
+    storage = JournalStorage(JournalFileBackend(path, lock_obj=lock))
+    study = optuna_tpu.create_study(storage=storage)
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=3)
+    assert len(study.trials) == 3

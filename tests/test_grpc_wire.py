@@ -164,6 +164,229 @@ def test_no_pickle_in_grpc_package():
         assert "pickle.loads" not in src and "pickle.dumps" not in src, f.name
 
 
+def test_op_token_replay_returns_recorded_response_not_a_second_trial():
+    """A client retrying a create after a transport failure re-sends the same
+    op token; the server must replay the recorded response instead of minting
+    a duplicate trial."""
+    import types
+
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc._service import OP_TOKEN_KEY
+    from optuna_tpu.storages._grpc.server import _make_handler
+    from optuna_tpu.study._study_direction import StudyDirection
+
+    storage = InMemoryStorage()
+    sid = storage.create_new_study([StudyDirection.MINIMIZE])
+    handler = _make_handler(storage)
+    details = types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/create_new_trial")
+    method_handler = handler.service(details)
+
+    request = wire.encode_request(
+        "create_new_trial", (sid, None), {OP_TOKEN_KEY: "tok-abc123"}
+    )
+    ok1, tid1 = wire.decode_response(method_handler.unary_unary(request, None))
+    ok2, tid2 = wire.decode_response(method_handler.unary_unary(request, None))
+    assert ok1 and ok2
+    assert tid1 == tid2
+    assert len(storage.get_all_trials(sid)) == 1  # executed exactly once
+
+    # A different token is a different logical call.
+    request3 = wire.encode_request(
+        "create_new_trial", (sid, None), {OP_TOKEN_KEY: "tok-other"}
+    )
+    ok3, tid3 = wire.decode_response(method_handler.unary_unary(request3, None))
+    assert ok3 and tid3 != tid1
+    assert len(storage.get_all_trials(sid)) == 2
+
+
+def test_op_token_replay_preserves_claim_cas_verdict():
+    """A committed-but-unacked WAITING->RUNNING claim must replay as the
+    recorded True, not re-run the CAS and tell its own winner it lost."""
+    import types
+
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc._service import OP_TOKEN_KEY
+    from optuna_tpu.storages._grpc.server import _make_handler
+    from optuna_tpu.storages._retry import REPLAY_UNSAFE_METHODS
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.trial._frozen import FrozenTrial
+
+    assert "set_trial_state_values" in REPLAY_UNSAFE_METHODS
+    storage = InMemoryStorage()
+    sid = storage.create_new_study([StudyDirection.MINIMIZE])
+    template = FrozenTrial(
+        number=-1, state=TrialState.WAITING, value=None, datetime_start=None,
+        datetime_complete=None, params={}, distributions={}, user_attrs={},
+        system_attrs={}, intermediate_values={}, trial_id=-1,
+    )
+    tid = storage.create_new_trial(sid, template_trial=template)
+    handler = _make_handler(storage)
+    details = types.SimpleNamespace(
+        method=f"/{wire.SERVICE_NAME}/set_trial_state_values"
+    )
+    method_handler = handler.service(details)
+    request = wire.encode_request(
+        "set_trial_state_values",
+        (tid, TrialState.RUNNING),
+        {OP_TOKEN_KEY: "claim-1"},
+    )
+    ok1, won1 = wire.decode_response(method_handler.unary_unary(request, None))
+    ok2, won2 = wire.decode_response(method_handler.unary_unary(request, None))
+    assert ok1 and ok2
+    assert won1 is True and won2 is True  # the replay does NOT re-run the CAS
+
+
+def test_op_token_retry_racing_inflight_original_coalesces():
+    """A retry arriving while the ORIGINAL execution is still running (the
+    connection died mid-call) must wait for it and replay its response, not
+    race it into a second create."""
+    import threading
+    import time
+    import types
+
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc._service import OP_TOKEN_KEY
+    from optuna_tpu.storages._grpc.server import _make_handler
+    from optuna_tpu.study._study_direction import StudyDirection
+
+    class SlowCreateStorage(InMemoryStorage):
+        def create_new_trial(self, study_id, template_trial=None):
+            time.sleep(0.3)  # wide window for the retry to land mid-call
+            return super().create_new_trial(study_id, template_trial)
+
+    storage = SlowCreateStorage()
+    sid = storage.create_new_study([StudyDirection.MINIMIZE])
+    handler = _make_handler(storage)
+    details = types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/create_new_trial")
+    method_handler = handler.service(details)
+    request = wire.encode_request(
+        "create_new_trial", (sid, None), {OP_TOKEN_KEY: "tok-race"}
+    )
+
+    results = []
+
+    def call():
+        results.append(wire.decode_response(method_handler.unary_unary(request, None)))
+
+    t1 = threading.Thread(target=call)
+    t2 = threading.Thread(target=call)
+    t1.start()
+    time.sleep(0.05)  # the "retry" arrives while the original executes
+    t2.start()
+    t1.join()
+    t2.join()
+    assert all(ok for ok, _ in results)
+    assert results[0][1] == results[1][1]  # same trial id from both
+    assert len(storage.get_all_trials(sid)) == 1  # executed exactly once
+
+
+def test_op_token_failure_is_not_cached():
+    import types
+
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc._service import OP_TOKEN_KEY
+    from optuna_tpu.storages._grpc.server import _make_handler
+
+    handler = _make_handler(InMemoryStorage())
+    details = types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/create_new_trial")
+    method_handler = handler.service(details)
+    # Unknown study id -> KeyError rides the wire; the token must NOT pin it.
+    request = wire.encode_request(
+        "create_new_trial", (424242, None), {OP_TOKEN_KEY: "tok-failing"}
+    )
+    ok1, err1 = wire.decode_response(method_handler.unary_unary(request, None))
+    ok2, err2 = wire.decode_response(method_handler.unary_unary(request, None))
+    assert not ok1 and not ok2
+    assert isinstance(err1, KeyError) and isinstance(err2, KeyError)
+
+
+def test_proxy_retry_is_bounded_and_jittered_no_retry_storm():
+    """Against a dead endpoint the proxy makes exactly max_attempts dials,
+    with full-jitter exponential delays — asserted via injected clock/sleep,
+    so no real time passes and a storm is structurally impossible."""
+    import random
+
+    import grpc  # noqa: F401  (skip if runtime missing)
+
+    from optuna_tpu.storages import RetryPolicy
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.testing.storages import _find_free_port
+
+    sleeps: list[float] = []
+    attempts = []
+    policy = RetryPolicy(
+        max_attempts=3,
+        initial_backoff=0.1,
+        max_backoff=1.0,
+        multiplier=2.0,
+        deadline=60.0,
+        sleep=sleeps.append,
+        clock=lambda: 0.0,
+        rng=random.Random(1),
+    )
+    orig_call = policy.call
+
+    def counting_call(fn, **kw):
+        on_retry = kw.get("on_retry")
+
+        def wrapped_on_retry(err, attempt, delay):
+            attempts.append(attempt)
+            if on_retry is not None:
+                on_retry(err, attempt, delay)
+
+        kw["on_retry"] = wrapped_on_retry
+        return orig_call(fn, **kw)
+
+    policy.call = counting_call
+    proxy = GrpcStorageProxy(port=_find_free_port(), retry_policy=policy)
+    with pytest.raises(grpc.RpcError):
+        proxy.get_all_studies()
+    proxy.remove_session()
+    assert attempts == [1, 2]  # exactly max_attempts - 1 retries
+    assert len(sleeps) == 2
+    assert 0.0 <= sleeps[0] <= 0.1 and 0.0 <= sleeps[1] <= 0.2  # jitter windows
+
+
+def test_proxy_survives_mid_study_server_restart(tmp_path):
+    """The acceptance scenario: the proxy server dies and comes back between
+    trials; the study finishes without the client ever seeing an error."""
+    import grpc  # noqa: F401
+
+    import optuna_tpu
+    from optuna_tpu.storages import RetryPolicy
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+    from optuna_tpu.testing.storages import _find_free_port
+
+    db = f"sqlite:///{tmp_path}/restart.db"
+    port = _find_free_port()
+    server = make_grpc_server(RDBStorage(db), "localhost", port)
+    server.start()
+    proxy = GrpcStorageProxy(
+        port=port,
+        retry_policy=RetryPolicy(
+            max_attempts=20, initial_backoff=0.05, max_backoff=0.25, deadline=30.0
+        ),
+    )
+    try:
+        study = optuna_tpu.create_study(storage=proxy, study_name="restart")
+        study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=3)
+
+        server.stop(grace=None)  # hard restart: in-flight channel goes stale
+        server = make_grpc_server(RDBStorage(db), "localhost", port)
+        server.start()
+
+        study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=3)
+        trials = study.trials
+        assert len(trials) == 6
+        assert [t.number for t in trials] == list(range(6))  # no dupes, no gaps
+        assert all(t.state.is_finished() for t in trials)
+    finally:
+        proxy.remove_session()
+        server.stop(grace=None)
+
+
 def test_server_rejects_versioned_garbage_without_crashing():
     from optuna_tpu.storages import InMemoryStorage
     from optuna_tpu.storages._grpc.server import _make_handler

@@ -245,8 +245,15 @@ def test_real_two_process_allgather_exchange(tmp_path):
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=180)
+    results = [p.communicate(timeout=180) for p in procs]
+    if any(
+        "Multiprocess computations aren't implemented" in err
+        for _out, err in results
+    ):
+        # jax < 0.5's CPU backend has no cross-process collectives; the real
+        # allgather smoke needs a runtime that does (or real TPU hardware).
+        pytest.skip("this jax runtime lacks multiprocess CPU collectives")
+    for p, (out, err) in zip(procs, results):
         assert p.returncode == 0, err[-2000:]
         outs.append(next(l for l in out.splitlines() if l.startswith("MERGED ")))
     merged0 = json.loads(outs[0][len("MERGED "):])

@@ -152,6 +152,8 @@ def test_polynomial_mutation_parity_with_reference():
     from tests._reference import load_reference
 
     ref_optuna = load_reference()
+    if ref_optuna is None:
+        pytest.skip("reference Optuna not mounted at /root/reference")
     from optuna_tpu.samplers.nsgaii import PolynomialMutation
 
     ref_cls = ref_optuna.samplers.nsgaii.PolynomialMutation

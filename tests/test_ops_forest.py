@@ -35,6 +35,28 @@ def problem():
     return X, y
 
 
+def test_depth_clamp_warns_only_when_lossy(problem, caplog):
+    """A caller-requested max_depth above the device cap must be announced
+    (sklearn's 64 means 'unbounded'); the data-driven cap stays silent."""
+    import logging
+
+    import optuna_tpu
+
+    X, y = problem  # n=300 -> data cap ~ depth 11 > device cap 10
+    optuna_tpu.logging.enable_propagation()  # let caplog's root handler see it
+    try:
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu.ops.forest"):
+            fit_forest(X, y, n_trees=2, max_depth=64, seed=0)
+        assert any("clamped" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu.ops.forest"):
+            fit_forest(X, y, n_trees=2, max_depth=8, seed=0)  # within the cap
+            fit_forest(X[:32], y[:32], n_trees=2, max_depth=64, seed=0)  # data-capped
+        assert not any("clamped" in r.message for r in caplog.records)
+    finally:
+        optuna_tpu.logging.disable_propagation()
+
+
 def test_structure_invariants(problem):
     X, y = problem
     trees = fit_forest(X, y, n_trees=8, seed=1)

@@ -125,6 +125,27 @@ class TestTranslation:
         with pytest.raises(ValueError, match="Unrecognized RDB URL scheme"):
             make_dialect("oracle://u:p@h/db")
 
+    def test_sqlite_ddl_swallow_limited_to_add_column(self, tmp_path):
+        """Only an already-applied ``ALTER TABLE ... ADD COLUMN`` is
+        tolerated; an 'already exists' from any other DDL shape means a
+        genuinely conflicting stale schema and must surface."""
+        import sqlite3
+
+        d = make_dialect(f"sqlite:///{tmp_path}/ddl.db")
+        con = d.connect()
+        con.execute("CREATE TABLE t (a INTEGER)")
+        # Idempotent migration replay: second ADD COLUMN of the same name no-ops.
+        d.execute_ddl(con, "ALTER TABLE t ADD COLUMN b TEXT")
+        d.execute_ddl(con, "ALTER TABLE t ADD COLUMN b TEXT")
+        assert [r[1] for r in con.execute("PRAGMA table_info(t)")] == ["a", "b"]
+        # A conflicting CREATE (no IF NOT EXISTS) is NOT swallowed.
+        with pytest.raises(sqlite3.OperationalError, match="already exists"):
+            d.execute_ddl(con, "CREATE TABLE t (a INTEGER)")
+        con.execute("CREATE INDEX idx_a ON t (a)")
+        with pytest.raises(sqlite3.OperationalError, match="already exists"):
+            d.execute_ddl(con, "CREATE INDEX idx_a ON t (a)")
+        con.close()
+
 
 @pytest.mark.parametrize(
     "url", ["mysql://u:p@h/db", "postgresql://u:p@h/db", "mysql+pymysql://u:p@h/db"]

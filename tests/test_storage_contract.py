@@ -5,12 +5,19 @@ Thin parametrization of the shipped suite
 ``optuna_tpu.testing.storages.STORAGE_MODES`` matrix — mirroring how the
 reference's ``tests/storages_tests/test_storages.py`` drives
 ``optuna/testing/pytest_storages.py``.
+
+``TestStorageContractUnderFaults`` re-runs the same matrix with every call
+passing through :class:`FaultInjectorStorage` (a low transient-fault rate)
+and :class:`RetryingStorage`: every backend + retry-wrapper combination must
+be contract-clean under faults, not just on the happy path.
 """
 
 from __future__ import annotations
 
 import pytest
 
+from optuna_tpu.storages import RetryingStorage, RetryPolicy
+from optuna_tpu.testing.fault_injection import FaultInjectorStorage, FaultPlan
 from optuna_tpu.testing.pytest_storages import StorageTestCase
 from optuna_tpu.testing.storages import STORAGE_MODES, StorageSupplier
 
@@ -20,3 +27,38 @@ class TestStorageContract(StorageTestCase):
     def storage(self, request):
         with StorageSupplier(request.param) as s:
             yield s
+
+
+# Aggregated across the whole under-faults matrix; a single short test may
+# legitimately draw zero faults at a 5% rate, but the matrix as a whole
+# cannot — see test_fault_matrix_actually_injected below.
+_FAULTS = {"injected": 0, "fixture_runs": 0}
+
+
+class TestStorageContractUnderFaults(StorageTestCase):
+    @pytest.fixture(params=STORAGE_MODES)
+    def storage(self, request):
+        with StorageSupplier(request.param) as inner:
+            injector = FaultInjectorStorage(
+                inner,
+                # Faults strike before the backend call executes, so
+                # retrying creates cannot double-apply (the plan seed varies
+                # by mode so the matrix doesn't fault in lockstep).
+                FaultPlan(transient_rate=0.05, seed=sum(map(ord, request.param))),
+            )
+            yield RetryingStorage(
+                injector,
+                RetryPolicy(max_attempts=25, deadline=None, sleep=lambda _s: None),
+                retry_non_idempotent=True,
+            )
+            _FAULTS["injected"] += injector.faults_injected
+            _FAULTS["fixture_runs"] += 1
+
+
+def test_fault_matrix_actually_injected():
+    """Runs after the class above (file order): the under-faults matrix must
+    have injected real faults, or it silently degraded to a happy-path rerun
+    (e.g. a refactor unwrapping the injector or zeroing the rate)."""
+    if _FAULTS["fixture_runs"] < len(STORAGE_MODES):
+        pytest.skip("under-faults matrix not (fully) selected in this run")
+    assert _FAULTS["injected"] > 0
