@@ -50,6 +50,36 @@ class VectorizedObjective:
     ) -> None:
         self.fn = fn
         self.search_space = search_space
+        self._compiled_cache: dict[tuple, Any] = {}
+
+    def compiled(self, mesh: "jax.sharding.Mesh | None", batch_axis: str):
+        """The jit wrapper for ``fn`` under (mesh, axis) — built once per key,
+        NOT per optimize call. jax.jit's trace/executable cache hangs off the
+        wrapper object, so rebuilding the wrapper each ``optimize_vectorized``
+        call silently retraced and recompiled every batch shape on the second
+        study; memoizing here is what makes "the tail shape compiles once and
+        is reused across studies" actually true. The cache lives on this
+        objective (not a module global) so dropping the objective frees the
+        executables and whatever ``fn`` closed over.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (mesh, batch_axis)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        if mesh is not None:
+            in_shard = NamedSharding(mesh, P(batch_axis))
+            compiled = jax.jit(  # graphlint: ignore[TPU002] -- memoized above: one wrapper per (mesh, axis) for this objective's lifetime, not per call
+                self.fn,
+                in_shardings=({k: in_shard for k in self.search_space},),
+                out_shardings=NamedSharding(mesh, P(batch_axis)),
+            )
+        else:
+            compiled = jax.jit(self.fn)  # graphlint: ignore[TPU002] -- memoized above: one wrapper per (mesh, axis) for this objective's lifetime, not per call
+        self._compiled_cache[key] = compiled
+        return compiled
 
 
 def _pack_params(
@@ -80,24 +110,12 @@ def optimize_vectorized(
     ``batch_axis`` and the objective executes SPMD across every device; the
     per-batch host work is just ask/tell bookkeeping.
     """
-    import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     if batch_size is None:
         batch_size = len(mesh.devices.flat) if mesh is not None else 8
 
-    compiled = None
-    if mesh is not None:
-        in_shard = NamedSharding(mesh, P(batch_axis))
-        out_shard = NamedSharding(mesh, P(batch_axis))
-        compiled = jax.jit(
-            objective.fn,
-            in_shardings=({k: in_shard for k in objective.search_space},),
-            out_shardings=out_shard,
-        )
-    else:
-        compiled = jax.jit(objective.fn)
+    compiled = objective.compiled(mesh, batch_axis)
 
     n_dev = len(mesh.devices.flat) if mesh is not None else 1
     done = 0

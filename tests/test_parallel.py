@@ -154,3 +154,23 @@ def test_optimize_vectorized_ragged_tail_minimal_padding(monkeypatch):
     # Batches: 16, then tail 3 -> padded to 8 (one device-multiple), never 16.
     assert eval_widths[0] == 16
     assert eval_widths[-1] == 8
+
+
+def test_compiled_objective_cached_across_optimize_calls():
+    """Regression (graphlint TPU002): the jit wrapper must be built once per
+    (objective, mesh, axis) — not per optimize_vectorized call, which
+    silently retraced every batch shape on the second study."""
+    from optuna_tpu.samplers import RandomSampler
+
+    def fn(params):
+        return params["x"] * 2.0
+
+    obj = VectorizedObjective(fn=fn, search_space={"x": FloatDistribution(0.0, 1.0)})
+    assert obj.compiled(None, "trials") is obj.compiled(None, "trials")
+
+    # End to end: two studies over the same objective share that one wrapper.
+    for _ in range(2):
+        study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+        optimize_vectorized(study, obj, n_trials=4, batch_size=4)
+        assert len(study.trials) == 4
+    assert len(obj._compiled_cache) == 1
