@@ -18,12 +18,31 @@ from optuna_tpu.storages._grpc._service import (
     encode_request,
 )
 from optuna_tpu.storages._heartbeat import BaseHeartbeat
-from optuna_tpu.storages._retry import REPLAY_UNSAFE_METHODS, RetryPolicy
+from optuna_tpu.storages._retry import RetryPolicy
 from optuna_tpu.study._frozen import FrozenStudy
 from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
 from optuna_tpu.trial._state import TrialState
 
+
+# Wire-protocol constant: the RPCs that carry a client-minted dedupe op
+# token. Deliberately a literal, NOT an import of
+# ``storages._retry.REPLAY_UNSAFE_METHODS``: the server's dedupe behavior is
+# a wire contract, and silently inheriting a changed retry-layer set would
+# change what old servers dedupe without anyone touching this file. graphlint
+# rule STO001 statically verifies this copy against the canonical registry
+# (optuna_tpu/_lint/registry.py), so drift is a lint failure instead of a
+# silent double-apply.
+_OP_TOKEN_METHODS = frozenset(
+    {
+        "create_new_study",
+        "delete_study",
+        "create_new_trial",
+        "create_new_trials",
+        "set_trial_param",
+        "set_trial_state_values",
+    }
+)
 
 # Per-attempt RPC bound used when the policy's overall deadline is disabled
 # (deadline=None): a single attempt against a wedged server must still fail
@@ -73,7 +92,7 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
         if old is not None:
             try:
                 old.close()
-            except Exception:
+            except Exception:  # graphlint: ignore[PY001] -- a wedged channel may fail close() in grpc-internal ways; reconnect must proceed regardless
                 pass
         self._setup()
 
@@ -89,7 +108,7 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
     def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
         import grpc
 
-        if method in REPLAY_UNSAFE_METHODS:
+        if method in _OP_TOKEN_METHODS:
             # One token per *logical* call, minted before the retry loop, so
             # every replay carries the same token and the server's dedupe
             # cache collapses them into one execution.

@@ -81,6 +81,37 @@ class FaultPlan:
     )
 
 
+# Chaos matrix for the replay-unsafe storage writes: every method whose
+# blind replay can double-apply maps to the failure scenario the chaos suite
+# must exercise against it. Deliberately a hand-written literal (not an
+# import of ``storages._retry.REPLAY_UNSAFE_METHODS``): the matrix is the
+# *test plan* for that set, and a new replay-unsafe write must show up here
+# with a scenario or graphlint rule STO001 fails the build — adding a write
+# without deciding how to chaos-test it is exactly the drift this guards.
+REPLAY_UNSAFE_CHAOS_MATRIX: dict[str, str] = {
+    "create_new_study": "inject transient before commit; a retry must not mint a twin study",
+    "delete_study": "inject transient before commit; a retry must not raise KeyError",
+    "create_new_trial": "inject transient before commit; a retry must not mint a twin trial",
+    "create_new_trials": "inject transient before commit; a retry must not duplicate the batch",
+    "set_trial_param": "inject transient before commit; a retry must not collide with the claim",
+    "set_trial_state_values": "kill mid-claim; heartbeat failover must reap the RUNNING trial",
+}
+
+
+def replay_unsafe_chaos_plan(
+    *, indices: Sequence[int] = (0,), seed: int = 0, max_faults: int | None = None
+) -> FaultPlan:
+    """A :class:`FaultPlan` that deterministically faults every replay-unsafe
+    write at the given per-method call ``indices`` — the executable form of
+    :data:`REPLAY_UNSAFE_CHAOS_MATRIX`, used by the storage-contract chaos
+    suite so new registry entries are exercised without editing the test."""
+    return FaultPlan(
+        schedule={method: tuple(indices) for method in REPLAY_UNSAFE_CHAOS_MATRIX},
+        seed=seed,
+        max_faults=max_faults,
+    )
+
+
 class FaultInjectorStorage(_ForwardingStorage):
     """Wrap any storage and inject faults per a :class:`FaultPlan`.
 

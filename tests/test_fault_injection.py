@@ -40,10 +40,12 @@ from optuna_tpu.storages.journal._file import (
     JournalFileSymlinkLock,
 )
 from optuna_tpu.testing.fault_injection import (
+    REPLAY_UNSAFE_CHAOS_MATRIX,
     FaultInjectorStorage,
     FaultPlan,
     SimulatedWorkerDeath,
     plant_stale_lock,
+    replay_unsafe_chaos_plan,
     tear_journal_tail,
 )
 from optuna_tpu.trial._state import TrialState
@@ -227,6 +229,37 @@ def test_chaos_study_converges_identically_to_fault_free() -> None:
     chaos_values = run(chaotic)
 
     assert injector.faults_injected > 0, "the plan injected nothing — test is vacuous"
+    assert chaos_values == clean_values
+
+
+def test_replay_unsafe_chaos_plan_covers_every_registry_write() -> None:
+    """The executable form of REPLAY_UNSAFE_CHAOS_MATRIX: every replay-unsafe
+    write faults at its first call and the study still converges exactly —
+    so a method added to the canonical registry (graphlint STO001) is chaos-
+    exercised here without anyone editing this test."""
+
+    def run(storage) -> list[float]:
+        study = optuna_tpu.create_study(
+            storage=storage, sampler=TPESampler(seed=11, n_startup_trials=5)
+        )
+        study.optimize(_objective, n_trials=20)
+        return [t.value for t in study.trials]
+
+    clean_values = run(InMemoryStorage())
+
+    plan = replay_unsafe_chaos_plan(indices=(0, 3))
+    injector = FaultInjectorStorage(InMemoryStorage(), plan)
+    chaotic = RetryingStorage(
+        injector, _fast_retry(max_attempts=20), retry_non_idempotent=True
+    )
+    chaos_values = run(chaotic)
+
+    # Every matrix row whose method the run exercises must have fired; rows
+    # the workload never calls (delete_study) stay pending but scheduled.
+    exercised = set(injector.calls) & set(REPLAY_UNSAFE_CHAOS_MATRIX)
+    assert {"create_new_study", "create_new_trial", "set_trial_param",
+            "set_trial_state_values"} <= exercised
+    assert injector.faults_injected >= len(exercised)
     assert chaos_values == clean_values
 
 
