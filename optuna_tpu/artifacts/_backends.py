@@ -141,7 +141,7 @@ class Backoff:
                 return fn(*args)
             except ArtifactNotFound:
                 raise
-            except Exception:
+            except Exception:  # graphlint: ignore[PY001] -- retry wrapper over pluggable backends (boto3/fs/...); their transient error types are not knowable here
                 if attempt == self._max_retries - 1:
                     raise
                 time.sleep(delay)

@@ -388,7 +388,7 @@ class CmaEsSampler(BaseSampler):
             result = (state, extra)
             self._state_cache = (hexstr, result)
             return result
-        except Exception:  # corrupt/racing attrs of any flavor -> clean restart
+        except Exception:  # graphlint: ignore[PY001] -- corrupt/racing state attrs of any flavor -> clean optimizer restart is always safe
             _logger.warning("Broken CMA-ES state attrs; restarting the optimizer.")
             return None
 

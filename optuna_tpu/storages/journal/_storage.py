@@ -304,7 +304,7 @@ class JournalStorage(BaseStorage):
                 if isinstance(restored, _ReplayResult):
                     self._replay = restored
                     self._replay.own_results = {}
-            except Exception:
+            except Exception:  # graphlint: ignore[PY001] -- corrupt pickle bytes raise far outside UnpicklingError (OverflowError, MemoryError, KeyError from __setstate__...); a snapshot is a pure optimization, every flavor falls back to full replay
                 _logger.warning("Failed to load journal snapshot; replaying from scratch.")
         self._sync()
 

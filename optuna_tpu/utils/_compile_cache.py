@@ -73,5 +73,9 @@ def ensure_compile_cache() -> None:
         ):
             os.makedirs(default_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", default_dir)
-    except Exception:  # pragma: no cover - cache is an optimization only
+    except (OSError, ImportError, AttributeError, ValueError, RuntimeError):
+        # pragma: no cover — the cache is an optimization only: unwritable
+        # HOME (OSError), a broken/ancient jax (ImportError/AttributeError),
+        # or a config key this jax doesn't know (ValueError/RuntimeError)
+        # must never break importing the package.
         pass

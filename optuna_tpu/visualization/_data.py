@@ -257,15 +257,7 @@ def _interpolate_grid(
     """Nearest-neighbour fill over a linear-interpolation base, mirroring the
     reference's plotly ``connectgaps``-like behavior without SciPy's Qhull
     dependency being mandatory."""
-    try:
-        from scipy.interpolate import griddata
-
-        pts = np.stack([xs, ys], axis=1)
-        grid = griddata(pts, zs, (gx[None, :], gy[:, None]), method="linear")
-        near = griddata(pts, zs, (gx[None, :], gy[:, None]), method="nearest")
-        grid = np.where(np.isnan(grid), near, grid)
-        return grid
-    except Exception:
+    def nearest_only() -> np.ndarray:
         # Degenerate geometry (collinear points, too few trials): nearest only.
         gz = np.empty((len(gy), len(gx)))
         for i, yv in enumerate(gy):
@@ -273,6 +265,23 @@ def _interpolate_grid(
                 k = int(np.argmin((xs - xv) ** 2 + (ys - yv) ** 2))
                 gz[i, j] = zs[k]
         return gz
+
+    try:
+        from scipy.interpolate import griddata
+
+        try:
+            from scipy.spatial import QhullError
+        except ImportError:  # scipy < 1.8 keeps it in the private module
+            from scipy.spatial.qhull import QhullError
+    except ImportError:  # SciPy is optional for visualization
+        return nearest_only()
+    try:
+        pts = np.stack([xs, ys], axis=1)
+        grid = griddata(pts, zs, (gx[None, :], gy[:, None]), method="linear")
+        near = griddata(pts, zs, (gx[None, :], gy[:, None]), method="nearest")
+        return np.where(np.isnan(grid), near, grid)
+    except (QhullError, ValueError):
+        return nearest_only()
 
 
 def contour_pair_data(
@@ -525,7 +534,7 @@ def pareto_front_data(
         def ok(t: FrozenTrial) -> bool:
             try:
                 return all(float(c) <= 0.0 for c in constraints_func(t))
-            except Exception:
+            except Exception:  # graphlint: ignore[PY001] -- user callback isolation: any crash in constraints_func means "infeasible", never a broken plot
                 return False
 
         feasible = [t for t in trials if ok(t)]
