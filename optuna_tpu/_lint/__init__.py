@@ -1,0 +1,58 @@
+"""graphlint: AST-based invariant checker for device kernels and storage
+concurrency.
+
+Run it::
+
+    python -m optuna_tpu._lint optuna_tpu        # or: optuna-tpu-lint optuna_tpu
+
+Rules (see ARCHITECTURE.md "Static analysis" for the full contract):
+
+=======  ================================================================
+TPU001   host sync (float()/.item()/np.asarray) inside a jit trace
+TPU002   jit built per-call / static args with unhashable defaults
+TPU003   float64 in an f32-hardened device module
+TPU004   stray print / jax.debug.print in package code
+STO001   replay-unsafe write registries drifted from the canonical one
+STO002   lock-order cycle in the storage layer
+PY001    broad ``except Exception`` without a documented reason
+LNT000   file failed to parse
+LNT001   malformed suppression pragma (reason is mandatory)
+=======  ================================================================
+
+Suppression: ``# graphlint: ignore[RULE] -- reason`` (reason required).
+Configuration: ``[tool.graphlint]`` in pyproject.toml.
+"""
+
+from __future__ import annotations
+
+from optuna_tpu._lint.engine import (  # noqa: F401 (public surface)
+    BAD_PRAGMA_RULE,
+    Finding,
+    LintResult,
+    PARSE_ERROR_RULE,
+    Rule,
+    run_lint,
+)
+from optuna_tpu._lint.config import Config, find_pyproject, load_config  # noqa: F401
+
+
+def all_rules() -> list[Rule]:
+    """One fresh instance of every graphlint rule, in reporting order."""
+    from optuna_tpu._lint.rules_device import (
+        TPU001HostSyncInJit,
+        TPU002RecompileHazard,
+        TPU003DtypeDrift,
+        TPU004StrayDebugOutput,
+    )
+    from optuna_tpu._lint.rules_py import PY001BroadExcept
+    from optuna_tpu._lint.rules_storage import STO001ReplayRegistrySync, STO002LockOrder
+
+    return [
+        TPU001HostSyncInJit(),
+        TPU002RecompileHazard(),
+        TPU003DtypeDrift(),
+        TPU004StrayDebugOutput(),
+        STO001ReplayRegistrySync(),
+        STO002LockOrder(),
+        PY001BroadExcept(),
+    ]

@@ -1,0 +1,5 @@
+"""``python -m optuna_tpu._lint`` — see cli.py for flags."""
+
+from optuna_tpu._lint.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""graphlint command line: shared by ``python -m optuna_tpu._lint`` and the
+``optuna-tpu-lint`` console script.
+
+Exit codes: 0 clean, 1 findings, 2 usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Sequence
+
+from optuna_tpu._lint import all_rules, find_pyproject, load_config, run_lint
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="optuna-tpu-lint",
+        description="AST-based invariant checker for device kernels and storage concurrency.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["optuna_tpu"],
+        help="files or directories to lint (default: optuna_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format (default: text)",
+    )
+    parser.add_argument(
+        "--config", default=None, metavar="PYPROJECT",
+        help="pyproject.toml to read [tool.graphlint] from "
+        "(default: nearest one above the first path)",
+    )
+    parser.add_argument(
+        "--no-config", action="store_true",
+        help="ignore any pyproject.toml and run with built-in defaults",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also list findings silenced by pragmas (text format only)",
+    )
+    return parser
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.no_config:
+        pyproject = None
+    elif args.config is not None:
+        pyproject = args.config
+    else:
+        pyproject = find_pyproject(args.paths[0])
+    try:
+        config = load_config(pyproject)
+    except (OSError, ValueError, RuntimeError) as err:
+        print(f"optuna-tpu-lint: cannot load {pyproject}: {err}", file=sys.stderr)
+        return 2
+    try:
+        result = run_lint(args.paths, config, all_rules())
+    except OSError as err:
+        print(f"optuna-tpu-lint: {err}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in result.findings],
+                    "suppressed": len(result.suppressed),
+                    "files_scanned": result.files_scanned,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for finding in result.findings:
+            print(finding.format())
+        if args.show_suppressed:
+            for finding, pragma in result.suppressed:
+                print(f"[suppressed: {pragma.reason}] {finding.format()}")
+        tail = (
+            f"{len(result.findings)} finding(s), {len(result.suppressed)} suppressed, "
+            f"{result.files_scanned} file(s) scanned"
+        )
+        print(tail, file=sys.stderr)
+    return result.exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    raise SystemExit(main())

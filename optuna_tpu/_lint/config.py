@@ -1,0 +1,179 @@
+"""graphlint configuration: defaults, ``[tool.graphlint]`` pyproject table.
+
+Path semantics: every pattern is matched against the *resolved posix path*
+of the file, so configs behave the same no matter which directory the
+runner is invoked from. A pattern matches when it is a path suffix, a
+directory prefix of a suffix (``optuna_tpu/_lint`` covers the subtree), or
+an ``fnmatch`` glob.
+
+The pyproject table::
+
+    [tool.graphlint]
+    exclude = ["optuna_tpu/_lint"]          # skip entirely
+    disable = []                            # rule ids off everywhere
+    device-paths = ["optuna_tpu/ops/", ...] # override device classification
+
+    [[tool.graphlint.overrides]]            # relaxed profile for a subtree
+    paths = ["tests", "scripts"]
+    disable = ["TPU004", "PY001"]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+from typing import Mapping, Sequence
+
+from optuna_tpu._lint import registry
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def _path_matches(path: str, pattern: str) -> bool:
+    """True if ``pattern`` selects ``path`` (suffix / subtree / glob)."""
+    path = _norm(path)
+    pattern = _norm(pattern).rstrip("/")
+    if not pattern:
+        return False
+    if path == pattern or path.endswith("/" + pattern):
+        return True
+    if ("/" + path + "/").find("/" + pattern + "/") != -1:
+        return True
+    if fnmatch.fnmatch(path, pattern) or fnmatch.fnmatch(path, "*/" + pattern):
+        return True
+    return False
+
+
+def _device_path_matches(path: str, pattern: str) -> bool:
+    # Device patterns keep their trailing slash ("subtree") distinction.
+    path = _norm(path)
+    pattern = _norm(pattern)
+    if pattern.endswith("/"):
+        return ("/" + pattern) in ("/" + path)
+    return path.endswith(pattern)
+
+
+@dataclasses.dataclass(frozen=True)
+class PathOverride:
+    paths: tuple[str, ...]
+    disable: tuple[str, ...] = ()
+    enable: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    disable: tuple[str, ...] = ()
+    enable: tuple[str, ...] = ()  # non-empty => only these rule ids run
+    exclude: tuple[str, ...] = ()
+    overrides: tuple[PathOverride, ...] = ()
+    device_paths: tuple[str, ...] = registry.DEVICE_MODULE_PATHS
+    host_boundary_f64: Mapping[str, Mapping[str, str]] = dataclasses.field(
+        default_factory=lambda: registry.HOST_BOUNDARY_F64
+    )
+    sto001_targets: tuple[tuple[str, str, str], ...] = registry.STO001_TARGETS
+    sto001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.REPLAY_UNSAFE_REGISTRY
+    )
+    sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)
+    base_dir: str | None = None  # dir containing the config file, for display paths
+
+    def is_excluded(self, path: str) -> bool:
+        return any(_path_matches(path, pat) for pat in self.exclude)
+
+    def is_device_path(self, path: str) -> bool:
+        return any(_device_path_matches(path, pat) for pat in self.device_paths)
+
+    def rule_enabled(self, rule_id: str, path: str) -> bool:
+        from optuna_tpu._lint.engine import BAD_PRAGMA_RULE, PARSE_ERROR_RULE
+
+        # An `enable` allowlist selects *rules to run*; the engine
+        # diagnostics (unparsable file, malformed pragma) must survive it or
+        # a syntax-broken file would lint clean. Explicit disable/overrides
+        # still silence them.
+        diagnostics = (PARSE_ERROR_RULE, BAD_PRAGMA_RULE)
+        if self.enable and rule_id not in self.enable and rule_id not in diagnostics:
+            return False
+        enabled = rule_id not in self.disable
+        for override in self.overrides:
+            if any(_path_matches(path, pat) for pat in override.paths):
+                if rule_id in override.disable:
+                    enabled = False
+                if rule_id in override.enable:
+                    enabled = True
+        return enabled
+
+
+def _load_toml(path: str) -> dict:
+    try:
+        import tomllib  # Python >= 3.11
+    except ImportError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ImportError:
+            # Silently running with defaults would un-exclude/un-disable
+            # whatever the project configured — fail loudly instead (the CLI
+            # maps this to exit 2; --no-config opts into defaults).
+            raise RuntimeError(
+                f"cannot read {path}: no TOML parser available "
+                "(Python < 3.11 needs the 'tomli' package; "
+                "or pass --no-config to run with built-in defaults)"
+            ) from None
+    with open(path, "rb") as f:
+        return tomllib.load(f)
+
+
+def find_pyproject(start: str) -> str | None:
+    """Walk up from ``start`` to the filesystem root looking for pyproject.toml."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        candidate = os.path.join(cur, "pyproject.toml")
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def load_config(pyproject_path: str | None) -> Config:
+    """Build a Config from a pyproject.toml (or defaults when None/absent)."""
+    if pyproject_path is None:
+        return Config()
+    data = _load_toml(pyproject_path)
+    table = data.get("tool", {}).get("graphlint", {})
+    if not isinstance(table, dict):
+        table = {}
+
+    def strings(key: str, default: Sequence[str] = ()) -> tuple[str, ...]:
+        val = table.get(key, table.get(key.replace("_", "-"), list(default)))
+        if not isinstance(val, list):
+            return tuple(default)
+        return tuple(str(v) for v in val)
+
+    overrides = []
+    for entry in table.get("overrides", ()):
+        if not isinstance(entry, dict):
+            continue
+        paths = tuple(str(p) for p in entry.get("paths", ()))
+        if not paths:
+            continue
+        overrides.append(
+            PathOverride(
+                paths=paths,
+                disable=tuple(str(r) for r in entry.get("disable", ())),
+                enable=tuple(str(r) for r in entry.get("enable", ())),
+            )
+        )
+    return Config(
+        disable=strings("disable"),
+        enable=strings("enable"),
+        exclude=strings("exclude"),
+        overrides=tuple(overrides),
+        device_paths=strings("device_paths", registry.DEVICE_MODULE_PATHS),
+        base_dir=os.path.dirname(os.path.abspath(pyproject_path)),
+    )

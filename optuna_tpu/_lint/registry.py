@@ -1,0 +1,99 @@
+"""Canonical machine-checked invariants shared by graphlint rules.
+
+This module is the single source of truth for facts that used to live only
+in reviewers' heads:
+
+* :data:`REPLAY_UNSAFE_REGISTRY` — the storage write methods whose blind
+  replay after a committed-but-unacked first attempt is observably wrong.
+  Three code sites carry a hand-written copy of this set, each for a
+  different reason (see :data:`STO001_TARGETS`); rule **STO001** fails the
+  lint if any copy drifts from this registry.
+* :data:`DEVICE_MODULE_PATHS` — the f32-hardened, sync-free modules where
+  the TPU rules apply. Everything the paper's "one fused dispatch per
+  suggestion" latency argument rests on lives here.
+* :data:`HOST_BOUNDARY_F64` — the reviewed host-side functions inside
+  device modules that legitimately touch float64 (rule **TPU003** skips
+  them). Every entry documents why that boundary is host-only.
+
+Keep this file boring: plain literals only, so the rules can cross-check
+other files against it without importing anything heavy.
+"""
+
+from __future__ import annotations
+
+#: Storage writes that must never be blindly replayed: a second create mints
+#: a duplicate trial/study, a replayed WAITING->RUNNING claim CAS loses to
+#: its own winner, a replayed param/terminal-state write raises against the
+#: now-claimed trial, a replayed delete raises KeyError. Values say *why*
+#: each method is replay-unsafe — the reasons surface in STO001 messages.
+REPLAY_UNSAFE_REGISTRY: dict[str, str] = {
+    "create_new_study": "replay raises DuplicatedStudyError or mints a second auto-named study",
+    "delete_study": "replay raises KeyError against the already-deleted study",
+    "create_new_trial": "replay mints a duplicate trial",
+    "create_new_trials": "replay mints a duplicate batch of trials",
+    "set_trial_param": "replay raises against the now-claimed/finished trial",
+    "set_trial_state_values": "replayed claim CAS reports a lost race to its own winner",
+}
+
+#: The three hand-maintained copies STO001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+STO001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/storages/_retry.py",
+        "REPLAY_UNSAFE_METHODS",
+        "RetryingStorage's pass-through set (these calls are not retried)",
+    ),
+    (
+        "optuna_tpu/storages/_grpc/client.py",
+        "_OP_TOKEN_METHODS",
+        "wire-protocol constant: RPCs that carry a dedupe op token",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "REPLAY_UNSAFE_CHAOS_MATRIX",
+        "chaos matrix: every replay-unsafe write must have an injection scenario",
+    ),
+)
+
+#: Path fragments (posix, package-qualified) classifying a module as a
+#: device module: f32-hardened, host-sync-free inside jit. A trailing slash
+#: means "the whole subtree".
+DEVICE_MODULE_PATHS: tuple[str, ...] = (
+    "optuna_tpu/ops/",
+    "optuna_tpu/gp/",
+    "optuna_tpu/samplers/_tpe/_kernels.py",
+)
+
+#: Reviewed host-boundary functions allowed to touch float64 inside device
+#: modules, as ``{path suffix: {function name: reason}}``. These run on the
+#: host (numpy / scipy), outside any jit trace; their f64 never reaches a
+#: device graph. TPU003 skips them and flags everything else.
+HOST_BOUNDARY_F64: dict[str, dict[str, str]] = {
+    "optuna_tpu/ops/forest.py": {
+        "_make_bins": "host-side histogram bin building (numpy, pre-device)",
+        "fit_forest": "host-side bin/target preparation before device transfer",
+        "_export_tree": "host-side export of fitted trees back to numpy",
+    },
+    "optuna_tpu/ops/cmaes.py": {
+        "apply_margin": "host tell path: margin correction on the host copy of state",
+        "should_stop": "host tell path: stop criteria on host numpy state",
+    },
+    "optuna_tpu/ops/qmc.py": {
+        "normal_qmc_sample": "host scipy ndtri path; eps guard is host-only",
+    },
+    "optuna_tpu/gp/box_decomposition.py": {
+        "nondominated_box_decomposition": "host-side box decomposition (numpy)",
+    },
+    "optuna_tpu/gp/optim_mixed.py": {
+        "eval_acqf_chunked": "host chunking wrapper around the jitted acqf",
+        "continuous_bounds": "host-side bounds/mask construction (numpy, pre-device)",
+        "snap_steps": "host-side rounding of a finished candidate",
+        "_sweep_tables": "host-side construction of categorical sweep tables",
+        "optimize_acqf_mixed": "host outer loop; device work happens in jitted callees",
+        "optimize_acqf_sample": "host-side argmax over device-evaluated candidates",
+    },
+    "optuna_tpu/gp/search_space.py": {
+        "SearchSpace": "host-side search-space bounds/steps bookkeeping (numpy)",
+    },
+}

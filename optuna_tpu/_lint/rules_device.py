@@ -1,0 +1,392 @@
+"""Device-kernel rules: TPU001 host sync, TPU002 recompile hazard,
+TPU003 dtype drift, TPU004 stray debug output.
+
+The TPU rules encode the invariants ARCHITECTURE.md's design stance rests
+on: inside a jit trace nothing may force a host round-trip (TPU001), jit
+wrappers are built once at module scope so the executable cache is keyed
+stably (TPU002), and f32-hardened modules never let float64 near a device
+graph (TPU003). JAX makes violations invisible until a recompile storm or
+NaN shows up on hardware — hence static analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from optuna_tpu._lint.engine import Finding, ModuleContext, Rule
+
+_LAX_CONTROL_FLOW = {"while_loop", "scan", "fori_loop", "cond", "switch", "map"}
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """``jax.lax.scan`` -> ["jax", "lax", "scan"]; [] when not a pure chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    chain = _attr_chain(node)
+    return bool(chain) and chain[-1] == "jit"
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):  # @jax.jit(donate_argnums=...) style
+            return True
+        chain = _attr_chain(dec.func)
+        if chain and chain[-1] == "partial" and dec.args and _is_jit_expr(dec.args[0]):
+            return True
+    return False
+
+
+def _is_cache_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    chain = _attr_chain(dec)
+    return bool(chain) and chain[-1] in _CACHE_DECORATORS
+
+
+def _walk_parents(tree: ast.Module) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _traced_scopes(tree: ast.Module) -> set[ast.AST]:
+    """Function/lambda nodes whose bodies execute under a JAX trace.
+
+    Seeds: jit-decorated defs, plus defs/lambdas handed to
+    ``lax.while_loop`` / ``scan`` / ``fori_loop`` / ``cond`` / ``switch`` /
+    ``map``. Closure: anything lexically nested inside a traced scope is
+    traced too.
+    """
+    parents = _walk_parents(tree)
+    traced: set[ast.AST] = set()
+    loop_body_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                traced.add(node)
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] in _LAX_CONTROL_FLOW and "lax" in chain[:-1]:
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        traced.add(arg)
+                    elif isinstance(arg, ast.Name):
+                        loop_body_names.add(arg.id)
+    if loop_body_names:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in loop_body_names
+            ):
+                traced.add(node)
+    # Close over lexical nesting: inner defs of a traced def are traced.
+    for node in ast.walk(tree):
+        if not isinstance(node, _FuncNode):
+            continue
+        cur = parents.get(node)
+        while cur is not None:
+            if cur in traced:
+                traced.add(node)
+                break
+            cur = parents.get(cur)
+    return traced
+
+
+def _mentions_static_shape(node: ast.AST) -> bool:
+    """True when the expression reads only trace-static metadata (shape/ndim/
+    len/dtype/size), so wrapping it in int()/float() is not a host sync."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) and sub.func.id == "len":
+            return True
+    return False
+
+
+class TPU001HostSyncInJit(Rule):
+    id = "TPU001"
+    title = "host sync inside a jit trace"
+
+    _SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+    _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+    _NP_SYNC_FUNCS = {"asarray", "array"}
+    _NP_NAMES = {"np", "numpy", "onp"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.is_device:
+            return
+        traced = _traced_scopes(ctx.tree)
+        if not traced:
+            return
+        # Walk each traced scope's body once (nested traced defs are reached
+        # through their outermost traced ancestor).
+        parents = _walk_parents(ctx.tree)
+        roots = [n for n in traced if not any(p in traced for p in _ancestors(n, parents))]
+        seen: set[int] = set()
+        for root in roots:
+            # Only the *body* executes under the trace: the root's decorators
+            # and default-arg expressions run once, at def time, on the host.
+            # (Nested defs' defaults DO evaluate during the outer trace, and
+            # walking the body statements reaches them.)
+            body = root.body if isinstance(root.body, list) else [root.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if id(node) in seen or not isinstance(node, ast.Call):
+                        continue
+                    seen.add(id(node))
+                    yield from self._check_call(ctx, node)
+
+    def _check_call(self, ctx: ModuleContext, node: ast.Call) -> Iterator[Finding]:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id in self._SYNC_BUILTINS:
+            if node.args and not all(_mentions_static_shape(a) for a in node.args):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{func.id}() on a traced value forces a device->host sync inside "
+                    "jit; keep the value on device or hoist the conversion out of the trace",
+                )
+            return
+        chain = _attr_chain(func)
+        if isinstance(func, ast.Attribute) and func.attr in self._SYNC_METHODS:
+            yield ctx.finding(
+                self.id, node,
+                f".{func.attr}() inside a jit trace blocks on the device; "
+                "return the array and convert at the host boundary",
+            )
+            return
+        if (
+            len(chain) >= 2
+            and chain[0] in self._NP_NAMES
+            and chain[-1] in self._NP_SYNC_FUNCS
+        ):
+            yield ctx.finding(
+                self.id, node,
+                f"{'.'.join(chain)}() materializes a traced value on the host inside "
+                "jit; use jnp equivalents so the op stays in the graph",
+            )
+            return
+        if chain[-2:] == ["jax", "device_get"] or chain == ["device_get"]:
+            yield ctx.finding(
+                self.id, node, "jax.device_get inside a jit trace is a host sync"
+            )
+
+
+def _ancestors(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> Iterator[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+class TPU002RecompileHazard(Rule):
+    id = "TPU002"
+    title = "jit recompile hazard"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        yield from self._check_dynamic_jit(ctx)
+        yield from self._check_static_defaults(ctx)
+
+    # -- jax.jit(...) built inside a function or loop body -------------------
+
+    def _check_dynamic_jit(self, ctx: ModuleContext) -> Iterator[Finding]:
+        findings: list[Finding] = []
+
+        def visit(node: ast.AST, func_stack: list[ast.AST], loop_depth: int) -> None:
+            if isinstance(node, _FuncNode):
+                if not isinstance(node, ast.Lambda):
+                    for dec in node.decorator_list:
+                        visit(dec, func_stack, loop_depth)
+                body = node.body if isinstance(node.body, list) else [node.body]
+                for child in body:
+                    visit(child, func_stack + [node], 0)
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, func_stack, loop_depth + 1)
+                return
+            if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                in_cached_factory = any(
+                    not isinstance(f, ast.Lambda)
+                    and any(_is_cache_decorator(d) for d in f.decorator_list)
+                    for f in func_stack
+                )
+                if (func_stack or loop_depth) and not in_cached_factory:
+                    where = "a loop body" if loop_depth else "a function body"
+                    findings.append(
+                        ctx.finding(
+                            self.id, node,
+                            f"jax.jit built inside {where}: each call mints a fresh "
+                            "wrapper with an empty executable cache (recompile churn); "
+                            "jit at module scope or behind functools.lru_cache",
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child, func_stack, loop_depth)
+
+        for top in ctx.tree.body:
+            visit(top, [], 0)
+        yield from findings
+
+    # -- static_argnums/static_argnames pointing at unhashable defaults ------
+
+    _UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+    def _static_names_from_call(self, call: ast.Call) -> tuple[list[str], list[int]]:
+        names: list[str] = []
+        nums: list[int] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                        names.append(sub.value)
+            elif kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                        nums.append(sub.value)
+        return names, nums
+
+    def _default_is_unhashable(self, default: ast.AST | None) -> bool:
+        if default is None:
+            return False
+        if isinstance(default, self._UNHASHABLE):
+            return True
+        if isinstance(default, ast.Call) and isinstance(default.func, ast.Name):
+            return default.func.id in ("list", "dict", "set", "bytearray")
+        return False
+
+    def _check_static_defaults(self, ctx: ModuleContext) -> Iterator[Finding]:
+        funcs = {
+            n.name: n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        wrappings: list[tuple[ast.Call, ast.FunctionDef]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _is_jit_decorator(dec):
+                        wrappings.append((dec, node))
+            elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                target = node.args[0] if node.args else None
+                if isinstance(target, ast.Name) and target.id in funcs:
+                    wrappings.append((node, funcs[target.id]))
+        for call, func in wrappings:
+            names, nums = self._static_names_from_call(call)
+            if not names and not nums:
+                continue
+            arg_nodes = list(func.args.posonlyargs) + list(func.args.args)
+            defaults = list(func.args.defaults)
+            # defaults align with the tail of the positional arg list
+            default_by_arg: dict[str, ast.AST] = {}
+            for arg, default in zip(arg_nodes[len(arg_nodes) - len(defaults):], defaults):
+                default_by_arg[arg.arg] = default
+            for kwarg, kwdefault in zip(func.args.kwonlyargs, func.args.kw_defaults):
+                if kwdefault is not None:
+                    default_by_arg[kwarg.arg] = kwdefault
+            flagged: set[str] = set()
+            for name in names:
+                if self._default_is_unhashable(default_by_arg.get(name)):
+                    flagged.add(name)
+            for num in nums:
+                if 0 <= num < len(arg_nodes):
+                    arg_name = arg_nodes[num].arg
+                    if self._default_is_unhashable(default_by_arg.get(arg_name)):
+                        flagged.add(arg_name)
+            for name in sorted(flagged):
+                yield ctx.finding(
+                    self.id, default_by_arg[name],
+                    f"static arg '{name}' of jit-wrapped '{func.name}' has an "
+                    "unhashable default: the first call raises (or retraces per "
+                    "call); use a hashable sentinel",
+                )
+
+
+class TPU003DtypeDrift(Rule):
+    id = "TPU003"
+    title = "float64 in an f32-hardened device module"
+
+    _F64_ATTRS = {"float64", "double"}
+    _NP_BASES = {"np", "numpy", "jnp", "onp"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.is_device:
+            return
+        allow = self._allowlist_for(ctx)
+        parents = _walk_parents(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            hit: str | None = None
+            if isinstance(node, ast.Attribute) and node.attr in self._F64_ATTRS:
+                chain = _attr_chain(node)
+                if chain and (chain[0] in self._NP_BASES or "numpy" in chain[:-1]):
+                    hit = ".".join(chain)
+            elif isinstance(node, ast.Constant) and node.value == "float64":
+                hit = "'float64'"
+            if hit is None:
+                continue
+            scope = self._enclosing_scope_names(node, parents)
+            if scope & allow:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"{hit} in an f32-hardened device module: f64 widens the whole "
+                "graph and halves TPU throughput; cast at the host boundary or "
+                "add the function to the HOST_BOUNDARY_F64 registry",
+            )
+
+    def _allowlist_for(self, ctx: ModuleContext) -> set[str]:
+        path = ctx.path.replace("\\", "/")
+        for suffix, funcs in ctx.config.host_boundary_f64.items():
+            if path.endswith(suffix):
+                return set(funcs)
+        return set()
+
+    def _enclosing_scope_names(
+        self, node: ast.AST, parents: dict[ast.AST, ast.AST]
+    ) -> set[str]:
+        names: set[str] = set()
+        for anc in _ancestors(node, parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.add(anc.name)
+        return names
+
+
+class TPU004StrayDebugOutput(Rule):
+    id = "TPU004"
+    title = "stray debug output"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                yield ctx.finding(
+                    self.id, node,
+                    "print() in package code: route through optuna_tpu.logging "
+                    "(or move the surface into cli.py)",
+                )
+            else:
+                chain = _attr_chain(node.func)
+                if chain[-2:] == ["debug", "print"] or chain[-2:] == ["debug", "breakpoint"]:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{'.'.join(chain)} left in package code: debug taps "
+                        "serialize the device stream; remove before landing",
+                    )

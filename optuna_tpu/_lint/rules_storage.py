@@ -1,0 +1,257 @@
+"""Storage-concurrency rules: STO001 replay-unsafe registry sync,
+STO002 nested-lock acquisition order.
+
+STO001 is the anti-drift rule PR 1 made necessary: the set of storage
+writes that must not be blindly replayed exists in three hand-written
+copies (RetryingStorage's pass-through set, the gRPC client's op-token
+wire constant, the fault-injection chaos matrix). Each copy is compared
+— statically, by AST constant evaluation, without importing the modules —
+against the canonical ``registry.REPLAY_UNSAFE_REGISTRY``.
+
+STO002 builds the lock-acquisition graph from lexical ``with`` nesting
+across the storage layer and flags cycles: two locks taken in both orders
+on different code paths is a deadlock waiting for the right interleaving.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Mapping, Sequence
+
+from optuna_tpu._lint.engine import Finding, ModuleContext, ProjectRule, Rule
+
+
+class _ConstSetError(Exception):
+    pass
+
+
+def _eval_const_strings(node: ast.AST, env: Mapping[str, frozenset[str]]) -> frozenset[str]:
+    """Statically evaluate a string-set expression: literals of
+    set/tuple/list/dict (keys), ``frozenset(...)``/``set(...)`` calls, names
+    bound earlier in the module, and ``|`` unions thereof."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset((node.value,))
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out: frozenset[str] = frozenset()
+        for elt in node.elts:
+            out |= _eval_const_strings(elt, env)
+        return out
+    if isinstance(node, ast.Dict):
+        out = frozenset()
+        for key in node.keys:
+            if key is None:  # **splat — not statically resolvable
+                raise _ConstSetError("dict **splat is not statically evaluable")
+            out |= _eval_const_strings(key, env)
+        return out
+    if isinstance(node, ast.Call):
+        chain_ok = isinstance(node.func, ast.Name) and node.func.id in ("frozenset", "set", "tuple", "dict")
+        if chain_ok and len(node.args) <= 1 and not node.keywords:
+            if not node.args:
+                return frozenset()
+            return _eval_const_strings(node.args[0], env)
+        raise _ConstSetError("unsupported call in constant set expression")
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise _ConstSetError(f"name '{node.id}' is not a known constant set")
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _eval_const_strings(node.left, env) | _eval_const_strings(node.right, env)
+    raise _ConstSetError(f"unsupported node {type(node).__name__} in constant set expression")
+
+
+def _module_const_sets(tree: ast.Module) -> dict[str, tuple[frozenset[str], int]]:
+    """All module-level names statically evaluable to string sets, with the
+    line of their (last) assignment."""
+    env: dict[str, frozenset[str]] = {}
+    lines: dict[str, int] = {}
+    for stmt in tree.body:
+        targets: list[ast.expr] = []
+        value: ast.AST | None = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        for target in targets:
+            if not isinstance(target, ast.Name):
+                continue
+            try:
+                env[target.id] = _eval_const_strings(value, env)
+                lines[target.id] = stmt.lineno
+            except _ConstSetError:
+                continue
+    return {name: (env[name], lines[name]) for name in env}
+
+
+class STO001ReplayRegistrySync(ProjectRule):
+    id = "STO001"
+    title = "replay-unsafe write registries out of sync"
+
+    def check_project(
+        self, modules: Sequence[ModuleContext], config
+    ) -> Iterator[Finding]:
+        canonical = frozenset(config.sto001_registry)
+        for suffix, symbol, why in config.sto001_targets:
+            ctx = next(
+                (m for m in modules if m.path.replace("\\", "/").endswith(suffix)), None
+            )
+            if ctx is None:
+                continue  # that file is outside this scan — nothing to verify
+            if not config.rule_enabled(self.id, ctx.path):
+                continue
+            const_sets = _module_const_sets(ctx.tree)
+            if symbol not in const_sets:
+                yield Finding(
+                    self.id, ctx.display_path, 1, 1,
+                    f"expected module-level '{symbol}' ({why}) statically evaluable "
+                    "to the replay-unsafe method set; not found",
+                )
+                continue
+            found, line = const_sets[symbol]
+            missing = sorted(canonical - found)
+            extra = sorted(found - canonical)
+            if missing:
+                reasons = "; ".join(
+                    f"{m}: {config.sto001_registry[m]}" for m in missing
+                )
+                yield Finding(
+                    self.id, ctx.display_path, line, 1,
+                    f"'{symbol}' ({why}) is missing replay-unsafe methods "
+                    f"[{', '.join(missing)}] — {reasons}",
+                )
+            if extra:
+                yield Finding(
+                    self.id, ctx.display_path, line, 1,
+                    f"'{symbol}' ({why}) lists [{', '.join(extra)}] which the "
+                    "canonical registry (optuna_tpu/_lint/registry.py) does not; "
+                    "either update the registry everywhere or drop the entry",
+                )
+
+
+# --------------------------------------------------------------------- STO002
+
+
+def _lock_label(node: ast.AST, class_name: str, module: str) -> str | None:
+    """Identify a ``with`` context expression as a lock; None otherwise."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return None
+    lowered = name.lower()
+    if "lock" not in lowered and "mutex" not in lowered:
+        return None
+    owner = class_name if class_name else module
+    return f"{owner}.{name}"
+
+
+class STO002LockOrder(ProjectRule):
+    id = "STO002"
+    title = "inconsistent nested lock acquisition order"
+
+    def check_project(
+        self, modules: Sequence[ModuleContext], config
+    ) -> Iterator[Finding]:
+        edges: dict[str, dict[str, tuple[str, int]]] = {}
+        scanned = False
+        for ctx in modules:
+            path = ctx.path.replace("\\", "/")
+            if not any(("/" + pat) in ("/" + path) for pat in config.sto002_paths):
+                continue
+            if not config.rule_enabled(self.id, ctx.path):
+                continue
+            scanned = True
+            module = path.rsplit("/", 1)[-1].removesuffix(".py")
+            self._collect(ctx, module, edges)
+        if not scanned:
+            return
+        yield from self._report_cycles(edges)
+
+    def _collect(
+        self,
+        ctx: ModuleContext,
+        module: str,
+        edges: dict[str, dict[str, tuple[str, int]]],
+    ) -> None:
+        def visit(node: ast.AST, class_name: str, held: tuple[str, ...]) -> None:
+            if isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, node.name, held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                # A function *defined* under a lock does not execute under
+                # it — a callback registered inside `with lock:` runs later,
+                # lock-free. Its body starts with an empty held set.
+                for child in ast.iter_child_nodes(node):
+                    visit(child, class_name, ())
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = list(held)
+                for item in node.items:
+                    label = _lock_label(item.context_expr, class_name, module)
+                    if label is None:
+                        continue
+                    for holder in acquired:
+                        if holder != label:  # reentrant re-acquire is RLock's job
+                            edges.setdefault(holder, {}).setdefault(
+                                label, (ctx.display_path, node.lineno)
+                            )
+                    acquired.append(label)
+                for child in node.body:
+                    visit(child, class_name, tuple(acquired))
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, class_name, held)
+
+        visit(ctx.tree, "", ())
+
+    def _report_cycles(
+        self, edges: dict[str, dict[str, tuple[str, int]]]
+    ) -> Iterator[Finding]:
+        # Iterative DFS cycle detection over the acquisition digraph; each
+        # cycle is reported once, anchored at its lexically-first edge.
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: dict[str, int] = {}
+        reported: set[frozenset[str]] = set()
+
+        def dfs(start: str) -> Iterator[Finding]:
+            stack: list[tuple[str, Iterator[str]]] = [(start, iter(edges.get(start, ())))]
+            path: list[str] = [start]
+            color[start] = GRAY
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if color.get(nxt, WHITE) == GRAY:
+                        cycle = path[path.index(nxt):] + [nxt]
+                        key = frozenset(cycle)
+                        if key not in reported:
+                            reported.add(key)
+                            locs = sorted(
+                                edges[a][b]
+                                for a, b in zip(cycle, cycle[1:])
+                                if b in edges.get(a, {})
+                            )
+                            display, line = locs[0]
+                            yield Finding(
+                                self.id, display, line, 1,
+                                "lock-order cycle: " + " -> ".join(cycle) + "; "
+                                "two paths acquire these locks in opposite orders "
+                                "(deadlock under the right interleaving)",
+                            )
+                    elif color.get(nxt, WHITE) == WHITE:
+                        color[nxt] = GRAY
+                        stack.append((nxt, iter(edges.get(nxt, ()))))
+                        path.append(nxt)
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    stack.pop()
+                    path.pop()
+
+        for start in sorted(edges):
+            if color.get(start, WHITE) == WHITE:
+                yield from dfs(start)

@@ -1,0 +1,2 @@
+# graphlint fixture: deliberately unparsable (LNT000); never imported.
+def f(:

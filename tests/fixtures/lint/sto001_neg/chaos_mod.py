@@ -1,0 +1,6 @@
+# graphlint fixture: STO001 negative — all three copies agree.
+REPLAY_UNSAFE_CHAOS_MATRIX = {
+    "create_thing": "scenario",
+    "set_thing": "scenario",
+    "delete_thing": "scenario",
+}

@@ -1,0 +1,2 @@
+# graphlint fixture: STO001 negative — all three copies agree.
+_OP_TOKEN_METHODS = frozenset({"create_thing", "set_thing", "delete_thing"})

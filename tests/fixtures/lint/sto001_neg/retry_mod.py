@@ -1,0 +1,4 @@
+# graphlint fixture: STO001 negative — all three copies agree.
+NON_IDEMPOTENT = frozenset({"create_thing"})
+
+REPLAY_UNSAFE_METHODS = NON_IDEMPOTENT | frozenset({"set_thing", "delete_thing"})

@@ -1,0 +1,7 @@
+# graphlint fixture: STO001 — this copy DRIFTED: 'rename_thing' is extra.
+REPLAY_UNSAFE_CHAOS_MATRIX = {  # EXPECT: STO001
+    "create_thing": "scenario",
+    "set_thing": "scenario",
+    "delete_thing": "scenario",
+    "rename_thing": "scenario",
+}

@@ -1,0 +1,2 @@
+# graphlint fixture: STO001 — this copy DRIFTED: 'delete_thing' is missing.
+_OP_TOKEN_METHODS = frozenset({"create_thing", "set_thing"})  # EXPECT: STO001

@@ -1,0 +1,37 @@
+# graphlint fixture: STO002 negatives — consistent order and reentrancy.
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def reentrant_ok(self):
+        with self._lock:
+            with self._lock:  # same lock: RLock reentrance, not an order edge
+                pass
+
+
+def ordered_one():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def ordered_two():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def register_callback(callbacks):
+    # A function *defined* under lock_b runs later, lock-free: no b->a edge.
+    with lock_b:
+        def cb():
+            with lock_a:
+                pass
+
+        callbacks.append(cb)

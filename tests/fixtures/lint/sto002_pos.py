@@ -1,0 +1,17 @@
+# graphlint fixture: STO002 positive — two locks taken in both orders.
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def path_one():
+    with lock_a:
+        with lock_b:  # EXPECT: STO002
+            pass
+
+
+def path_two():
+    with lock_b:
+        with lock_a:
+            pass

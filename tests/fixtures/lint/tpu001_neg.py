@@ -1,0 +1,29 @@
+# graphlint fixture: TPU001 negatives — none of these may fire.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def static_metadata_ok(x):
+    n = int(x.shape[0])  # shape is trace-static
+    m = float(x.ndim)
+    k = int(len(x.shape))
+    return x * n * m * k
+
+
+def host_code_ok(x):
+    # Not a traced scope: host conversions are the point of the boundary.
+    arr = np.asarray(x)
+    return float(arr.sum()) + arr.item()
+
+
+@jax.jit
+def jnp_ok(x):
+    return jnp.asarray(x) + jnp.array([1.0])
+
+
+@jax.jit
+def computed_default_ok(x, eps=float(np.finfo(np.float32).eps)):
+    # The default expression runs once at def time, on the host — not traced.
+    return x + eps

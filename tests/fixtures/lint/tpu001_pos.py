@@ -1,0 +1,31 @@
+# graphlint fixture: TPU001 positives (parsed, never executed).
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def bad_sync(x):
+    y = float(x)  # EXPECT: TPU001
+    z = x.item()  # EXPECT: TPU001
+    a = np.asarray(x)  # EXPECT: TPU001
+    x.block_until_ready()  # EXPECT: TPU001
+    return y + z + a
+
+
+@partial(jax.jit, static_argnames=("n",))
+def bad_loop_body(x, n):
+    def body(i, carry):
+        return carry + int(x)  # EXPECT: TPU001
+
+    return jax.lax.fori_loop(0, n, body, x)
+
+
+def host_wrapper(x):
+    # The while_loop body is traced even though host_wrapper is not jitted.
+    return jax.lax.while_loop(
+        lambda c: c[0] < 3,
+        lambda c: (c[0] + bool(c[1]), c[1]),  # EXPECT: TPU001
+        (0, x),
+    )

@@ -1,0 +1,18 @@
+# graphlint fixture: TPU002 negatives — none of these may fire.
+import functools
+
+import jax
+from functools import partial
+
+jitted_at_module_scope = jax.jit(lambda x: x)
+
+
+@functools.lru_cache(maxsize=None)
+def blessed_cached_factory(n):
+    # The lru_cache makes this once-per-key: no churn.
+    return jax.jit(lambda x: x * n, static_argnames=())
+
+
+@partial(jax.jit, static_argnames=("n",))
+def hashable_static_default(x, n=3):
+    return x * n

@@ -1,0 +1,24 @@
+# graphlint fixture: TPU002 positives.
+import jax
+from functools import partial
+
+
+def per_call_wrapper(f):
+    return jax.jit(f)  # EXPECT: TPU002
+
+
+def in_loop(fs):
+    out = []
+    for f in fs:
+        out.append(jax.jit(f))  # EXPECT: TPU002
+    return out
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def unhashable_static(x, opts=[]):  # EXPECT: TPU002
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def unhashable_static_num(x, table={}):  # EXPECT: TPU002
+    return x

@@ -1,0 +1,11 @@
+# graphlint fixture: TPU003 negatives — none of these may fire.
+import jax.numpy as jnp
+import numpy as np
+
+SCALE = np.float32(2.0)
+
+
+def f32_disciplined(x):
+    a = jnp.asarray(x, dtype=jnp.float32)
+    b = np.zeros(3, dtype="float32")
+    return a, b

@@ -1,0 +1,16 @@
+# graphlint fixture: TPU003 positives (file is device-classified by the test).
+import jax.numpy as jnp
+import numpy as np
+
+SCALE = np.float64(2.0)  # EXPECT: TPU003
+
+
+def widen(x):
+    a = jnp.float64(x)  # EXPECT: TPU003
+    b = jnp.asarray(x, dtype="float64")  # EXPECT: TPU003
+    return a + b
+
+
+def allowed_host_boundary(x):
+    # The test's config allowlists this function name: no finding here.
+    return np.float64(x)

@@ -1,0 +1,15 @@
+# graphlint fixture: TPU004 negatives — none of these may fire.
+from optuna_tpu.logging import get_logger
+
+_logger = get_logger(__name__)
+
+
+class Report:
+    def print(self):
+        return "rendered"
+
+
+def quiet(x, sink):
+    _logger.info("proper logging")
+    sink.print()  # a method named print on another object is fine
+    return x

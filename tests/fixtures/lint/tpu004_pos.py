@@ -1,0 +1,8 @@
+# graphlint fixture: TPU004 positives.
+import jax
+
+
+def leaky(x):
+    print("debugging", x)  # EXPECT: TPU004
+    jax.debug.print("x = {}", x)  # EXPECT: TPU004
+    return x

@@ -1,0 +1,280 @@
+"""graphlint: tier-1 gate over the package + fixture self-tests per rule.
+
+The gate (`test_package_lint_clean`) is the contract from ISSUE 2: the full
+rule set over ``optuna_tpu`` must report zero unsuppressed findings, so a
+stray host sync, f64 widen, print, lock-order cycle, or a replay-unsafe
+registry drifting from ``optuna_tpu/_lint/registry.py`` fails CI.
+
+Fixture self-tests prove each rule fires where a ``# EXPECT: RULE`` marker
+says (exact rule id AND line number) and stays silent on the negative twin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from optuna_tpu._lint import Config, all_rules, load_config, run_lint
+from optuna_tpu._lint import registry as lint_registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "optuna_tpu")
+PYPROJECT = os.path.join(REPO_ROOT, "pyproject.toml")
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z]{2,3}\d{3})")
+
+
+def expected_markers(*paths: str) -> set[tuple[str, str, int]]:
+    """(rule, filename, line) triples declared by ``# EXPECT: RULE`` comments."""
+    out: set[tuple[str, str, int]] = set()
+    for path in paths:
+        with open(path) as f:
+            for lineno, line in enumerate(f, start=1):
+                for rule in _EXPECT_RE.findall(line):
+                    out.add((rule, os.path.basename(path), lineno))
+    return out
+
+
+def found_triples(result) -> set[tuple[str, str, int]]:
+    return {(f.rule, os.path.basename(f.path), f.line) for f in result.findings}
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# --------------------------------------------------------------------- gate
+
+
+@pytest.fixture(scope="module")
+def package_scan():
+    """One full-package scan shared by the gate assertions (keeps tier-1 lean)."""
+    return run_lint([PKG], load_config(PYPROJECT))
+
+
+def test_package_lint_clean(package_scan):
+    """THE tier-1 gate: zero unsuppressed findings over the whole package."""
+    formatted = "\n".join(f.format() for f in package_scan.findings)
+    assert not package_scan.findings, f"graphlint found unsuppressed violations:\n{formatted}"
+    assert package_scan.files_scanned > 100  # the walk really covered the package
+
+
+def test_every_suppression_carries_a_reason(package_scan):
+    """Every pragma in the tree parses with a non-empty reason (LNT001 covers
+    malformed ones in the gate; this asserts the well-formed ones are real)."""
+    assert package_scan.suppressed, "expected at least one documented pragma in the tree"
+    for finding, pragma in package_scan.suppressed:
+        assert pragma.reason.strip(), f"reason-less pragma suppressed {finding.format()}"
+
+
+def test_sto001_registry_matches_runtime_sets():
+    """Belt and braces: the canonical registry equals the *runtime* values of
+    all three hand-written copies (the lint compares them statically)."""
+    from optuna_tpu.storages._grpc import client as grpc_client
+    from optuna_tpu.storages._retry import REPLAY_UNSAFE_METHODS
+    from optuna_tpu.testing.fault_injection import REPLAY_UNSAFE_CHAOS_MATRIX
+
+    canonical = set(lint_registry.REPLAY_UNSAFE_REGISTRY)
+    assert set(REPLAY_UNSAFE_METHODS) == canonical
+    assert set(grpc_client._OP_TOKEN_METHODS) == canonical
+    assert set(REPLAY_UNSAFE_CHAOS_MATRIX) == canonical
+
+
+def test_sto001_gate_rejects_drift():
+    """Point STO001 at the real files with a registry containing a method the
+    code does not know: every copy must be reported as drifted."""
+    fat_registry = dict(lint_registry.REPLAY_UNSAFE_REGISTRY)
+    fat_registry["set_trial_galaxy"] = "made-up write to prove the check is live"
+    config = Config(sto001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.sto001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "STO001"]
+    assert len(drifted) == 3, [f.format() for f in result.findings]
+    assert all("set_trial_galaxy" in f.message for f in drifted)
+
+
+# ------------------------------------------------------- fixture self-tests
+
+
+def _device_config(name: str, **kwargs) -> Config:
+    return Config(device_paths=(f"fixtures/lint/{name}",), base_dir=REPO_ROOT, **kwargs)
+
+
+RULE_CASES = [
+    ("tpu001", lambda name: _device_config(name)),
+    ("tpu002", lambda name: Config(base_dir=REPO_ROOT)),
+    (
+        "tpu003",
+        lambda name: _device_config(
+            name,
+            host_boundary_f64={
+                f"fixtures/lint/{name}": {"allowed_host_boundary": "fixture allowlist"}
+            },
+        ),
+    ),
+    ("tpu004", lambda name: Config(base_dir=REPO_ROOT)),
+    ("py001", lambda name: Config(base_dir=REPO_ROOT)),
+    ("sto002", lambda name: Config(base_dir=REPO_ROOT, sto002_paths=("fixtures/lint/",))),
+]
+
+
+@pytest.mark.parametrize("stem,make_config", RULE_CASES, ids=[c[0] for c in RULE_CASES])
+def test_rule_fires_exactly_where_expected(stem, make_config):
+    pos = fixture(f"{stem}_pos.py")
+    result = run_lint([pos], make_config(f"{stem}_pos.py"))
+    expected = expected_markers(pos)
+    assert expected, f"{pos} declares no EXPECT markers"
+    assert found_triples(result) == expected
+
+
+@pytest.mark.parametrize("stem,make_config", RULE_CASES, ids=[c[0] for c in RULE_CASES])
+def test_rule_does_not_overfire(stem, make_config):
+    neg = fixture(f"{stem}_neg.py")
+    result = run_lint([neg], make_config(f"{stem}_neg.py"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
+_STO001_FIXTURE_REGISTRY = {
+    "create_thing": "replay mints a twin",
+    "set_thing": "replay loses its own race",
+    "delete_thing": "replay raises KeyError",
+}
+
+
+def _sto001_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        sto001_registry=_STO001_FIXTURE_REGISTRY,
+        sto001_targets=(
+            (f"fixtures/lint/{tree}/retry_mod.py", "REPLAY_UNSAFE_METHODS", "pass-through"),
+            (f"fixtures/lint/{tree}/client_mod.py", "_OP_TOKEN_METHODS", "op tokens"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "REPLAY_UNSAFE_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_sto001_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "sto001_pos")
+    result = run_lint([tree], _sto001_config("sto001_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "missing" in by_file["client_mod.py"]
+    assert "rename_thing" in by_file["chaos_mod.py"]
+
+
+def test_sto001_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "sto001_neg")
+    result = run_lint([tree], _sto001_config("sto001_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
+# ------------------------------------------------------------------ pragmas
+
+
+def test_pragma_with_reason_suppresses():
+    result = run_lint([fixture("pragma_ok.py")], Config(base_dir=REPO_ROOT))
+    assert not result.findings, [f.format() for f in result.findings]
+    assert len(result.suppressed) == 2
+    assert all(p.reason for _, p in result.suppressed)
+
+
+def test_pragma_without_reason_is_rejected():
+    result = run_lint([fixture("pragma_missing_reason.py")], Config(base_dir=REPO_ROOT))
+    rules = {f.rule for f in result.findings}
+    assert rules == {"LNT001", "TPU004"}  # pragma reported AND nothing hidden
+    assert not result.suppressed
+
+
+# ------------------------------------------------------- config + CLI surface
+
+
+def test_per_path_override_disables_rule():
+    from optuna_tpu._lint.config import PathOverride
+
+    config = Config(
+        base_dir=REPO_ROOT,
+        overrides=(PathOverride(paths=("fixtures/lint",), disable=("TPU004",)),),
+    )
+    result = run_lint([fixture("tpu004_pos.py")], config)
+    assert not result.findings
+
+
+def test_global_disable_and_enable():
+    assert not run_lint(
+        [fixture("py001_pos.py")], Config(disable=("PY001",), base_dir=REPO_ROOT)
+    ).findings
+    only_tpu4 = run_lint(
+        [fixture("py001_pos.py"), fixture("tpu004_pos.py")],
+        Config(enable=("TPU004",), base_dir=REPO_ROOT),
+    )
+    assert {f.rule for f in only_tpu4.findings} == {"TPU004"}
+
+
+def test_enable_allowlist_keeps_engine_diagnostics():
+    """enable=["TPU001"] selects rules to run; a syntax-broken file must still
+    surface as LNT000, never lint clean."""
+    result = run_lint(
+        [fixture("broken_syntax.py")], Config(enable=("TPU001",), base_dir=REPO_ROOT)
+    )
+    assert {f.rule for f in result.findings} == {"LNT000"}
+    # ...but an explicit disable still silences it.
+    result = run_lint(
+        [fixture("broken_syntax.py")], Config(disable=("LNT000",), base_dir=REPO_ROOT)
+    )
+    assert not result.findings
+
+
+def test_overlapping_input_paths_deduplicate():
+    """dir + nested file on the command line must not double-report."""
+    result = run_lint(
+        [FIXTURES, fixture("tpu004_pos.py")], Config(enable=("TPU004",), base_dir=REPO_ROOT)
+    )
+    tpu004 = [f for f in result.findings if "tpu004_pos" in f.path]
+    assert len(tpu004) == 2  # once per violation, not twice per overlap
+
+
+def test_lnt_rules_are_config_disableable():
+    """LNT000/LNT001 honor disable/overrides like any other rule (vendored
+    trees with pragma-like comments must be silenceable without exclude)."""
+    result = run_lint(
+        [fixture("pragma_missing_reason.py")],
+        Config(disable=("LNT001",), base_dir=REPO_ROOT),
+    )
+    assert {f.rule for f in result.findings} == {"TPU004"}  # still not suppressed
+
+
+def test_cli_json_format_and_exit_codes(capsys):
+    from optuna_tpu._lint.cli import main
+
+    rc = main([fixture("tpu004_pos.py"), "--no-config", "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(payload["findings"]) == 2
+    assert {f["rule"] for f in payload["findings"]} == {"TPU004"}
+
+    rc = main([fixture("tpu004_neg.py"), "--no-config", "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["findings"] == []
+
+
+def test_module_entrypoint_runs_clean_on_package():
+    """`python -m optuna_tpu._lint optuna_tpu` exits 0 on the final tree —
+    the exact invocation the acceptance criteria names."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "optuna_tpu._lint", "optuna_tpu"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
