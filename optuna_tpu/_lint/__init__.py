@@ -14,6 +14,7 @@ TPU003   float64 in an f32-hardened device module
 TPU004   stray print / jax.debug.print in package code
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
+EXE001   non-finite quarantine policy sets drifted from the canonical one
 PY001    broad ``except Exception`` without a documented reason
 LNT000   file failed to parse
 LNT001   malformed suppression pragma (reason is mandatory)
@@ -45,7 +46,11 @@ def all_rules() -> list[Rule]:
         TPU004StrayDebugOutput,
     )
     from optuna_tpu._lint.rules_py import PY001BroadExcept
-    from optuna_tpu._lint.rules_storage import STO001ReplayRegistrySync, STO002LockOrder
+    from optuna_tpu._lint.rules_storage import (
+        EXE001NonFinitePolicySync,
+        STO001ReplayRegistrySync,
+        STO002LockOrder,
+    )
 
     return [
         TPU001HostSyncInJit(),
@@ -54,5 +59,6 @@ def all_rules() -> list[Rule]:
         TPU004StrayDebugOutput(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
+        EXE001NonFinitePolicySync(),
         PY001BroadExcept(),
     ]

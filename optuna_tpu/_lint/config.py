@@ -77,6 +77,10 @@ class Config:
     sto001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.REPLAY_UNSAFE_REGISTRY
     )
+    exe001_targets: tuple[tuple[str, str, str], ...] = registry.EXE001_TARGETS
+    exe001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.NON_FINITE_POLICY_REGISTRY
+    )
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)
     base_dir: str | None = None  # dir containing the config file, for display paths
 

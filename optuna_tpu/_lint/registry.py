@@ -8,6 +8,9 @@ in reviewers' heads:
   Three code sites carry a hand-written copy of this set, each for a
   different reason (see :data:`STO001_TARGETS`); rule **STO001** fails the
   lint if any copy drifts from this registry.
+* :data:`NON_FINITE_POLICY_REGISTRY` — the batch executor's non-finite
+  quarantine policies; rule **EXE001** keeps the executor's literal set and
+  the fault-injection chaos matrix in sync (see :data:`EXE001_TARGETS`).
 * :data:`DEVICE_MODULE_PATHS` — the f32-hardened, sync-free modules where
   the TPU rules apply. Everything the paper's "one fused dispatch per
   suggestion" latency argument rests on lives here.
@@ -56,13 +59,41 @@ STO001_TARGETS: tuple[tuple[str, str, str], ...] = (
     ),
 )
 
+#: The non-finite quarantine policies the vectorized batch executor
+#: accepts, with the containment semantics each one promises. Two code
+#: sites carry a hand-written copy (see :data:`EXE001_TARGETS`); rule
+#: **EXE001** fails the lint if either drifts from this registry.
+NON_FINITE_POLICY_REGISTRY: dict[str, str] = {
+    "fail": "quarantine: non-finite trials are told FAIL; the rest of the batch completes",
+    "raise": "strict: quarantine as FAIL first, then raise to the caller",
+    "clip": "degrade: nan_to_num in-graph; every trial completes with finite values",
+}
+
+#: The hand-maintained copies EXE001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+EXE001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/parallel/executor.py",
+        "NON_FINITE_POLICIES",
+        "the executor's accepted policy literals (validated at construction)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "NON_FINITE_CHAOS_POLICIES",
+        "chaos matrix: every quarantine policy must have an injection scenario",
+    ),
+)
+
 #: Path fragments (posix, package-qualified) classifying a module as a
 #: device module: f32-hardened, host-sync-free inside jit. A trailing slash
-#: means "the whole subtree".
+#: means "the whole subtree". Mirrored by ``[tool.graphlint] device-paths``
+#: in pyproject.toml (tests/test_lint.py asserts the two stay identical).
 DEVICE_MODULE_PATHS: tuple[str, ...] = (
     "optuna_tpu/ops/",
     "optuna_tpu/gp/",
     "optuna_tpu/samplers/_tpe/_kernels.py",
+    "optuna_tpu/parallel/executor.py",
 )
 
 #: Reviewed host-boundary functions allowed to touch float64 inside device

@@ -1,12 +1,15 @@
-"""Storage-concurrency rules: STO001 replay-unsafe registry sync,
-STO002 nested-lock acquisition order.
+"""Registry-sync and storage-concurrency rules: STO001 replay-unsafe
+registry sync, EXE001 non-finite policy sync, STO002 nested-lock
+acquisition order.
 
 STO001 is the anti-drift rule PR 1 made necessary: the set of storage
 writes that must not be blindly replayed exists in three hand-written
 copies (RetryingStorage's pass-through set, the gRPC client's op-token
 wire constant, the fault-injection chaos matrix). Each copy is compared
 — statically, by AST constant evaluation, without importing the modules —
-against the canonical ``registry.REPLAY_UNSAFE_REGISTRY``.
+against the canonical ``registry.REPLAY_UNSAFE_REGISTRY``. EXE001 is the
+same machinery (:class:`_RegistrySyncRule`) pointed at the batch
+executor's non-finite quarantine policy literals and their chaos matrix.
 
 STO002 builds the lock-acquisition graph from lexical ``with`` nesting
 across the storage layer and flags cycles: two locks taken in both orders
@@ -84,15 +87,30 @@ def _module_const_sets(tree: ast.Module) -> dict[str, tuple[frozenset[str], int]
     return {name: (env[name], lines[name]) for name in env}
 
 
-class STO001ReplayRegistrySync(ProjectRule):
-    id = "STO001"
-    title = "replay-unsafe write registries out of sync"
+class _RegistrySyncRule(ProjectRule):
+    """Shared engine for canonical-registry anti-drift rules.
+
+    Subclasses name a canonical ``{entry: reason}`` map and a target list of
+    ``(path suffix, symbol, why)`` hand-written copies; each copy must
+    statically evaluate (AST constant evaluation, no imports) to exactly the
+    registry's key set.
+    """
+
+    #: What the registry's entries are, for messages ("replay-unsafe methods").
+    noun = "entries"
+
+    def _canonical(self, config) -> dict:
+        raise NotImplementedError
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        raise NotImplementedError
 
     def check_project(
         self, modules: Sequence[ModuleContext], config
     ) -> Iterator[Finding]:
-        canonical = frozenset(config.sto001_registry)
-        for suffix, symbol, why in config.sto001_targets:
+        canonical_map = self._canonical(config)
+        canonical = frozenset(canonical_map)
+        for suffix, symbol, why in self._targets(config):
             ctx = next(
                 (m for m in modules if m.path.replace("\\", "/").endswith(suffix)), None
             )
@@ -105,19 +123,17 @@ class STO001ReplayRegistrySync(ProjectRule):
                 yield Finding(
                     self.id, ctx.display_path, 1, 1,
                     f"expected module-level '{symbol}' ({why}) statically evaluable "
-                    "to the replay-unsafe method set; not found",
+                    f"to the canonical set of {self.noun}; not found",
                 )
                 continue
             found, line = const_sets[symbol]
             missing = sorted(canonical - found)
             extra = sorted(found - canonical)
             if missing:
-                reasons = "; ".join(
-                    f"{m}: {config.sto001_registry[m]}" for m in missing
-                )
+                reasons = "; ".join(f"{m}: {canonical_map[m]}" for m in missing)
                 yield Finding(
                     self.id, ctx.display_path, line, 1,
-                    f"'{symbol}' ({why}) is missing replay-unsafe methods "
+                    f"'{symbol}' ({why}) is missing {self.noun} "
                     f"[{', '.join(missing)}] — {reasons}",
                 )
             if extra:
@@ -127,6 +143,30 @@ class STO001ReplayRegistrySync(ProjectRule):
                     "canonical registry (optuna_tpu/_lint/registry.py) does not; "
                     "either update the registry everywhere or drop the entry",
                 )
+
+
+class STO001ReplayRegistrySync(_RegistrySyncRule):
+    id = "STO001"
+    title = "replay-unsafe write registries out of sync"
+    noun = "replay-unsafe methods"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.sto001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.sto001_targets
+
+
+class EXE001NonFinitePolicySync(_RegistrySyncRule):
+    id = "EXE001"
+    title = "non-finite quarantine policy sets out of sync"
+    noun = "non-finite policies"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.exe001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.exe001_targets
 
 
 # --------------------------------------------------------------------- STO002

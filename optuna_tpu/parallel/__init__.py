@@ -7,12 +7,30 @@ This package adds the TPU-native tier on top:
 * :mod:`vectorized` — batch ask -> shard_map objective evaluation over a
   ``jax.sharding.Mesh`` -> batch tell: hundreds of trials advance per device
   dispatch instead of one (BASELINE config #5);
+* :mod:`executor` — the fault-tolerant dispatch loop behind
+  ``optimize_vectorized``: non-finite quarantine, crash bisection, OOM
+  batch-halving, batch heartbeat failover, dispatch deadlines — the batch
+  is the unit of failure, not just of dispatch;
 * :mod:`ici_journal` — a journal backend whose sync primitive is an XLA
   allgather over the mesh (ICI) instead of a POSIX file, so intra-slice
   trial synchronization never leaves the interconnect.
 """
 
+from optuna_tpu.parallel.executor import (
+    NON_FINITE_POLICIES,
+    DispatchTimeoutError,
+    NonFiniteObjectiveError,
+    ResilientBatchExecutor,
+)
 from optuna_tpu.parallel.ici_journal import IciJournalBackend
 from optuna_tpu.parallel.vectorized import VectorizedObjective, optimize_vectorized
 
-__all__ = ["IciJournalBackend", "VectorizedObjective", "optimize_vectorized"]
+__all__ = [
+    "DispatchTimeoutError",
+    "IciJournalBackend",
+    "NON_FINITE_POLICIES",
+    "NonFiniteObjectiveError",
+    "ResilientBatchExecutor",
+    "VectorizedObjective",
+    "optimize_vectorized",
+]

@@ -1,0 +1,621 @@
+"""Resilient batched trial execution: the batch as the unit of *failure*.
+
+``optimize_vectorized`` advances B trials per sharded device dispatch — but a
+batch that can only succeed atomically turns one poison trial into B lost
+trials. This module owns the containment layers that make partial-batch
+failure survivable (ARCHITECTURE.md "Batch fault tolerance" has the full
+failure matrix):
+
+1. **Non-finite quarantine** — the jitted wrapper returns a device-side
+   ``jnp.isfinite`` mask alongside the values (computed in-graph; no host
+   sync inside the trace), so NaN/Inf trials are told ``FAIL`` under a
+   ``non_finite=`` policy (:data:`NON_FINITE_POLICIES`) while the rest of
+   the batch completes. Sampler fits (GP/TPE/CMA-ES) never ingest NaN.
+2. **Crash containment + bisection** — a dispatch that raises marks its
+   trials FAIL instead of stranding them RUNNING; with
+   ``bisect_on_error=True`` the batch is first split recursively
+   (≤ 2·log₂B re-dispatches) so a single poison trial fails alone and the
+   healthy B-1 are salvaged. ``RESOURCE_EXHAUSTED``-shaped errors instead
+   halve the running batch size under the :class:`RetryPolicy` backoff
+   schedule until the dispatch fits.
+3. **Preemption failover** — the whole batch shares one
+   :class:`HeartbeatThread`; ``fail_stale_trials`` runs at every batch
+   boundary, so a SIGKILL'd worker's stranded batch is reaped by survivors
+   and re-enqueued by ``RetryFailedTrialCallback`` (fixed-params lineage
+   round-trips through ``ask_batch``, which claims WAITING clones first).
+4. **Dispatch deadline** — an injectable-clock watchdog bounds a hung
+   device dispatch and converts it into the same FAIL/containment path.
+
+Worker *death* (``BaseException``: SIGKILL stand-ins, ``SystemExit``,
+Ctrl-C) deliberately punches through every layer here — a dead worker never
+gets to tell, and layer 3 exists precisely to reap what it strands.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import TYPE_CHECKING, Callable, Sequence
+
+import numpy as np
+
+from optuna_tpu.exceptions import OptunaTPUError, UpdateFinishedTrialError
+from optuna_tpu.logging import get_logger
+from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
+from optuna_tpu.storages._heartbeat import (
+    fail_stale_trials,
+    get_batch_heartbeat_thread,
+    is_heartbeat_enabled,
+)
+from optuna_tpu.storages._retry import RetryPolicy
+from optuna_tpu.trial._state import TrialState
+from optuna_tpu.trial._trial import Trial
+
+if TYPE_CHECKING:
+    import jax
+
+    from optuna_tpu.parallel.vectorized import VectorizedObjective
+    from optuna_tpu.study.study import Study
+    from optuna_tpu.trial._frozen import FrozenTrial
+
+_logger = get_logger(__name__)
+
+
+#: The accepted ``non_finite=`` policy literals and what each does to a
+#: quarantined (NaN/±Inf) trial. Canonical copy: graphlint rule **EXE001**
+#: cross-checks this set against ``_lint/registry.py::
+#: NON_FINITE_POLICY_REGISTRY`` and the chaos matrix in
+#: ``testing/fault_injection.py`` — adding a policy here without a chaos
+#: scenario is a lint failure.
+NON_FINITE_POLICIES: dict[str, str] = {
+    "fail": "quarantine: non-finite trials are told FAIL; the rest of the batch completes",
+    "raise": "strict: quarantine as FAIL first, then raise NonFiniteObjectiveError",
+    "clip": "degrade: values pass through jnp.nan_to_num in-graph; every trial completes",
+}
+
+
+class DispatchTimeoutError(OptunaTPUError, TimeoutError):
+    """A device dispatch overran ``dispatch_deadline_s`` and was abandoned."""
+
+
+class NonFiniteObjectiveError(OptunaTPUError, ValueError):
+    """Raised under ``non_finite='raise'`` *after* the poisoned trials were
+    quarantined as FAIL — the study is left containment-clean either way."""
+
+
+def build_non_finite_guard(fn: Callable, *, clip: bool) -> Callable:
+    """Wrap a batched objective so the dispatch returns ``(values, finite)``.
+
+    ``finite`` is a per-trial bool vector computed **in-graph**
+    (``jnp.isfinite``, reduced over the objective axis for multi-objective
+    values) — the quarantine decision ships back with the values in the same
+    dispatch, costing zero extra host round-trips. With ``clip`` the values
+    are additionally passed through ``jnp.nan_to_num`` on device (NaN→0,
+    ±Inf→finite extremes) while ``finite`` still reports the *raw* mask so
+    callers can log what was clipped.
+    """
+    import jax.numpy as jnp
+
+    def _guard(params):
+        values = fn(params)
+        finite = jnp.isfinite(values)
+        if finite.ndim > 1:
+            finite = finite.all(axis=-1)
+        if clip:
+            values = jnp.nan_to_num(values)
+        return values, finite
+
+    return _guard
+
+
+def _is_oom_error(err: BaseException) -> bool:
+    """XLA surfaces allocation failure as RESOURCE_EXHAUSTED (or an 'out of
+    memory' message, backend-dependent); classify by text so the stub-safe
+    path needs no jaxlib import."""
+    text = f"{type(err).__name__}: {err}"
+    return "RESOURCE_EXHAUSTED" in text or "out of memory" in text.lower()
+
+
+class ResilientBatchExecutor:
+    """Fault-tolerant engine behind :func:`optimize_vectorized`.
+
+    One instance = one ``run`` loop over a study; the compiled (guarded)
+    objective wrapper is memoized on the objective itself, so executors are
+    cheap to construct per call.
+    """
+
+    def __init__(
+        self,
+        study: "Study",
+        objective: "VectorizedObjective",
+        *,
+        batch_size: int | None = None,
+        mesh: "jax.sharding.Mesh | None" = None,
+        batch_axis: str = "trials",
+        callbacks: Sequence[Callable] | None = None,
+        non_finite: str = "fail",
+        bisect_on_error: bool = True,
+        retry_policy: RetryPolicy | None = None,
+        dispatch_deadline_s: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if non_finite not in NON_FINITE_POLICIES:
+            raise ValueError(
+                f"non_finite must be one of {sorted(NON_FINITE_POLICIES)}; "
+                f"got {non_finite!r}."
+            )
+        if batch_size is not None and batch_size < 1:
+            # An empty batch would loop forever in run(): ask_batch(0)
+            # returns [] and `done` never advances.
+            raise ValueError(f"batch_size must be >= 1; got {batch_size}.")
+        self._study = study
+        self._objective = objective
+        self._mesh = mesh
+        self._batch_axis = batch_axis
+        self._callbacks = list(callbacks or ())
+        self._non_finite = non_finite
+        self._bisect = bisect_on_error
+        self._policy = retry_policy if retry_policy is not None else RetryPolicy()
+        # Leaf/timeout strikes share the retry policy's attempt count but
+        # with a floor of 2: max_attempts is documented as pacing OOM
+        # halving, so a user lowering it to 1 to cut OOM retries must not
+        # unknowingly set poison-trial tolerance to zero — with a budget of
+        # 1 the very first bisection leaf would re-raise before any healthy
+        # trial was salvaged, contradicting the "poison trial FAILs alone,
+        # B-1 COMPLETE" contract.
+        self._strike_budget = max(2, self._policy.max_attempts)
+        self._deadline_s = dispatch_deadline_s
+        self._clock = clock
+        self._n_dev = len(mesh.devices.flat) if mesh is not None else 1
+        if batch_size is None:
+            batch_size = self._n_dev if mesh is not None else 8
+        self._batch_size = batch_size
+        self._requested_batch_size = batch_size
+        self._grow_streak = 0
+        self._oom_seen = False
+        self._oom_attempts = 0
+        self._timeout_strikes = 0
+        self._timeout_width = 0
+        self._leaf_strikes = 0
+        self._batch_seq = 0
+        self._guarded = objective.guarded(mesh, batch_axis, non_finite)
+        # Distinguishes this executor's dispatch bookkeeping from any other
+        # worker's in the shared storage (debuggability, not correctness).
+        self._run_token = f"{os.getpid():x}.{id(self) & 0xFFFFFF:x}"
+
+    # ------------------------------------------------------------------- loop
+
+    def run(self, n_trials: int) -> None:
+        """Advance ``n_trials`` trials in device-wide batches, containing
+        per-batch faults so no trial is ever left RUNNING by a survivable
+        failure."""
+        study = self._study
+        if study._thread_local.in_optimize_loop:
+            # Parity with the serial loop's guard: a nested run() launched
+            # from a callback would clobber the outer loop's pending stop()
+            # via the reset below.
+            raise RuntimeError(
+                "Nested invocation of `optimize_vectorized` isn't allowed."
+            )
+        study._stop_flag = False
+        study._thread_local.in_optimize_loop = True  # callbacks may stop()
+        try:
+            done = 0
+            while done < n_trials and not study._stop_flag:
+                if is_heartbeat_enabled(study._storage):
+                    # Batch boundary reap: a dead peer's stranded batch is
+                    # failed + re-enqueued before we ask, so ask_batch below
+                    # claims the WAITING clones first.
+                    fail_stale_trials(study)
+                b = min(self._batch_size, n_trials - done)
+                size_before = self._batch_size
+                self._oom_seen = False
+                trials, proposals = self._ask_batch(b)
+                try:
+                    # Parameter suggestion runs *inside* the heartbeat
+                    # (whose __enter__ records a synchronous first beat, so
+                    # a worker killed mid-suggest still strands a reapable
+                    # batch).
+                    with get_batch_heartbeat_thread(
+                        [t._trial_id for t in trials], study._storage
+                    ):
+                        self._prepare_batch(trials, proposals)
+                        self._run_batch(trials)
+                except Exception as err:  # graphlint: ignore[PY001] -- last-line containment sweep: whatever escaped between ask and tell must not leave trials RUNNING; the original error re-raises below. BaseException (worker death) punches through for heartbeat failover
+                    # Catch-all sweep over the batch: anything that escaped
+                    # the inner containment — the heartbeat's first beat, a
+                    # sampler raising mid-suggest, a user callback raising
+                    # mid-notify, a storage blip during containment itself —
+                    # must not leave created-or-evaluated trials RUNNING
+                    # (on a heartbeat-less storage nothing would ever reap
+                    # them). _fail_trials skips already-terminal trials, so
+                    # the sweep is idempotent over whatever containment did
+                    # manage to commit.
+                    try:
+                        self._fail_trials(trials, f"batch aborted: {err!r}")
+                    except Exception as sweep_err:  # graphlint: ignore[PY001] -- the storage is down mid-sweep; the original batch error matters more than the sweep's, so log and fall through to the raise
+                        _logger.warning(
+                            f"containment sweep after a batch error itself "
+                            f"raised {sweep_err!r}; surfacing the original "
+                            "error."
+                        )
+                    raise
+                done += len(trials)
+                self._maybe_grow(len(trials), size_before)
+        finally:
+            study._thread_local.in_optimize_loop = False
+
+    # ----------------------------------------------------------------- phases
+
+    def _maybe_grow(self, batch_width: int, size_before: int) -> None:
+        """Probationary regrowth after an OOM clamp: a transient allocator
+        failure (or a poison error whose text merely *looked* OOM-shaped)
+        must not permanently halve throughput for the rest of the study.
+        Two consecutive clean full-width batches buy one doubling back
+        toward the requested size; a recurring genuine OOM re-clamps and
+        resets the streak, so at worst the probe costs one extra OOM round
+        per two clean batches."""
+        if self._batch_size < size_before or self._oom_seen:
+            # This batch clamped — or a bisection sub-dispatch hit an OOM
+            # that was contained without clamping: either way it showed
+            # memory pressure and is not clean.
+            self._grow_streak = 0
+            return
+        if (
+            self._batch_size >= self._requested_batch_size
+            or batch_width < self._batch_size  # tail batch: not capacity evidence
+        ):
+            return
+        self._grow_streak += 1
+        if self._grow_streak >= 2:
+            self._grow_streak = 0
+            self._batch_size = min(self._requested_batch_size, self._batch_size * 2)
+            _logger.info(
+                f"two clean batches at the clamped width; growing batch_size "
+                f"back to {self._batch_size}."
+            )
+
+    def _ask_batch(self, b: int) -> tuple[list[Trial], list | None]:
+        """Create the batch's trials (one storage commit). A sampler that
+        raises in ``sample_relative_batch`` escapes *before* any trial
+        exists — nothing to contain."""
+        study = self._study
+        proposals = None
+        if hasattr(study.sampler, "sample_relative_batch"):
+            proposals = study.sampler.sample_relative_batch(
+                study, self._objective.search_space, b
+            )
+        return study.ask_batch(b), proposals
+
+    def _prepare_batch(self, trials: list[Trial], proposals: list | None) -> None:
+        """Suggest every trial's parameters and tag dispatch bookkeeping.
+        Runs inside the batch heartbeat and under run()'s setup containment."""
+        study = self._study
+        space = self._objective.search_space
+        batch_tag = f"{self._run_token}/{self._batch_seq}"
+        self._batch_seq += 1
+        # Dispatch bookkeeping (which physical batch/slot a trial rode) only
+        # matters where failover can strand a batch — heartbeat storages,
+        # which already pay per-trial liveness writes. Elsewhere it would be
+        # B extra round trips against the one-commit-per-batch design.
+        tag_dispatch = is_heartbeat_enabled(study._storage)
+        for i, trial in enumerate(trials):
+            if proposals is not None:
+                trial.relative_search_space = space
+                trial.relative_params = proposals[i]
+            for name, dist in space.items():
+                # Claimed retry clones carry fixed_params, which _suggest
+                # honors before any sampler proposal — lineage round-trips.
+                trial._suggest(name, dist)
+            if tag_dispatch:
+                study._storage.set_trial_system_attr(
+                    trial._trial_id,
+                    EXECUTOR_ATTR_PREFIX + "dispatch",
+                    {"batch": batch_tag, "slot": i},
+                )
+
+    def _run_batch(self, trials: list[Trial]) -> None:
+        """Evaluate + tell one (sub-)batch with full containment."""
+        try:
+            values, finite = self._eval(trials)
+        except Exception as err:  # graphlint: ignore[PY001] -- containment boundary: every dispatch error becomes FAIL tells (plus bisection/halving); BaseException (worker death, Ctrl-C) punches through for heartbeat failover
+            self._contain(trials, err)
+            return
+        self._tell_batch(trials, values, finite)
+
+    def _eval(self, trials: list[Trial]) -> tuple[np.ndarray, np.ndarray]:
+        import jax.numpy as jnp
+
+        from optuna_tpu.parallel.vectorized import _pack_params
+
+        b = len(trials)
+        if self._mesh is not None and b % self._n_dev != 0:
+            # Minimum SPMD-valid padding (see vectorized.py's tail rationale).
+            b_eval = ((b + self._n_dev - 1) // self._n_dev) * self._n_dev
+        else:
+            b_eval = b
+        packed = _pack_params(trials, self._objective.search_space)
+        if b_eval > b:
+            packed = {
+                k: np.concatenate([v, np.repeat(v[-1:], b_eval - b, axis=0)])
+                for k, v in packed.items()
+            }
+        values, finite = self._dispatch({k: jnp.asarray(v) for k, v in packed.items()})
+        # A dispatch completed: the device is alive and the width fits.
+        self._oom_attempts = 0
+        self._leaf_strikes = 0
+        if b >= self._timeout_width:
+            # Hang evidence clears only at (or above) the width that hung: a
+            # width-dependent deadlock whose bisected halves always complete
+            # must still exhaust the strike budget, or every full-width
+            # batch would leak one abandoned watchdog thread (and its
+            # pinned device buffers) for the whole study.
+            self._timeout_strikes = 0
+            self._timeout_width = 0
+        return values[:b], finite[:b]
+
+    def _realize(self, args: dict) -> tuple[np.ndarray, np.ndarray]:
+        """Call the guarded objective and block for its *realized* host
+        values — the one host sync per dispatch, at the trace boundary. jax
+        dispatch is asynchronous: the jit call returns unrealized futures in
+        milliseconds, so a deadline that only wrapped the call would never
+        bound the actual device execution."""
+        values, finite = self._guarded(args)
+        return np.asarray(values), np.asarray(finite)
+
+    def _dispatch(self, args: dict) -> tuple[np.ndarray, np.ndarray]:
+        if self._deadline_s is None:
+            return self._realize(args)
+        box: list = []
+        failure: list[BaseException] = []
+
+        def _target() -> None:
+            try:
+                box.append(self._realize(args))
+            except BaseException as err:  # graphlint: ignore[PY001] -- thread trampoline: the error is re-raised verbatim on the dispatching thread below, nothing is swallowed
+                failure.append(err)
+
+        worker = threading.Thread(
+            target=_target, name="optuna-tpu-dispatch", daemon=True
+        )
+        start = self._clock()
+        worker.start()
+        while worker.is_alive():
+            remaining = self._deadline_s - (self._clock() - start)
+            if remaining <= 0:
+                break
+            worker.join(timeout=min(0.05, remaining))
+        if worker.is_alive():
+            # The hung dispatch is abandoned (daemon thread); its eventual
+            # result, if any, is discarded — the trials take the FAIL path.
+            raise DispatchTimeoutError(
+                f"device dispatch exceeded the {self._deadline_s}s deadline"
+            )
+        if failure:
+            raise failure[0]
+        return box[0]
+
+    def _contain(self, trials: list[Trial], err: Exception) -> None:
+        """A dispatch over ``trials`` raised ``err``: salvage what we can,
+        FAIL the rest, never leave anything RUNNING."""
+        b = len(trials)
+        if _is_oom_error(err) and b > self._n_dev:
+            # Halving needs no retry budget: a cascade is bounded by
+            # log2(b/floor) re-dispatches by construction (floor: one
+            # device-multiple — padding restores any narrower dispatch). The
+            # attempt counter (reset whenever a dispatch completes) only
+            # paces the backoff.
+            self._oom_attempts += 1
+            self._oom_seen = True
+            if b >= self._batch_size:
+                # Only a full-width dispatch is capacity evidence: later
+                # batches start at the halved size until _maybe_grow earns
+                # it back. An OOM inside a bisection
+                # sub-dispatch must not clamp the study-wide batch size
+                # below a width the device just proved it can run. Rounded
+                # down to a device multiple — a ragged size would be padded
+                # back up by every later _eval, wasting device evals for the
+                # rest of the study (and the padded width could exceed what
+                # just fit, forcing a needless extra OOM round).
+                self._batch_size = max(
+                    self._n_dev, (b // 2) // self._n_dev * self._n_dev
+                )
+                self._grow_streak = 0
+            self._policy.backoff(
+                self._oom_attempts,
+                announce=lambda delay: _logger.warning(
+                    f"dispatch of {b} trials hit {type(err).__name__} "
+                    f"(OOM-shaped); halving to {(b + 1) // 2} "
+                    f"and retrying after {delay:.3f}s backoff."
+                ),
+            )
+            self._run_halves(trials, (b + 1) // 2)
+            return
+        # An OOM-shaped error at one device-multiple falls through to the
+        # generic containment below rather than aborting outright: the text
+        # classifier can misfire on a poison trial whose error merely *looks*
+        # OOM-shaped ("ran out of memory in user preprocessing"), and
+        # bisection/leaf containment preserves the healthy trials' salvage
+        # either way — a genuine device OOM still surfaces once the leaf
+        # budget is spent.
+        if isinstance(err, DispatchTimeoutError):
+            # Each timed-out dispatch abandons a daemon thread (and whatever
+            # device buffers it pins); a persistently wedged device must not
+            # accumulate them unboundedly batch after batch. Consecutive
+            # timeouts share the OOM path's bounded budget — cleared only by
+            # a completed dispatch at (or above) the hung width, so
+            # bisection salvaging the halves doesn't launder the evidence.
+            self._timeout_strikes += 1
+            self._timeout_width = max(self._timeout_width, b)
+            if self._timeout_strikes >= self._strike_budget:
+                self._fail_trials(trials, f"batch dispatch raised: {err!r}")
+                raise err
+        if self._bisect and b > 1:
+            _logger.warning(
+                f"dispatch of {b} trials raised {err!r}; bisecting to isolate "
+                "the poison trial(s)."
+            )
+            self._run_halves(trials, b // 2)
+            return
+        self._fail_trials(trials, f"batch dispatch raised: {err!r}")
+        if self._bisect:
+            # Bisection leaf: the poison trial is isolated and contained; the
+            # rest of the study proceeds (parity with _run_trial's FAIL tell).
+            # But a *systemic* error — every leaf failing with no completed
+            # dispatch in between — must not be swallowed trial by trial
+            # until all n_trials are silently FAILed: consecutive leaf
+            # containments share the retry policy's bounded budget (any
+            # completed dispatch resets it), then the error surfaces, parity
+            # with the serial loop's propagate-on-first-raise.
+            self._leaf_strikes += 1
+            if self._leaf_strikes >= self._strike_budget:
+                raise err
+            _logger.warning(
+                f"trial {trials[0].number} quarantined after dispatch error: {err!r}"
+            )
+            return
+        raise err
+
+    def _run_halves(self, trials: list[Trial], mid: int) -> None:
+        """Recurse into both halves of a failed dispatch, guaranteeing the
+        second half is contained even when the first half's containment
+        re-raises (an unshrinkable OOM, a ``non_finite='raise'`` quarantine):
+        every trial must hold a terminal state before any error escapes."""
+        errors: list[Exception] = []
+        for half in (trials[:mid], trials[mid:]):
+            try:
+                self._run_batch(half)
+            except Exception as err:  # graphlint: ignore[PY001] -- deferred re-raise: the first half's error must not strand the second half RUNNING; the earliest error re-raises below once both halves hold terminal states
+                errors.append(err)
+        if errors:
+            raise errors[0]
+
+    def _tell_batch(
+        self, trials: list[Trial], values: np.ndarray, finite: np.ndarray
+    ) -> None:
+        study = self._study
+        clip = self._non_finite == "clip"
+        poisoned: list[int] = []
+        for i, trial in enumerate(trials):
+            if study._stop_flag:
+                # Study.stop() honored mid-batch: the already-evaluated
+                # remainder is quarantined as FAIL — never COMPLETE past the
+                # budget, never stranded RUNNING. break, not return: under
+                # non_finite='raise' a stop fired by a quarantine callback
+                # must not swallow the promised NonFiniteObjectiveError
+                # below.
+                self._fail_trials(
+                    trials[i:],
+                    "study stopped (Study.stop()) before this trial was told",
+                )
+                break
+            value = values[i]
+            if clip or bool(finite[i]):
+                # Deliberately *unskipped* (same rationale as _fail_trials):
+                # a concurrent survivor reaping this trial — before the
+                # tell's pre-read or between pre-read and commit — surfaces
+                # as UpdateFinishedTrialError, where skip_if_finished would
+                # silently hand back the reaper's terminal state,
+                # indistinguishable from a tell we own. Any tell that
+                # *returns* is ours — including one the tell path itself
+                # converted to FAIL (value-arity mismatch, a non-castable
+                # value) — so callbacks fire for it, matching the serial
+                # loop's every-finished-trial contract.
+                try:
+                    if np.ndim(value) == 0:
+                        frozen = study.tell(trial, float(value))
+                    else:
+                        frozen = study.tell(
+                            trial, [float(x) for x in np.asarray(value)]
+                        )
+                except UpdateFinishedTrialError:
+                    # The reaper owns the terminal state and notified for
+                    # it; the rest of the batch must still be told.
+                    continue
+                if frozen.state == TrialState.COMPLETE and not finite[i]:
+                    _logger.warning(
+                        f"trial {trial.number} returned a non-finite value; "
+                        "completed with clipped (nan_to_num) values under "
+                        "non_finite='clip'."
+                    )
+                self._notify(frozen)
+            else:
+                poisoned.append(trial.number)
+                # Notification rides _fail_trials so its reap-race guard
+                # also suppresses callbacks for a trial another worker
+                # already finished.
+                self._fail_trials(
+                    [trial],
+                    f"non-finite objective value {np.asarray(value)!r} quarantined "
+                    f"(non_finite={self._non_finite!r})",
+                )
+        if poisoned and self._non_finite == "raise":
+            raise NonFiniteObjectiveError(
+                f"trials {poisoned} returned non-finite objective values "
+                "(quarantined as FAIL before raising)"
+            )
+
+    def _fail_trials(self, trials: Sequence[Trial], reason: str) -> None:
+        # The tell-path sibling of storages/_heartbeat.py::
+        # fail_and_notify_trials (same reason-then-CAS ordering and
+        # UpdateFinishedTrialError race contract; different notify
+        # semantics — study.tell + this run's callbacks instead of the
+        # storage's failed-trial callback).
+        study = self._study
+        storage_error: Exception | None = None
+        to_notify: list["FrozenTrial"] = []
+        for trial in trials:
+            # A concurrent survivor may have reaped this trial between our
+            # dispatch and this tell — losing that race is fine (its terminal
+            # state stands), double-finishing or double-notifying is not:
+            # both the attr write and the deliberately *unskipped* tell
+            # surface the race as UpdateFinishedTrialError (every storage
+            # raises it for finished-trial mutation), and the warning and
+            # callbacks are skipped — the worker that owns the terminal
+            # state notified for it. skip_if_finished would silently return
+            # the reaper's FAIL here, indistinguishable from our own.
+            try:
+                try:
+                    study._storage.set_trial_system_attr(
+                        trial._trial_id, "fail_reason", reason
+                    )
+                except UpdateFinishedTrialError:
+                    raise  # race lost: handled by the outer except
+                except Exception as err:  # graphlint: ignore[PY001] -- the reason attr is diagnostics; a blip on it must not skip the FAIL tell below (losing the diagnostic is recoverable, stranding the trial RUNNING is not)
+                    _logger.warning(
+                        f"writing fail_reason for trial {trial.number} raised "
+                        f"{err!r}; failing the trial without it."
+                    )
+                frozen = study.tell(trial, state=TrialState.FAIL)
+            except UpdateFinishedTrialError:
+                continue
+            except Exception as err:  # graphlint: ignore[PY001] -- containment must visit every trial: a storage blip on one tell must not abort the loop and strand the rest RUNNING; the first error re-raises below (user callback errors still propagate, parity with the serial loop)
+                if storage_error is None:
+                    storage_error = err
+                _logger.warning(
+                    f"failing trial {trial.number} raised {err!r}; continuing "
+                    "so the rest of the batch is not stranded RUNNING."
+                )
+                continue
+            _logger.warning(f"Trial {trial.number} failed: {reason}")
+            to_notify.append(frozen)
+        # Notify only after *every* trial holds a terminal state: a user
+        # callback that raises persistently would otherwise abort this loop
+        # mid-batch — including run()'s last-line containment sweep, whose
+        # whole job is that no survivable failure strands a trial RUNNING.
+        # The callback error still propagates (serial-loop parity); it just
+        # can't undo the containment anymore.
+        for frozen in to_notify:
+            self._notify(frozen)
+        if storage_error is not None:
+            raise storage_error
+
+    def _notify(self, frozen: "FrozenTrial") -> None:
+        """Fire user callbacks for one finished trial — every terminal path
+        (COMPLETE, quarantine, crash/OOM/deadline/stop FAIL) goes through
+        here, matching the serial loop's every-finished-trial contract. The
+        caller passes the frozen trial its tell returned (already refetched
+        post-commit), saving a storage round trip per notification."""
+        for callback in self._callbacks:
+            callback(self._study, frozen)

@@ -11,6 +11,10 @@ ordinary trials.
 This is the engine behind BASELINE config #5 (256-way MLP study across a
 pod): trials ride the mesh's data axis; whatever model parallelism the
 objective uses internally rides the remaining axes.
+
+This module owns the *objective* side (packing, compilation caching); the
+fault-tolerant dispatch loop lives in :mod:`optuna_tpu.parallel.executor`,
+which ``optimize_vectorized`` delegates to.
 """
 
 from __future__ import annotations
@@ -24,12 +28,12 @@ from optuna_tpu.distributions import (
     CategoricalDistribution,
 )
 from optuna_tpu.logging import get_logger
-from optuna_tpu.trial._state import TrialState
 from optuna_tpu.trial._trial import Trial
 
 if TYPE_CHECKING:
     import jax
 
+    from optuna_tpu.storages._retry import RetryPolicy
     from optuna_tpu.study.study import Study
 
 _logger = get_logger(__name__)
@@ -52,34 +56,57 @@ class VectorizedObjective:
         self.search_space = search_space
         self._compiled_cache: dict[tuple, Any] = {}
 
-    def compiled(self, mesh: "jax.sharding.Mesh | None", batch_axis: str):
-        """The jit wrapper for ``fn`` under (mesh, axis) — built once per key,
-        NOT per optimize call. jax.jit's trace/executable cache hangs off the
-        wrapper object, so rebuilding the wrapper each ``optimize_vectorized``
-        call silently retraced and recompiled every batch shape on the second
-        study; memoizing here is what makes "the tail shape compiles once and
-        is reused across studies" actually true. The cache lives on this
-        objective (not a module global) so dropping the objective frees the
-        executables and whatever ``fn`` closed over.
+    def _memoized_jit(
+        self, key: tuple, fn, mesh: "jax.sharding.Mesh | None", batch_axis: str, n_out: int
+    ):
+        """Build (once per ``key``) a jit wrapper for ``fn`` with the batch
+        axis sharded over ``mesh``. jax.jit's trace/executable cache hangs
+        off the wrapper object, so rebuilding the wrapper each
+        ``optimize_vectorized`` call silently retraced and recompiled every
+        batch shape on the second study; memoizing here is what makes "the
+        tail shape compiles once and is reused across studies" actually
+        true. The cache lives on this objective (not a module global) so
+        dropping the objective frees the executables and whatever ``fn``
+        closed over.
         """
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        key = (mesh, batch_axis)
         cached = self._compiled_cache.get(key)
         if cached is not None:
             return cached
         if mesh is not None:
-            in_shard = NamedSharding(mesh, P(batch_axis))
-            compiled = jax.jit(  # graphlint: ignore[TPU002] -- memoized above: one wrapper per (mesh, axis) for this objective's lifetime, not per call
-                self.fn,
-                in_shardings=({k: in_shard for k in self.search_space},),
-                out_shardings=NamedSharding(mesh, P(batch_axis)),
+            shard = NamedSharding(mesh, P(batch_axis))
+            compiled = jax.jit(  # graphlint: ignore[TPU002] -- memoized above: one wrapper per cache key for this objective's lifetime, not per call
+                fn,
+                in_shardings=({k: shard for k in self.search_space},),
+                out_shardings=shard if n_out == 1 else (shard,) * n_out,
             )
         else:
-            compiled = jax.jit(self.fn)  # graphlint: ignore[TPU002] -- memoized above: one wrapper per (mesh, axis) for this objective's lifetime, not per call
+            compiled = jax.jit(fn)  # graphlint: ignore[TPU002] -- memoized above: one wrapper per cache key for this objective's lifetime, not per call
         self._compiled_cache[key] = compiled
         return compiled
+
+    def compiled(self, mesh: "jax.sharding.Mesh | None", batch_axis: str):
+        """The plain jit wrapper for ``fn`` under (mesh, axis) — built once
+        per key, NOT per optimize call (see :meth:`_memoized_jit`)."""
+        return self._memoized_jit((mesh, batch_axis), self.fn, mesh, batch_axis, 1)
+
+    def guarded(self, mesh: "jax.sharding.Mesh | None", batch_axis: str, non_finite: str = "fail"):
+        """The executor-facing jit wrapper: returns ``(values, finite_mask)``
+        with the mask computed in-graph (see
+        :func:`~optuna_tpu.parallel.executor.build_non_finite_guard`), so
+        non-finite quarantine costs no extra host round-trip. Memoized in the
+        same per-objective cache as :meth:`compiled`; ``'fail'`` and
+        ``'raise'`` share one graph (only ``'clip'`` changes the trace).
+        """
+        from optuna_tpu.parallel.executor import build_non_finite_guard
+
+        clip = non_finite == "clip"
+        key = (mesh, batch_axis, "guarded", clip)
+        return self._memoized_jit(
+            key, build_non_finite_guard(self.fn, clip=clip), mesh, batch_axis, 2
+        )
 
 
 def _pack_params(
@@ -103,67 +130,38 @@ def optimize_vectorized(
     mesh: "jax.sharding.Mesh | None" = None,
     batch_axis: str = "trials",
     callbacks: Sequence[Callable] | None = None,
+    *,
+    non_finite: str = "fail",
+    bisect_on_error: bool = True,
+    retry_policy: "RetryPolicy | None" = None,
+    dispatch_deadline_s: float | None = None,
 ) -> None:
-    """Run ``n_trials`` in device-wide batches.
+    """Run ``n_trials`` in device-wide batches, fault-tolerantly.
 
     With a ``mesh``, the packed parameter arrays are sharded along
     ``batch_axis`` and the objective executes SPMD across every device; the
-    per-batch host work is just ask/tell bookkeeping.
+    per-batch host work is just ask/tell bookkeeping. Ragged tails pad only
+    to the next device-count multiple (the minimum SPMD-valid shape).
+
+    Execution is delegated to
+    :class:`~optuna_tpu.parallel.executor.ResilientBatchExecutor`:
+    ``non_finite`` picks the NaN/Inf quarantine policy
+    (``'fail'``/``'raise'``/``'clip'``), ``bisect_on_error`` isolates poison
+    trials by batch bisection instead of failing the whole dispatch,
+    ``retry_policy`` paces OOM batch-halving, and ``dispatch_deadline_s``
+    bounds a hung device dispatch.
     """
-    import jax.numpy as jnp
+    from optuna_tpu.parallel.executor import ResilientBatchExecutor
 
-    if batch_size is None:
-        batch_size = len(mesh.devices.flat) if mesh is not None else 8
-
-    compiled = objective.compiled(mesh, batch_axis)
-
-    n_dev = len(mesh.devices.flat) if mesh is not None else 1
-    done = 0
-    while done < n_trials:
-        b = min(batch_size, n_trials - done)
-        if mesh is not None and b % n_dev != 0:
-            # Ragged tail: pad only to the next device-count multiple (the
-            # minimum SPMD-valid shape), not the full batch — a 257th trial
-            # costs at most n_dev-1 wasted evals, not batch_size-1. The tail
-            # shape jit-compiles once and is reused across studies.
-            b_eval = ((b + n_dev - 1) // n_dev) * n_dev
-        else:
-            b_eval = b
-
-        # Batch suggestion: one sampler dispatch proposes the whole batch;
-        # samplers without the hook fall back to per-trial relative sampling.
-        proposals = None
-        if hasattr(study.sampler, "sample_relative_batch"):
-            proposals = study.sampler.sample_relative_batch(
-                study, objective.search_space, b
-            )
-        # One storage commit creates the whole batch of trials.
-        trials = study.ask_batch(b)
-        for i, t in enumerate(trials):
-            if proposals is not None:
-                t.relative_search_space = objective.search_space
-                t.relative_params = proposals[i]
-            for name, dist in objective.search_space.items():
-                t._suggest(name, dist)
-
-        packed = _pack_params(trials, objective.search_space)
-        if b_eval > b:
-            packed = {
-                k: np.concatenate([v, np.repeat(v[-1:], b_eval - b, axis=0)])
-                for k, v in packed.items()
-            }
-        values = np.asarray(compiled({k: jnp.asarray(v) for k, v in packed.items()}))
-        values = values[:b]
-
-        for t, v in zip(trials, values):
-            if np.ndim(v) == 0:
-                study.tell(t, float(v))
-            else:
-                study.tell(t, [float(x) for x in np.asarray(v)])
-            if callbacks:
-                frozen = study._storage.get_trial(t._trial_id)
-                for cb in callbacks:
-                    cb(study, frozen)
-        done += b
-        if study._stop_flag:
-            break
+    ResilientBatchExecutor(
+        study,
+        objective,
+        batch_size=batch_size,
+        mesh=mesh,
+        batch_axis=batch_axis,
+        callbacks=callbacks,
+        non_finite=non_finite,
+        bisect_on_error=bisect_on_error,
+        retry_policy=retry_policy,
+        dispatch_deadline_s=dispatch_deadline_s,
+    ).run(n_trials)

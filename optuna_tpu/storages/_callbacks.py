@@ -16,6 +16,16 @@ from optuna_tpu.trial._state import TrialState
 if TYPE_CHECKING:
     from optuna_tpu.study.study import Study
 
+#: System-attr namespace owned by the vectorized batch executor
+#: (:mod:`optuna_tpu.parallel.executor`). Everything under this prefix is
+#: bookkeeping about one *physical dispatch* (batch id, slot index) — it
+#: describes the dead attempt, not the logical trial, so retry callbacks
+#: strip it when cloning: a WAITING clone will be re-dispatched in a new
+#: batch that writes its own fresh attrs. Keys like ``failed_trial`` /
+#: ``retry_history`` / ``fixed_params`` are deliberately *outside* this
+#: namespace — retry lineage must survive the copy.
+EXECUTOR_ATTR_PREFIX = "batch_exec:"
+
 
 class RetryFailedTrialCallback:
     """``failed_trial_callback`` for storages: re-enqueue failed trials.
@@ -31,7 +41,17 @@ class RetryFailedTrialCallback:
         self._inherit_intermediate_values = inherit_intermediate_values
 
     def __call__(self, study: "Study", trial: FrozenTrial) -> None:
-        system_attrs = dict(trial.system_attrs)
+        # Executor-owned dispatch bookkeeping must not leak into the clone
+        # (see EXECUTOR_ATTR_PREFIX above); lineage attrs are kept.
+        # ``fail_reason`` predates the namespace but is the same category —
+        # it diagnoses the dead attempt, and a clone that later COMPLETEs
+        # must not still claim a dispatch crash (the reason stays readable
+        # on the original trial the lineage attrs point at).
+        system_attrs = {
+            k: v
+            for k, v in trial.system_attrs.items()
+            if not k.startswith(EXECUTOR_ATTR_PREFIX) and k != "fail_reason"
+        }
         retry_history = list(system_attrs.get("retry_history", []))
         original_trial_number = system_attrs.get("failed_trial", trial.number)
         retry_history.append(trial.number)

@@ -488,7 +488,7 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         template_trial: FrozenTrial | None,
     ) -> int:
         if template_trial is None:
-            return self._d.insert_id(
+            trial_id = self._d.insert_id(
                 con,
                 "INSERT INTO trials (number, study_id, state, datetime_start) VALUES (?, ?, ?, ?)",
                 (
@@ -499,6 +499,8 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                 ),
                 "trial_id",
             )
+            self._record_initial_heartbeat(con, trial_id)
+            return trial_id
         t = template_trial
         trial_id = self._d.insert_id(
             con,
@@ -545,7 +547,27 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                 "INSERT INTO trial_system_attributes (trial_id, key, value_json) VALUES (?, ?, ?)",
                 (trial_id, key, json.dumps(v)),
             )
+        if t.state == TrialState.RUNNING:
+            self._record_initial_heartbeat(con, trial_id)
         return trial_id
+
+    def _record_initial_heartbeat(self, con: sqlite3.Connection, trial_id: int) -> None:
+        """The RUNNING commit doubles as the trial's first beat, in the same
+        transaction — so there is no commit-to-first-beat window at all: a
+        worker SIGKILL'd at any point after its trials became RUNNING leaves
+        them reapable (``_get_stale_trial_ids`` joins on heartbeat rows, and
+        epoch-based rows are immune to cross-host timezone/clock-basis skew,
+        unlike the ISO-text ``datetime_start`` column). Deliberate
+        consequence: on a heartbeat storage, a RUNNING trial that never
+        beats again (a bare ``ask()`` outside optimize, which already warns)
+        goes stale after the grace period."""
+        if self.heartbeat_interval is None:
+            return
+        con.execute(
+            "INSERT INTO trial_heartbeats (trial_id, heartbeat) VALUES (?, ?) "
+            "ON CONFLICT(trial_id) DO UPDATE SET heartbeat = excluded.heartbeat",
+            (trial_id, time.time()),
+        )
 
     def _check_trial_updatable(self, con: sqlite3.Connection, trial_id: int) -> None:
         # Always called inside a write txn: the FOR UPDATE suffix (server
@@ -617,6 +639,10 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
                 args.append(now)
             args.append(trial_id)
             con.execute(f"UPDATE trials SET {', '.join(sets)} WHERE trial_id = ?", args)
+            if state == TrialState.RUNNING:
+                # A WAITING->RUNNING claim beats atomically with the claim,
+                # same rationale as _record_initial_heartbeat at creation.
+                self._record_initial_heartbeat(con, trial_id)
             if values is not None:
                 con.execute("DELETE FROM trial_values WHERE trial_id = ?", (trial_id,))
                 for i, v in enumerate(values):
@@ -781,6 +807,12 @@ class RDBStorage(BaseStorage, BaseHeartbeat):
         assert self.heartbeat_interval is not None
         grace = self.grace_period or self.heartbeat_interval * 2
         cutoff = time.time() - grace
+        # The inner join is safe: every RUNNING commit writes its first beat
+        # in the same transaction (_record_initial_heartbeat), so beat-less
+        # RUNNING trials cannot exist on a heartbeat-enabled storage and the
+        # comparison stays purely epoch-based (immune to cross-host timezone
+        # or clock-basis skew, which the ISO-text datetime_start column is
+        # not).
         rows = self._conn().execute(
             "SELECT t.trial_id FROM trials t JOIN trial_heartbeats h ON t.trial_id = h.trial_id "
             "WHERE t.study_id = ? AND t.state = ? AND h.heartbeat < ?",

@@ -123,6 +123,22 @@ class RetryPolicy:
     def next_delay(self, attempt: int) -> float:
         return self._rng.uniform(0.0, self.backoff_cap(attempt))
 
+    def backoff(
+        self, attempt: int, announce: Callable[[float], None] | None = None
+    ) -> float:
+        """Draw attempt's jittered delay and sleep it (through the injected
+        sleep); returns the delay. ``announce`` is called with the drawn
+        delay *before* the sleep, so callers can log the stall while it is
+        happening rather than after it already ended. For callers that run
+        their own retry loop but want this policy's schedule — the
+        vectorized executor's OOM batch-halving backs off through here
+        between re-dispatches."""
+        delay = self.next_delay(attempt)
+        if announce is not None:
+            announce(delay)
+        self._sleep(delay)
+        return delay
+
     def call(
         self,
         fn: Callable[[], Any],

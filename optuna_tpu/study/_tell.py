@@ -28,7 +28,14 @@ def _check_values_are_feasible(study: "Study", values: Sequence[float]) -> str |
     for v in values:
         if v is None:
             return "The value None could not be cast to float."
-        if math.isnan(v):
+        try:
+            is_nan = math.isnan(v)
+        except (TypeError, OverflowError):
+            # A value math.isnan cannot take — non-numeric (TypeError) or an
+            # int too large for float (OverflowError) — must surface as the
+            # same infeasibility message family, not escape the guard.
+            return f"The value {v!r} could not be cast to float."
+        if is_nan:
             return f"The value {v} is not acceptable."
     if len(study.directions) != len(values):
         return (

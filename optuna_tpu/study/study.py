@@ -266,16 +266,35 @@ class Study:
         self._thread_local.cached_all_trials = None
 
         trial_ids: list[int] = []
-        while len(trial_ids) < n:
-            waiting = self._pop_waiting_trial_id()
-            if waiting is None:
-                break
-            trial_ids.append(waiting)
-        if len(trial_ids) < n:
-            trial_ids.extend(
-                self._storage.create_new_trials(self._study_id, n - len(trial_ids))
+        try:
+            # The claim/create phase lives inside the containment too: a
+            # storage blip in create_new_trials (or a later waiting-pop) after
+            # some WAITING trials were already claimed to RUNNING would
+            # otherwise strand exactly those claimed trials — no FAIL, no
+            # retry callback, lineage silently consumed.
+            while len(trial_ids) < n:
+                waiting = self._pop_waiting_trial_id()
+                if waiting is None:
+                    break
+                trial_ids.append(waiting)
+            if len(trial_ids) < n:
+                trial_ids.extend(
+                    self._storage.create_new_trials(self._study_id, n - len(trial_ids))
+                )
+            return [self._init_asked_trial(tid, fixed_distributions) for tid in trial_ids]
+        except Exception as init_err:  # graphlint: ignore[PY001] -- containment boundary: every trial in trial_ids is already committed RUNNING, and an error during claim/create/init (sampler.before_trial, a storage blip) would otherwise strand them with no heartbeat recorded yet — unreapable by fail_stale_trials
+            # Same sequence fail_stale_trials would run had the batch been
+            # reapable: record why, CAS to FAIL, fire the failed-trial
+            # callback so claimed WAITING retry clones are re-enqueued
+            # instead of being silently consumed — a transient blip here
+            # must not end a whole batch's retry lineage.
+            fail_and_notify_trials(
+                self,
+                trial_ids,
+                reason=f"batch ask aborted: init raised {init_err!r}",
+                best_effort=True,
             )
-        return [self._init_asked_trial(tid, fixed_distributions) for tid in trial_ids]
+            raise
 
     def tell(
         self,
@@ -595,4 +614,7 @@ def get_all_study_summaries(
 # Imports placed at the tail to break the storages<->study cycle.
 import warnings  # noqa: E402
 
-from optuna_tpu.storages._heartbeat import is_heartbeat_enabled  # noqa: E402
+from optuna_tpu.storages._heartbeat import (  # noqa: E402
+    fail_and_notify_trials,
+    is_heartbeat_enabled,
+)

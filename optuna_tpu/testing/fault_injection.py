@@ -14,6 +14,11 @@ the faults they claim to absorb. This module provides:
 * Filesystem chaos helpers for the journal backend:
   :func:`tear_journal_tail` (simulate a crash mid-append: torn final record)
   and :func:`plant_stale_lock` (simulate a SIGKILL'd lock holder).
+* :class:`FaultyVectorizedObjective` — a
+  :class:`~optuna_tpu.parallel.vectorized.VectorizedObjective` that injects
+  device-dispatch-level faults (NaN-at-position, crash-at-dispatch,
+  OOM-shaped errors, hangs, worker kills) for chaos-testing the resilient
+  batch executor (:mod:`optuna_tpu.parallel.executor`).
 
 Typical chaos test::
 
@@ -34,7 +39,9 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Mapping, Sequence
+from typing import Any, Callable, Collection, Mapping, Sequence
+
+import numpy as np
 
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage, _ForwardingStorage
@@ -165,7 +172,129 @@ class FaultInjectorStorage(_ForwardingStorage):
         return None
 
 
-# ---------------------------------------------------------- filesystem chaos
+# ----------------------------------------------------- device-dispatch chaos
+
+
+class FakeResourceExhaustedError(RuntimeError):
+    """An XLA-allocation-failure stand-in: the executor classifies OOM by the
+    RESOURCE_EXHAUSTED text, so no jaxlib error type needs constructing."""
+
+
+# Chaos matrix for the executor's non-finite quarantine policies: every
+# policy literal the executor accepts maps to the injection scenario the
+# chaos suite must run against it. Deliberately a hand-written literal (not
+# an import of ``parallel.executor.NON_FINITE_POLICIES``): graphlint rule
+# EXE001 cross-checks both against ``_lint/registry.py::
+# NON_FINITE_POLICY_REGISTRY`` — adding a policy without deciding how to
+# chaos-test it is a lint failure (the STO001 pattern).
+NON_FINITE_CHAOS_POLICIES: dict[str, str] = {
+    "fail": "inject NaN at batch positions; those trials FAIL, the rest COMPLETE finite",
+    "raise": "inject NaN; the executor quarantines as FAIL and then raises to the caller",
+    "clip": "inject NaN; every trial COMPLETEs with finite (nan_to_num) values",
+}
+
+
+class FaultyVectorizedObjective:
+    """A ``VectorizedObjective`` whose *dispatches* misbehave on schedule.
+
+    All knobs are keyed by the 0-based **dispatch index** (counted per
+    objective instance, including the executor's bisection/halving
+    re-dispatches — watch ``dispatch_widths`` to follow the recursion):
+
+    ``nan_at``
+        ``{dispatch: positions}`` — poison the first float parameter column
+        at those batch positions with NaN *before* the device call, so the
+        objective's output is NaN there and the executor's in-graph
+        ``isfinite`` mask quarantines exactly those trials.
+    ``raise_at`` / ``oom_at`` / ``kill_at`` / ``hang_at``
+        Dispatch indices that raise ``error_factory(index)``, raise
+        :class:`FakeResourceExhaustedError`, raise
+        :class:`SimulatedWorkerDeath` (punches through containment, strands
+        the batch RUNNING for heartbeat failover), or sleep ``hang_s``
+        seconds (tripping the executor's dispatch deadline).
+    ``oom_above``
+        Width threshold: any dispatch wider than this raises the OOM-shaped
+        error — the knob behind "halve until it fits".
+    ``raise_when``
+        Host predicate over the packed numpy params; a *persistent* poison
+        (``lambda p: (p["x"] > 0.9).any()``) follows the poison trial through
+        bisection instead of striking a fixed dispatch count.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[dict[str, Any]], Any],
+        search_space: dict,
+        *,
+        nan_at: Mapping[int, Sequence[int]] | None = None,
+        raise_at: Collection[int] = (),
+        oom_at: Collection[int] = (),
+        kill_at: Collection[int] = (),
+        hang_at: Collection[int] = (),
+        hang_s: float = 30.0,
+        oom_above: int | None = None,
+        raise_when: Callable[[dict[str, "np.ndarray"]], bool] | None = None,
+        error_factory: Callable[[int], Exception] = lambda index: RuntimeError(
+            f"injected dispatch crash at dispatch #{index}"
+        ),
+    ) -> None:
+        from optuna_tpu.parallel.vectorized import VectorizedObjective
+
+        self._inner = VectorizedObjective(fn, search_space)
+        self.fn = fn
+        self.search_space = search_space
+        self.nan_at = dict(nan_at or {})
+        self.raise_at = frozenset(raise_at)
+        self.oom_at = frozenset(oom_at)
+        self.kill_at = frozenset(kill_at)
+        self.hang_at = frozenset(hang_at)
+        self.hang_s = hang_s
+        self.oom_above = oom_above
+        self.raise_when = raise_when
+        self.error_factory = error_factory
+        self.dispatches = 0
+        self.dispatch_widths: list[int] = []
+
+    def compiled(self, mesh, batch_axis):
+        return self._inner.compiled(mesh, batch_axis)
+
+    def guarded(self, mesh, batch_axis, non_finite: str = "fail"):
+        inner = self._inner.guarded(mesh, batch_axis, non_finite)
+
+        def _faulty(args: dict) -> Any:
+            index = self.dispatches
+            self.dispatches += 1
+            width = int(next(iter(args.values())).shape[0]) if args else 0
+            self.dispatch_widths.append(width)
+            if index in self.kill_at:
+                raise SimulatedWorkerDeath(
+                    f"scheduled worker death at dispatch #{index}"
+                )
+            if index in self.oom_at or (
+                self.oom_above is not None and width > self.oom_above
+            ):
+                raise FakeResourceExhaustedError(
+                    f"RESOURCE_EXHAUSTED: out of memory allocating a "
+                    f"{width}-wide dispatch (injected)"
+                )
+            if index in self.raise_at:
+                raise self.error_factory(index)
+            host = {k: np.asarray(v) for k, v in args.items()}
+            if self.raise_when is not None and self.raise_when(host):
+                raise self.error_factory(index)
+            if index in self.hang_at:
+                time.sleep(self.hang_s)
+            positions = [p for p in self.nan_at.get(index, ()) if p < width]
+            if positions:
+                name = next(
+                    k for k, v in host.items() if np.issubdtype(v.dtype, np.floating)
+                )
+                column = host[name].copy()
+                column[positions] = np.nan
+                args = {**args, name: column}
+            return inner(args)
+
+        return _faulty
 
 
 def tear_journal_tail(file_path: str, keep_bytes: int = 7) -> int:

@@ -101,6 +101,42 @@ def test_sto001_gate_rejects_drift():
     assert all("set_trial_galaxy" in f.message for f in drifted)
 
 
+def test_exe001_registry_matches_runtime_sets():
+    """The canonical non-finite policy registry equals the *runtime* values
+    of both hand-written copies (the lint compares them statically)."""
+    from optuna_tpu.parallel.executor import NON_FINITE_POLICIES
+    from optuna_tpu.testing.fault_injection import NON_FINITE_CHAOS_POLICIES
+
+    canonical = set(lint_registry.NON_FINITE_POLICY_REGISTRY)
+    assert set(NON_FINITE_POLICIES) == canonical
+    assert set(NON_FINITE_CHAOS_POLICIES) == canonical
+
+
+def test_exe001_gate_rejects_drift():
+    """Point EXE001 at the real files with a registry containing a policy the
+    code does not know: both copies must be reported as drifted — adding a
+    quarantine policy without a chaos scenario is a lint failure."""
+    fat_registry = dict(lint_registry.NON_FINITE_POLICY_REGISTRY)
+    fat_registry["explode"] = "made-up policy to prove the check is live"
+    config = Config(exe001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.exe001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "EXE001"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("explode" in f.message for f in drifted)
+
+
+def test_pyproject_device_paths_mirror_registry():
+    """[tool.graphlint] device-paths (the operator-visible classification)
+    must stay identical to the canonical DEVICE_MODULE_PATHS — the executor
+    registration lives in both places by design."""
+    config = load_config(PYPROJECT)
+    assert tuple(config.device_paths) == lint_registry.DEVICE_MODULE_PATHS
+    assert "optuna_tpu/parallel/executor.py" in config.device_paths
+
+
 # ------------------------------------------------------- fixture self-tests
 
 

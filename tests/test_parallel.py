@@ -157,7 +157,7 @@ def test_optimize_vectorized_ragged_tail_minimal_padding(monkeypatch):
 
 
 def test_compiled_objective_cached_across_optimize_calls():
-    """Regression (graphlint TPU002): the jit wrapper must be built once per
+    """Regression (graphlint TPU002): the jit wrappers must be built once per
     (objective, mesh, axis) — not per optimize_vectorized call, which
     silently retraced every batch shape on the second study."""
     from optuna_tpu.samplers import RandomSampler
@@ -167,10 +167,17 @@ def test_compiled_objective_cached_across_optimize_calls():
 
     obj = VectorizedObjective(fn=fn, search_space={"x": FloatDistribution(0.0, 1.0)})
     assert obj.compiled(None, "trials") is obj.compiled(None, "trials")
+    # The executor-facing guarded wrapper is memoized the same way, and the
+    # 'fail'/'raise' policies share one graph (only 'clip' retraces).
+    assert obj.guarded(None, "trials") is obj.guarded(None, "trials")
+    assert obj.guarded(None, "trials", "fail") is obj.guarded(None, "trials", "raise")
+    assert obj.guarded(None, "trials", "clip") is not obj.guarded(None, "trials", "fail")
 
-    # End to end: two studies over the same objective share that one wrapper.
+    # End to end: two studies over the same objective share one guarded
+    # wrapper (plus the plain + clip wrappers built above: 3 cache entries).
+    before = len(obj._compiled_cache)
     for _ in range(2):
         study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
         optimize_vectorized(study, obj, n_trials=4, batch_size=4)
         assert len(study.trials) == 4
-    assert len(obj._compiled_cache) == 1
+    assert len(obj._compiled_cache) == before

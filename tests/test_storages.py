@@ -251,12 +251,13 @@ def test_heartbeat_fail_stale_and_retry(tmp_path):
     study = create_study(storage=storage, sampler=RandomSampler(seed=0))
     trial = study.ask()
     trial.suggest_float("x", 0, 1)
-    # Simulate a dead worker: write an ancient heartbeat directly (mirrors
-    # reference tests/storages_tests/test_heartbeat.py).
+    # Simulate a dead worker: age the heartbeat directly (mirrors reference
+    # tests/storages_tests/test_heartbeat.py; the row always exists now —
+    # the RUNNING commit wrote it atomically).
     with storage._txn() as con:
         con.execute(
-            "INSERT INTO trial_heartbeats (trial_id, heartbeat) VALUES (?, ?)",
-            (trial._trial_id, 0.0),
+            "UPDATE trial_heartbeats SET heartbeat = 0.0 WHERE trial_id = ?",
+            (trial._trial_id,),
         )
     fail_stale_trials(study)
     trials = study.get_trials()
@@ -266,6 +267,230 @@ def test_heartbeat_fail_stale_and_retry(tmp_path):
     assert len(waiting) == 1
     assert waiting[0].system_attrs["failed_trial"] == 0
     assert waiting[0].system_attrs["retry_history"] == [0]
+
+
+def test_heartbeat_first_beat_is_synchronous():
+    """Regression (code review): the first heartbeat used to be recorded on
+    the spawned daemon thread, so a worker killed before the OS scheduled
+    that thread stranded trials RUNNING with zero heartbeat rows — invisible
+    to fail_stale_trials' join on recorded beats. __enter__ must beat every
+    trial id before the thread exists."""
+    import threading
+
+    from optuna_tpu.storages._heartbeat import HeartbeatThread
+
+    class RecordingHeartbeat:
+        def __init__(self):
+            self.beats: list[int] = []
+
+        def get_heartbeat_interval(self):
+            return 60
+
+        def record_heartbeat(self, trial_id):
+            self.beats.append(trial_id)
+
+    heartbeat = RecordingHeartbeat()
+    thread = HeartbeatThread([7, 8, 9], heartbeat)
+    # Suppress the daemon thread entirely: any beat observed below was
+    # recorded synchronously by __enter__ itself.
+    original_start = threading.Thread.start
+    threading.Thread.start = lambda self: None
+    try:
+        thread.__enter__()
+    finally:
+        threading.Thread.start = original_start
+    assert heartbeat.beats == [7, 8, 9]
+
+
+def test_heartbeat_first_beat_storage_blip_does_not_abort():
+    """Regression (code review): the synchronous first beat must be
+    best-effort — a transient record_heartbeat error in __enter__ would
+    otherwise escape into the serial optimize loop (which has no containment
+    sweep around the heartbeat context) and strand the just-asked trial
+    RUNNING. On a blip, __enter__ proceeds and the daemon thread retries the
+    first beat immediately instead of waiting a full interval."""
+    import threading
+
+    from optuna_tpu.storages._heartbeat import HeartbeatThread
+
+    class FlakyHeartbeat:
+        def __init__(self):
+            self.beats: list[int] = []
+            self.calls = 0
+            self.beaten = threading.Event()
+
+        def get_heartbeat_interval(self):
+            return 60
+
+        def record_heartbeat(self, trial_id):
+            self.calls += 1
+            if self.calls == 1:
+                raise ConnectionError("injected storage blip")
+            self.beats.append(trial_id)
+            self.beaten.set()
+
+    heartbeat = FlakyHeartbeat()
+    thread = HeartbeatThread([7, 8], heartbeat)
+    with thread:  # must not raise
+        # The daemon retries the failed first beat immediately — well within
+        # this timeout, nowhere near the 60s interval.
+        assert heartbeat.beaten.wait(timeout=10.0)
+    assert heartbeat.beats[:2] == [7, 8]
+
+
+def test_heartbeat_daemon_survives_multi_call_storage_blip():
+    """Regression (code review): a storage blip spanning more than one
+    record_heartbeat call — the sync first beat AND the daemon's immediate
+    retry — used to kill the beat thread unhandled, silencing liveness for
+    the whole batch while the worker was alive. Each beat round is
+    contained; the thread retries at the next interval."""
+    import threading
+
+    from optuna_tpu.storages._heartbeat import HeartbeatThread
+
+    class OutageHeartbeat:
+        def __init__(self):
+            self.beats: list[int] = []
+            self.calls = 0
+            self.beaten = threading.Event()
+
+        def get_heartbeat_interval(self):
+            return 0.1
+
+        def record_heartbeat(self, trial_id):
+            self.calls += 1
+            if self.calls <= 3:  # outage spans the sync beat + first retry round
+                raise ConnectionError("injected storage outage")
+            self.beats.append(trial_id)
+            if len(self.beats) >= 2:
+                self.beaten.set()
+
+    heartbeat = OutageHeartbeat()
+    thread = HeartbeatThread([7, 8], heartbeat)
+    with thread:  # must not raise
+        assert heartbeat.beaten.wait(timeout=10.0)
+    assert heartbeat.beats[:2] == [7, 8]
+
+
+def test_running_commit_records_first_heartbeat_atomically(tmp_path):
+    """Regression (code review): _get_stale_trial_ids inner-joins
+    trial_heartbeats, so a worker SIGKILL'd between its RUNNING commit and
+    its first recorded beat used to leave trials with zero heartbeat rows —
+    invisible to every reaper forever. The RUNNING commit itself records the
+    first beat in the same transaction (epoch-based, so immune to the
+    cross-host timezone skew a datetime_start comparison would have), for
+    both fresh creates and WAITING->RUNNING claims: the beat-less window
+    does not exist."""
+
+    def beat_count(trial_id):
+        return storage._conn().execute(
+            "SELECT COUNT(*) FROM trial_heartbeats WHERE trial_id = ?",
+            (trial_id,),
+        ).fetchone()[0]
+
+    from optuna_tpu.storages import RetryFailedTrialCallback, fail_stale_trials
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/atomicbeat.db",
+        heartbeat_interval=1,
+        grace_period=1,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=3),
+    )
+    study = create_study(storage=storage, sampler=RandomSampler(seed=0))
+    # Fresh create: the ask's commit wrote the beat — no beat thread ran.
+    trial = study.ask()
+    trial.suggest_float("x", 0, 1)
+    assert beat_count(trial._trial_id) == 1
+    # WAITING->RUNNING claim beats atomically with the claim.
+    study.enqueue_trial({"x": 0.5})
+    claimed = study.ask()
+    assert beat_count(claimed._trial_id) == 1
+    # Simulate the SIGKILL right after the commit: age the initial beat —
+    # the trial is reapable even though its worker never beat again.
+    with storage._txn() as con:
+        con.execute(
+            "UPDATE trial_heartbeats SET heartbeat = 0 WHERE trial_id = ?",
+            (trial._trial_id,),
+        )
+    fail_stale_trials(study)
+    trials = study.get_trials()
+    assert trials[trial.number].state == TrialState.FAIL
+    waiting = [t for t in trials if t.state == TrialState.WAITING]
+    assert len(waiting) == 1  # the retry clone was re-enqueued
+    # The freshly-claimed trial (inside its grace period) is NOT stale.
+    assert trials[claimed.number].state == TrialState.RUNNING
+
+
+def test_fail_and_notify_loses_finished_trial_race_cleanly():
+    """Regression (code review): storages surface finished-trial mutation as
+    UpdateFinishedTrialError, not a False CAS — two survivors reaping the
+    same stale batch must not crash each other's optimize run. The loser
+    skips the trial (no callback) and keeps visiting the rest."""
+    from optuna_tpu.storages._heartbeat import fail_and_notify_trials
+
+    study = create_study(sampler=RandomSampler(seed=0))
+    finished = study.ask()
+    study.tell(finished, 1.0)  # the "other survivor" won this trial
+    stale = study.ask()
+    failed = fail_and_notify_trials(
+        study, [finished._trial_id, stale._trial_id], reason="reaped"
+    )
+    assert failed == [stale._trial_id]
+    assert study.get_trials()[finished.number].state == TrialState.COMPLETE
+    assert study.get_trials()[stale.number].state == TrialState.FAIL
+
+
+def test_fail_and_notify_reason_blip_does_not_skip_fail_write(monkeypatch):
+    """Regression (code review): the fail_reason attr write and the FAIL CAS
+    shared one try, so a transient blip on the (diagnostic) attr write
+    skipped the (critical) FAIL write and stranded the trial RUNNING. The
+    reason is best-effort; the FAIL must still land."""
+    from optuna_tpu.storages._heartbeat import fail_and_notify_trials
+
+    study = create_study(sampler=RandomSampler(seed=0))
+    trial = study.ask()
+
+    def blip(trial_id, key, value):
+        raise ConnectionError("transient attr-write blip")
+
+    monkeypatch.setattr(study._storage, "set_trial_system_attr", blip)
+    failed = fail_and_notify_trials(
+        study, [trial._trial_id], reason="reaped", best_effort=True
+    )
+    assert failed == [trial._trial_id]
+    assert study.get_trials()[trial.number].state == TrialState.FAIL
+
+
+def test_fail_and_notify_callback_error_cannot_leave_stale_trials_running(tmp_path):
+    """Regression (code review): the failed-trial callback used to fire
+    inline inside the CAS loop, so a retry callback hitting a blip on the
+    first stale trial aborted the reap and left the rest RUNNING. All FAIL
+    writes land before any callback fires — losing a clone is recoverable,
+    losing the FAIL is not."""
+    from optuna_tpu.storages._heartbeat import fail_and_notify_trials
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+
+    notified: list[int] = []
+
+    def exploding_callback(study, frozen):
+        notified.append(frozen.number)
+        raise RuntimeError("retry callback exploded")
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/cbboom.db",
+        heartbeat_interval=1,
+        grace_period=1,
+        failed_trial_callback=exploding_callback,
+    )
+    study = create_study(storage=storage, sampler=RandomSampler(seed=0))
+    a, b = study.ask(), study.ask()
+    with pytest.raises(RuntimeError, match="retry callback exploded"):
+        fail_and_notify_trials(study, [a._trial_id, b._trial_id], reason="reaped")
+    trials = study.get_trials()
+    assert trials[a.number].state == TrialState.FAIL
+    assert trials[b.number].state == TrialState.FAIL  # CAS'd before any callback
+    assert notified == [a.number]  # the first callback raised and propagated
 
 
 def test_grpc_proxy_multiple_clients():

@@ -88,6 +88,161 @@ def test_objective_nan_fails():
     assert study.trials[0].state == TrialState.FAIL
 
 
+def test_tell_nan_fails_with_warning_never_completes():
+    """ISSUE 4 satellite audit: telling NaN must FAIL the trial with a
+    warning (reference parity) — a COMPLETE NaN value must be impossible
+    through every tell path."""
+    study = create_study(sampler=RandomSampler(seed=0))
+    trial = study.ask()
+    with pytest.warns(UserWarning, match="nan"):
+        frozen = study.tell(trial, float("nan"))
+    assert frozen.state == TrialState.FAIL
+    assert frozen.values is None
+    assert "not acceptable" in frozen.system_attrs["fail_reason"]
+
+    # Explicit state=COMPLETE with NaN raises and leaves the trial unfinished
+    # rather than committing the NaN.
+    trial = study.ask()
+    with pytest.raises(ValueError, match="nan"):
+        study.tell(trial, float("nan"), state=TrialState.COMPLETE)
+    assert study.trials[trial.number].state == TrialState.RUNNING
+
+
+@pytest.mark.parametrize("value", [float("inf"), float("-inf")])
+def test_tell_infinite_values_complete(value):
+    # Reference parity: ±inf are *feasible* told values (only NaN fails) —
+    # the vectorized engine's non_finite= policies are stricter by choice.
+    study = create_study(sampler=RandomSampler(seed=0))
+    trial = study.ask()
+    frozen = study.tell(trial, value)
+    assert frozen.state == TrialState.COMPLETE
+    assert frozen.value == value
+
+
+def test_tell_multiobjective_mixed_finite_values():
+    study = create_study(directions=["minimize", "minimize"], sampler=RandomSampler(seed=0))
+    # A NaN anywhere in the vector fails the whole trial...
+    trial = study.ask()
+    with pytest.warns(UserWarning, match="nan"):
+        frozen = study.tell(trial, [1.0, float("nan")])
+    assert frozen.state == TrialState.FAIL
+    assert frozen.values is None
+    # ...while an inf component stays feasible (parity with the reference).
+    trial = study.ask()
+    frozen = study.tell(trial, [1.0, float("inf")])
+    assert frozen.state == TrialState.COMPLETE
+    assert frozen.values == [1.0, float("inf")]
+
+
+def test_add_trial_rejects_nan_and_non_numeric_values():
+    from optuna_tpu.trial._frozen import create_trial
+
+    study = create_study(sampler=RandomSampler(seed=0))
+    with pytest.raises(ValueError):
+        study.add_trial(create_trial(state=TrialState.COMPLETE, values=[float("nan")]))
+    # Non-numerics are rejected at FrozenTrial construction (float cast),
+    # before add_trial's feasibility check even runs.
+    with pytest.raises(ValueError):
+        study.add_trial(create_trial(state=TrialState.COMPLETE, values=["oops"]))
+    assert len(study.trials) == 0
+
+
+def test_check_values_are_feasible_non_numeric_guard():
+    """Every public path float-casts values before the feasibility check, so
+    the non-numeric branch is defense in depth — exercise it directly: a
+    value `math.isnan` cannot take must yield the cast-failure message, not a
+    TypeError escaping the guard."""
+    from optuna_tpu.study._tell import _check_values_are_feasible
+
+    study = create_study(sampler=RandomSampler(seed=0))
+    message = _check_values_are_feasible(study, ["oops"])
+    assert message is not None and "could not be cast to float" in message
+    # An int too large for float raises OverflowError from math.isnan, not
+    # TypeError — same infeasibility message, no exception escaping.
+    message = _check_values_are_feasible(study, [10**400])
+    assert message is not None and "could not be cast to float" in message
+    assert _check_values_are_feasible(study, [1.0]) is None
+
+
+def test_ask_batch_init_error_fails_trials_and_preserves_retry_lineage(tmp_path):
+    """Regression (code review): ask_batch's init-error cleanup used to FAIL
+    the batch via raw ``set_trial_state_values`` — bypassing the storage's
+    failed-trial callback, so claimed WAITING retry clones were permanently
+    consumed by one transient blip, with no ``fail_reason`` written. The
+    cleanup must mirror fail_stale_trials: fail_reason + FAIL + callback."""
+    from optuna_tpu.storages import RetryFailedTrialCallback
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/ask_batch.db",
+        heartbeat_interval=60,
+        grace_period=120,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=3),
+    )
+    study = create_study(storage=storage, sampler=RandomSampler(seed=0))
+
+    class ExplodingBeforeTrialSampler(RandomSampler):
+        def before_trial(self, study, trial):
+            raise RuntimeError("injected before_trial blip")
+
+    study.sampler = ExplodingBeforeTrialSampler(seed=0)
+    with pytest.raises(RuntimeError, match="injected before_trial blip"):
+        study.ask_batch(3)
+
+    trials = study.get_trials(deepcopy=False)
+    failed = [t for t in trials if t.state == TrialState.FAIL]
+    waiting = [t for t in trials if t.state == TrialState.WAITING]
+    assert len(failed) == 3
+    assert len(waiting) == 3
+    assert not any(t.state == TrialState.RUNNING for t in trials)
+    for t in failed:
+        assert "batch ask aborted" in t.system_attrs["fail_reason"]
+    # Clones carry lineage but not the dead attempt's diagnostics.
+    for t in waiting:
+        assert t.system_attrs["failed_trial"] in {f.number for f in failed}
+        assert "fail_reason" not in t.system_attrs
+
+
+def test_ask_batch_create_error_fails_claimed_waiting_trials(tmp_path):
+    """Regression (code review): the WAITING-claim loop and create_new_trials
+    ran *before* ask_batch's containment try, so a storage blip in
+    create_new_trials after some WAITING trials were already claimed to
+    RUNNING stranded exactly those claimed trials — no FAIL, no retry
+    callback. The claim/create phase must sit inside the same containment as
+    per-trial init."""
+    from optuna_tpu.storages import RetryFailedTrialCallback
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/ask_batch_create.db",
+        heartbeat_interval=60,
+        grace_period=120,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=3),
+    )
+    study = create_study(storage=storage, sampler=RandomSampler(seed=0))
+    study.enqueue_trial({"x": 1.0, "y": 1, "c": "a"})
+    study.enqueue_trial({"x": 2.0, "y": 2, "c": "b"})
+
+    def exploding_create_new_trials(study_id, n):
+        raise RuntimeError("injected create_new_trials blip")
+
+    study._storage.create_new_trials = exploding_create_new_trials
+    with pytest.raises(RuntimeError, match="injected create_new_trials blip"):
+        study.ask_batch(4)
+
+    trials = study.get_trials(deepcopy=False)
+    failed = [t for t in trials if t.state == TrialState.FAIL]
+    waiting = [t for t in trials if t.state == TrialState.WAITING]
+    assert len(failed) == 2
+    assert not any(t.state == TrialState.RUNNING for t in trials)
+    for t in failed:
+        assert "batch ask aborted" in t.system_attrs["fail_reason"]
+    # The two claimed enqueued trials were re-enqueued as retry clones with
+    # their fixed params intact.
+    assert len(waiting) == 2
+    assert {t.system_attrs["failed_trial"] for t in waiting} == {f.number for f in failed}
+
+
 def test_enqueue_trial():
     study = create_study(sampler=RandomSampler(seed=0))
     study.enqueue_trial({"x": 5.0, "y": 3, "c": "b"})

@@ -1,0 +1,1007 @@
+"""Chaos suite for the resilient vectorized batch executor (ISSUE 4).
+
+The batch is the economical unit on TPU — these tests make it the unit of
+*failure* too, and prove each containment layer of
+``optuna_tpu/parallel/executor.py`` against injected faults:
+
+* non-finite quarantine (``non_finite='fail'|'raise'|'clip'``) keeps sampler
+  fits finite while the healthy batch completes;
+* crash bisection isolates a poison trial and salvages the other B-1;
+* OOM-shaped errors halve the batch under the RetryPolicy backoff schedule;
+* a hung dispatch is bounded by the deadline watchdog and takes the FAIL path;
+* a killed worker's stranded batch is reaped by a survivor and re-enqueued,
+  and the study still converges *exactly* to the fault-free run;
+* ``Study.stop()`` is honored mid-batch (no full-batch overshoot).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu._callbacks import MaxTrialsCallback
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import (
+    DispatchTimeoutError,
+    NonFiniteObjectiveError,
+    VectorizedObjective,
+    optimize_vectorized,
+)
+from optuna_tpu.samplers import RandomSampler, TPESampler
+from optuna_tpu.storages import RetryFailedTrialCallback, RetryPolicy
+from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
+from optuna_tpu.storages._heartbeat import fail_stale_trials
+from optuna_tpu.storages._rdb.storage import RDBStorage
+from optuna_tpu.testing.fault_injection import (
+    FaultyVectorizedObjective,
+    SimulatedWorkerDeath,
+)
+from optuna_tpu.trial._frozen import create_trial
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+
+def _quad(params):
+    return (params["x"] - 0.3) ** 2
+
+
+def _states(study):
+    return {
+        state: sum(t.state == state for t in study.trials) for state in TrialState
+    }
+
+
+# ------------------------------------------------------ non-finite quarantine
+
+
+def test_nan_quarantine_fails_poisoned_trials_only():
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (1, 4)})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(study, obj, n_trials=16, batch_size=8)
+
+    counts = _states(study)
+    assert counts[TrialState.COMPLETE] == 14
+    assert counts[TrialState.FAIL] == 2
+    assert counts[TrialState.RUNNING] == 0
+    failed = [t for t in study.trials if t.state == TrialState.FAIL]
+    assert sorted(t.number for t in failed) == [1, 4]
+    assert all("non-finite" in t.system_attrs["fail_reason"] for t in failed)
+    # No COMPLETE trial carries a non-finite value, so downstream fits can't
+    # ingest NaN, and best_value is well-defined.
+    assert all(
+        np.isfinite(t.value) for t in study.trials if t.state == TrialState.COMPLETE
+    )
+    assert np.isfinite(study.best_value)
+
+
+def test_nan_quarantine_keeps_tpe_fit_finite_and_converging():
+    """The satellite claim end to end: a NaN-poisoned batch must not poison
+    the sampler's model — TPE keeps fitting past its startup window and the
+    study still finds the optimum basin."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (0, 3), 2: (5,)})
+    study = optuna_tpu.create_study(
+        sampler=TPESampler(seed=7, n_startup_trials=8, constant_liar=True)
+    )
+    optimize_vectorized(study, obj, n_trials=48, batch_size=8)
+    counts = _states(study)
+    assert counts[TrialState.FAIL] == 3
+    assert counts[TrialState.COMPLETE] == 45
+    assert counts[TrialState.RUNNING] == 0
+    assert np.isfinite(study.best_value)
+    assert study.best_value < 0.05
+
+
+def test_non_finite_raise_policy_quarantines_then_raises():
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (2,)})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=1))
+    with pytest.raises(NonFiniteObjectiveError):
+        optimize_vectorized(study, obj, n_trials=8, batch_size=8, non_finite="raise")
+    counts = _states(study)
+    # Containment before the raise: the poison trial is FAIL, the healthy
+    # batchmates COMPLETE, nothing is stranded RUNNING.
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 1
+    assert counts[TrialState.COMPLETE] == 7
+
+
+def test_non_finite_clip_policy_completes_everything_finite():
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (2,)})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=1))
+    optimize_vectorized(study, obj, n_trials=8, batch_size=8, non_finite="clip")
+    trials = study.trials
+    assert all(t.state == TrialState.COMPLETE for t in trials)
+    assert all(np.isfinite(t.value) for t in trials)
+    # The poisoned slot was clipped in-graph (nan_to_num: NaN -> 0.0).
+    assert trials[2].value == 0.0
+
+
+@pytest.mark.parametrize("batch_size", [0, -4])
+def test_non_positive_batch_size_is_rejected(batch_size):
+    """Regression (code review): ask_batch(0) returns [] and ``done`` never
+    advances, so an unvalidated batch_size<=0 hung run() forever."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    with pytest.raises(ValueError, match="batch_size"):
+        optimize_vectorized(
+            study, VectorizedObjective(_quad, SPACE), n_trials=4, batch_size=batch_size
+        )
+
+
+def test_invalid_non_finite_policy_is_rejected():
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study()
+    with pytest.raises(ValueError, match="non_finite"):
+        optimize_vectorized(study, obj, n_trials=8, non_finite="explode")
+
+
+# --------------------------------------------------- crash containment paths
+
+
+def test_poison_trial_bisection_salvages_the_rest():
+    """Seed 5 draws exactly one x > 0.9 in the first batch (slot 3); the
+    persistent poison crashes every dispatch containing it, and bisection
+    must isolate it: B-1 trials COMPLETE, the poison trial alone FAILs."""
+    obj = FaultyVectorizedObjective(
+        _quad, SPACE, raise_when=lambda host: bool((host["x"] > 0.9).any())
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=5))
+    optimize_vectorized(study, obj, n_trials=8, batch_size=8)
+
+    trials = study.trials
+    poison = [t for t in trials if t.params["x"] > 0.9]
+    healthy = [t for t in trials if t.params["x"] <= 0.9]
+    assert len(poison) == 1  # the seed guarantees the scenario is non-vacuous
+    assert poison[0].state == TrialState.FAIL
+    assert "dispatch raised" in poison[0].system_attrs["fail_reason"]
+    assert all(t.state == TrialState.COMPLETE for t in healthy)
+    assert obj.dispatches > 1  # bisection actually recursed
+    assert _states(study)[TrialState.RUNNING] == 0
+
+
+def test_transient_crash_bisection_salvages_everything():
+    """A crash that strikes once (dispatch #0 only): both bisected halves
+    re-dispatch cleanly, so every trial completes — no FAIL at all."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_at={0})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=3))
+    optimize_vectorized(study, obj, n_trials=8, batch_size=8)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert obj.dispatch_widths == [8, 4, 4]
+
+
+def test_systemic_dispatch_error_surfaces_instead_of_silent_all_fail():
+    """Regression (code review): with bisection on, an objective that raises
+    on *every* dispatch used to be swallowed leaf by leaf — the study would
+    return normally with all n_trials FAILed and no error. Consecutive leaf
+    containments share the retry policy's bounded budget (reset by any
+    completed dispatch), after which the error surfaces like the serial
+    loop's propagate-on-first-raise."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_when=lambda _p: True)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=6))
+    with pytest.raises(RuntimeError, match="injected dispatch crash"):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=16,
+            batch_size=8,
+            retry_policy=RetryPolicy(max_attempts=3, sleep=lambda _s: None),
+        )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 8  # first batch fully contained, no second
+
+
+def test_crash_without_bisection_fails_whole_batch_and_raises():
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_at={0})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=3))
+    with pytest.raises(RuntimeError, match="injected dispatch crash"):
+        optimize_vectorized(
+            study, obj, n_trials=8, batch_size=8, bisect_on_error=False
+        )
+    counts = _states(study)
+    # Marked FAIL instead of stranded RUNNING — the crash is loud but clean.
+    assert counts[TrialState.FAIL] == 8
+    assert counts[TrialState.RUNNING] == 0
+    failed = study.trials
+    assert all("dispatch raised" in t.system_attrs["fail_reason"] for t in failed)
+
+
+def test_oom_shaped_error_halves_batch_with_backoff_and_completes():
+    sleeps: list[float] = []
+    obj = FaultyVectorizedObjective(_quad, SPACE, oom_above=4)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=1))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=16,
+        batch_size=8,
+        retry_policy=RetryPolicy(max_attempts=5, sleep=sleeps.append),
+    )
+    # First dispatch OOMs at width 8, is split into two width-4 halves, and
+    # every later batch sticks to the halved size.
+    assert obj.dispatch_widths == [8, 4, 4, 4, 4]
+    assert len(sleeps) == 1  # one backoff per halving, through the policy
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert len(study.trials) == 16
+
+
+def test_oom_cascade_reaches_floor_regardless_of_retry_budget():
+    """Regression (code review): ``_oom_attempts`` was a lifetime budget, so
+    a deep halving cascade — or transient OOMs spread across a long study —
+    could exhaust it before the batch reached the advertised
+    one-device-multiple floor, killing a salvageable study. Halving is
+    log-bounded by construction; the counter only paces the backoff."""
+    sleeps: list[float] = []
+    obj = FaultyVectorizedObjective(_quad, SPACE, oom_above=2)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=4))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=32,
+        batch_size=32,
+        # Two attempts "budget" but four halvings needed (32 -> 2): the old
+        # gate raised RESOURCE_EXHAUSTED at width 16.
+        retry_policy=RetryPolicy(max_attempts=2, sleep=sleeps.append),
+    )
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert len(study.trials) == 32
+    assert min(obj.dispatch_widths) == 2  # reached a width that fits
+    assert obj.dispatch_widths[-1] == 2  # and the cascade ended on one
+
+
+def test_persistent_oom_at_floor_fails_batch_and_raises():
+    """An OOM that keeps striking even at one device-multiple must not loop:
+    the floor bounds the halving, the dispatch's trials FAIL, and the
+    error surfaces to the caller."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, oom_above=0)  # every width OOMs
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=8,
+            batch_size=8,
+            retry_policy=RetryPolicy(max_attempts=3, sleep=lambda _s: None),
+        )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] >= 1
+
+
+# ----------------------------------------------------------- dispatch deadline
+
+
+def test_dispatch_deadline_converts_hang_into_fail_path():
+    obj = FaultyVectorizedObjective(_quad, SPACE, hang_at={0}, hang_s=5.0)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    with pytest.raises(DispatchTimeoutError):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=4,
+            batch_size=4,
+            bisect_on_error=False,
+            dispatch_deadline_s=0.2,
+        )
+    counts = _states(study)
+    assert counts[TrialState.FAIL] == 4
+    assert counts[TrialState.RUNNING] == 0
+
+
+def test_persistent_hang_is_bounded_by_timeout_strike_budget():
+    """A wedged device (every dispatch hangs) must not bisect forever and
+    leak an abandoned watchdog thread per leaf: consecutive timeouts share
+    the retry policy's bounded budget, then the error surfaces with every
+    trial FAILed."""
+    obj = FaultyVectorizedObjective(
+        _quad, SPACE, hang_at=set(range(64)), hang_s=5.0
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    with pytest.raises(DispatchTimeoutError):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=16,
+            batch_size=8,
+            dispatch_deadline_s=0.2,
+            retry_policy=RetryPolicy(max_attempts=2, sleep=lambda _s: None),
+        )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 8  # the first batch, fully contained
+    assert obj.dispatches <= 3  # budget bounds the abandoned-thread count
+
+
+def test_dispatch_deadline_covers_async_realization():
+    """Regression (code review): jax dispatch is asynchronous — the jit call
+    returns unrealized futures in milliseconds and the real device wait
+    happens at host realization (np.asarray). The watchdog must cover that
+    wait, not just the enqueue, or a wedged device hangs the study despite
+    ``dispatch_deadline_s``."""
+
+    class _LazyHang:
+        """Array-like whose realization blocks, like a future from a hung
+        device: np.asarray() on it sleeps far past the deadline."""
+
+        def __init__(self, values, hang_s):
+            self._values = np.asarray(values)
+            self._hang_s = hang_s
+
+        def __array__(self, dtype=None, copy=None):
+            time.sleep(self._hang_s)
+            return self._values if dtype is None else self._values.astype(dtype)
+
+    class _AsyncHungObjective:
+        search_space = SPACE
+
+        def guarded(self, mesh, batch_axis, non_finite="fail"):
+            def _fn(args):
+                width = next(iter(args.values())).shape[0]
+                # Returns instantly — the hang is deferred to realization.
+                return (
+                    _LazyHang(np.zeros(width), hang_s=5.0),
+                    _LazyHang(np.ones(width, dtype=bool), hang_s=0.0),
+                )
+
+            return _fn
+
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=5))
+    start = time.monotonic()
+    with pytest.raises(DispatchTimeoutError):
+        optimize_vectorized(
+            study,
+            _AsyncHungObjective(),
+            n_trials=4,
+            batch_size=4,
+            bisect_on_error=False,
+            dispatch_deadline_s=0.2,
+        )
+    assert time.monotonic() - start < 4.0  # bounded by the deadline, not the hang
+    counts = _states(study)
+    assert counts[TrialState.FAIL] == 4
+    assert counts[TrialState.RUNNING] == 0
+
+
+def test_dispatch_deadline_with_bisection_salvages_after_transient_hang():
+    obj = FaultyVectorizedObjective(_quad, SPACE, hang_at={0}, hang_s=5.0)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    optimize_vectorized(
+        study, obj, n_trials=4, batch_size=4, dispatch_deadline_s=0.2
+    )
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+
+# ------------------------------------------------------- stop() mid-batch
+
+
+def test_stop_mid_batch_does_not_overshoot_budget():
+    """Regression (ISSUE 4 satellite): MaxTrialsCallback(3) under B=8 used to
+    overshoot to a full batch of 8 COMPLETEs because the stop flag was only
+    read at the batch boundary. The tell loop must stop at 3 and quarantine
+    the already-evaluated remainder as FAIL — never COMPLETE, never RUNNING."""
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=24,
+        batch_size=8,
+        callbacks=[MaxTrialsCallback(3)],
+    )
+    counts = _states(study)
+    assert counts[TrialState.COMPLETE] == 3
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 5
+    assert len(study.trials) == 8  # the second batch was never asked
+    stopped = [t for t in study.trials if t.state == TrialState.FAIL]
+    assert all("stopped" in t.system_attrs["fail_reason"] for t in stopped)
+
+
+def test_stop_from_quarantine_callback_does_not_swallow_raise_policy():
+    """Regression (code review): under non_finite='raise', a Study.stop()
+    fired by the quarantined trial's own callback used to return from the
+    tell loop before the post-loop raise — a caller using 'raise' as a NaN
+    tripwire saw a clean return. The stop breaks, then the promised
+    NonFiniteObjectiveError still surfaces."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (0,)})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+
+    def stop_on_fail(s, frozen):
+        if frozen.state == TrialState.FAIL:
+            s.stop()
+
+    with pytest.raises(NonFiniteObjectiveError):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=8,
+            batch_size=8,
+            non_finite="raise",
+            callbacks=[stop_on_fail],
+        )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 8  # quarantined + stopped remainder
+
+
+def test_callbacks_fire_exactly_once_for_every_terminal_path():
+    """Parity with the serial loop: user callbacks see every finished trial
+    exactly once — COMPLETE, NaN quarantine, and bisection-leaf FAIL alike."""
+    seen: list[tuple[int, TrialState]] = []
+    # Seed 5's poison trial is slot 3: dispatch 0 (full batch) crashes, and
+    # bisection reaches the healthy [0, 1] leaf as dispatch 2 — where the
+    # NaN injection poisons trial 0, exercising quarantine-inside-bisection.
+    obj = FaultyVectorizedObjective(
+        _quad,
+        SPACE,
+        nan_at={2: (0,)},
+        raise_when=lambda host: bool((host["x"] > 0.9).any()),
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=5))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=8,
+        batch_size=8,
+        callbacks=[lambda _s, frozen: seen.append((frozen.number, frozen.state))],
+    )
+    assert sorted(number for number, _ in seen) == list(range(8))
+    by_number = dict(seen)
+    assert by_number[0] == TrialState.FAIL  # NaN quarantine
+    assert sum(state == TrialState.FAIL for state in by_number.values()) == 2
+    assert sum(state == TrialState.COMPLETE for state in by_number.values()) == 6
+
+
+def test_value_conversion_fail_still_notifies_callbacks():
+    """Regression (code review): the reap-race guard used to skip callbacks
+    for any tell whose frozen state was not COMPLETE — including tells the
+    tell path itself converted to FAIL (value-arity mismatch against a
+    multi-objective study). A state this worker committed must notify, or a
+    MaxTrialsCallback counting FAILs silently never fires."""
+    seen: list[TrialState] = []
+    study = optuna_tpu.create_study(
+        directions=["minimize", "minimize"], sampler=RandomSampler(seed=0)
+    )
+
+    # Three objective values against two directions: every tell FAILs with
+    # the arity-mismatch warning instead of completing.
+    def _wrong_arity(params):
+        import jax.numpy as jnp
+
+        v = (params["x"] - 0.3) ** 2
+        return jnp.stack([v, v, v], axis=-1)
+
+    obj = VectorizedObjective(_wrong_arity, SPACE)
+    with pytest.warns(UserWarning, match="did not match the number of the objectives"):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=4,
+            batch_size=4,
+            callbacks=[lambda _s, frozen: seen.append(frozen.state)],
+        )
+    counts = _states(study)
+    assert counts[TrialState.FAIL] == 4
+    assert counts[TrialState.RUNNING] == 0
+    assert seen == [TrialState.FAIL] * 4
+
+
+def test_width_dependent_hang_exhausts_timeout_budget():
+    """Regression (code review): the timeout-strike budget reset on *any*
+    completed dispatch, so a hang striking only at full batch width — whose
+    bisected halves always complete — accumulated one abandoned watchdog
+    thread per batch for the whole study. Hang evidence must clear only at
+    (or above) the width that hung."""
+    obj = FaultyVectorizedObjective(
+        # Full-width (8) dispatches 0 and 3 hang; the bisected halves in
+        # between complete, which used to launder the strike count.
+        _quad, SPACE, hang_at={0, 3}, hang_s=5.0
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=3))
+    with pytest.raises(DispatchTimeoutError):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=24,
+            batch_size=8,
+            dispatch_deadline_s=0.2,
+            retry_policy=RetryPolicy(max_attempts=2, sleep=lambda _s: None),
+        )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.COMPLETE] == 8  # batch 1, salvaged via bisection
+    assert counts[TrialState.FAIL] == 8  # batch 2, budget exhausted
+    assert obj.dispatches == 4  # 8-hang, 4, 4, 8-hang — then the budget trips
+
+
+def test_sub_dispatch_oom_resets_regrowth_streak():
+    """Regression (code review): only a clamp used to reset the regrowth
+    streak, so a batch whose bisection sub-dispatch hit a genuine OOM —
+    contained locally, deliberately without clamping — still counted as
+    'clean' and probationary regrowth advanced on fresh memory-pressure
+    evidence. Any OOM during a batch marks it unclean."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, oom_at={0, 4}, raise_at={3})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=8))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=28,
+        batch_size=8,
+        retry_policy=RetryPolicy(max_attempts=5, sleep=lambda _s: None),
+    )
+    # Batch 1 (d0 w8) OOMs -> clamp to 4, salvaged 4+4. Batch 2 (d3 w4)
+    # crashes -> bisect; its w2 half (d4) hits a real OOM -> contained as
+    # 1+1 with no clamp, but the batch is NOT clean, so the streak stays 0.
+    # Batches 3 and 4 (w4) are clean -> regrow to 8 for batch 5.
+    assert obj.dispatch_widths == [8, 4, 4, 4, 2, 1, 1, 2, 4, 4, 8]
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert len(study.trials) == 28
+
+
+def test_min_retry_budget_still_salvages_isolated_poison_trial():
+    """Regression (code review): the leaf/timeout strike budget reused
+    ``retry_policy.max_attempts`` verbatim, so ``max_attempts=1`` — a user
+    cutting OOM backoff retries — made the very first bisection leaf
+    re-raise before any healthy trial was salvaged. The strike budget is
+    floored at 2, decoupling poison tolerance from the OOM knob."""
+    obj = FaultyVectorizedObjective(
+        _quad, SPACE, raise_when=lambda host: bool((host["x"] > 0.9).any())
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=5))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=8,
+        batch_size=8,
+        retry_policy=RetryPolicy(max_attempts=1, sleep=lambda _s: None),
+    )
+    counts = _states(study)
+    assert counts[TrialState.COMPLETE] == 7
+    assert counts[TrialState.FAIL] == 1
+    assert counts[TrialState.RUNNING] == 0
+
+
+def test_transient_oom_clamp_grows_back_after_clean_batches():
+    """Regression (code review): the full-width OOM clamp was one-way, so a
+    single transient allocator failure (or an OOM-shaped poison error text)
+    permanently halved throughput for the rest of the run. Two consecutive
+    clean full-width batches earn one doubling back toward the requested
+    size."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, oom_at={0})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=6))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=40,
+        batch_size=8,
+        retry_policy=RetryPolicy(max_attempts=4, sleep=lambda _s: None),
+    )
+    # Dispatch 0 (width 8) OOMs once -> clamp to 4 and salvage as 4+4; two
+    # clean width-4 batches follow, then the size doubles back to 8.
+    assert obj.dispatch_widths == [8, 4, 4, 4, 4, 8, 8, 8]
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert len(study.trials) == 40
+
+
+def test_sub_dispatch_oom_does_not_clamp_study_batch_size():
+    """Regression (code review): an OOM caught inside a bisection
+    sub-dispatch used to clamp the study-wide batch size to half the
+    *sub-batch's* width — only a full-width dispatch is capacity evidence,
+    so later batches must return to the configured size."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_at={0}, oom_at={1})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=24,
+        batch_size=8,
+        retry_policy=RetryPolicy(max_attempts=4, sleep=lambda _s: None),
+    )
+    # Dispatch 0 (width 8) crashes -> bisect; dispatch 1 (first half, width
+    # 4) hits a transient OOM -> halved locally to 2+2; second half runs at
+    # 4 — and the remaining two batches come back at the full width 8.
+    assert obj.dispatch_widths == [8, 4, 2, 2, 4, 8, 8]
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert len(study.trials) == 24
+
+
+def test_oom_shaped_poison_error_is_salvaged_not_fatal():
+    """Regression (code review): a poison trial whose error text merely
+    *looks* OOM-shaped used to abort the study once halving bottomed out —
+    it must fall through to leaf containment so the healthy trials'
+    B-1 salvage survives the misclassification."""
+    obj = FaultyVectorizedObjective(
+        _quad,
+        SPACE,
+        raise_when=lambda host: bool((host["x"] > 0.9).any()),
+        error_factory=lambda _i: RuntimeError(
+            "ran out of memory in user preprocessing"
+        ),
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=5))
+    optimize_vectorized(
+        study,
+        obj,
+        n_trials=8,
+        batch_size=8,
+        retry_policy=RetryPolicy(max_attempts=4, sleep=lambda _s: None),
+    )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 1
+    assert counts[TrialState.COMPLETE] == 7
+    failed = [t for t in study.trials if t.state == TrialState.FAIL]
+    assert all(t.params["x"] > 0.9 for t in failed)
+
+
+def test_reaped_trial_is_not_double_notified(monkeypatch):
+    """Regression (code review): when a concurrent survivor reaps a trial
+    between this worker's dispatch and its tell, the skipped tell must also
+    skip the user callbacks — the reaper owns the terminal state and
+    notified for it — on the COMPLETE path and on both halves of the
+    _fail_trials race window alike."""
+    from optuna_tpu.parallel.executor import ResilientBatchExecutor
+
+    seen: list[int] = []
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    obj = VectorizedObjective(_quad, SPACE)
+    ex = ResilientBatchExecutor(
+        study, obj, callbacks=[lambda _s, frozen: seen.append(frozen.number)]
+    )
+
+    def _ask(n):
+        trials = study.ask_batch(n)
+        for trial in trials:
+            for name, dist in SPACE.items():
+                trial._suggest(name, dist)
+        return trials
+
+    # COMPLETE path: trial 0 was reaped to FAIL mid-dispatch; its evaluated
+    # value must neither override the reaper's state nor fire callbacks.
+    trials = _ask(2)
+    study.tell(trials[0], state=TrialState.FAIL)
+    ex._tell_batch(trials, np.array([0.5, 0.25]), np.array([True, True]))
+    assert study.trials[0].state == TrialState.FAIL
+    assert study.trials[1].state == TrialState.COMPLETE
+    assert seen == [1]
+
+    # FAIL path, race before the attr write: the guard loses cleanly.
+    seen.clear()
+    (reaped,) = _ask(1)
+    study.tell(reaped, 0.1)
+    ex._fail_trials([reaped], "batch dispatch raised: boom")
+    assert study.trials[reaped.number].state == TrialState.COMPLETE
+    assert seen == []
+
+    # FAIL path, race *between* the attr write and the tell: the unskipped
+    # tell surfaces UpdateFinishedTrialError and callbacks stay silent.
+    seen.clear()
+    (racy,) = _ask(1)
+    storage = study._storage
+    original = storage.set_trial_system_attr
+
+    def reap_after_attr_write(trial_id, key, value):
+        original(trial_id, key, value)
+        if key == "fail_reason" and trial_id == racy._trial_id:
+            storage.set_trial_state_values(trial_id, state=TrialState.FAIL)
+
+    monkeypatch.setattr(storage, "set_trial_system_attr", reap_after_attr_write)
+    ex._fail_trials([racy], "batch dispatch raised: boom")
+    assert study.trials[racy.number].state == TrialState.FAIL
+    assert seen == []
+
+    # COMPLETE path, race *during* the tell (after its finished-state
+    # pre-read, before its commit): the storage's UpdateFinishedTrialError
+    # must be swallowed for that trial only — the rest of the batch is
+    # still told.
+    monkeypatch.undo()
+    seen.clear()
+    trials = _ask(2)
+    target_id = trials[0]._trial_id
+    original_set_state = storage.set_trial_state_values
+    reaped_mid_tell = []
+
+    def reap_mid_tell(trial_id, state, values=None):
+        if trial_id == target_id and state == TrialState.COMPLETE and not reaped_mid_tell:
+            reaped_mid_tell.append(trial_id)
+            original_set_state(trial_id, state=TrialState.FAIL)
+        return original_set_state(trial_id, state=state, values=values)
+
+    monkeypatch.setattr(storage, "set_trial_state_values", reap_mid_tell)
+    ex._tell_batch(trials, np.array([0.5, 0.25]), np.array([True, True]))
+    assert reaped_mid_tell  # the injected race actually fired
+    assert study.trials[trials[0].number].state == TrialState.FAIL
+    assert study.trials[trials[1].number].state == TrialState.COMPLETE
+    assert seen == [trials[1].number]
+
+
+def test_batch_setup_error_fails_created_trials_before_raising():
+    """Regression (code review): a sampler that raises mid-suggest used to
+    strand the whole just-created batch RUNNING — with zero heartbeat rows,
+    so fail_stale_trials could never reap it. Setup errors must FAIL every
+    trial of the batch before surfacing."""
+
+    class ExplodingSampler(RandomSampler):
+        def __init__(self):
+            super().__init__(seed=0)
+            self.calls = 0
+
+        def sample_independent(self, study, trial, name, dist):
+            self.calls += 1
+            if self.calls == 3:
+                raise RuntimeError("sampler exploded mid-batch")
+            return super().sample_independent(study, trial, name, dist)
+
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(sampler=ExplodingSampler())
+    with pytest.raises(RuntimeError, match="sampler exploded"):
+        optimize_vectorized(study, obj, n_trials=8, batch_size=8)
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.FAIL] == 8
+    assert all(
+        "batch aborted" in t.system_attrs["fail_reason"] for t in study.trials
+    )
+
+
+def test_storage_blip_during_fail_tells_does_not_strand_rest_of_batch(monkeypatch):
+    """Regression (code review): a storage error while FAILing one trial of
+    a crashed batch used to abort the containment loop, stranding every
+    later trial RUNNING. The loop must visit all trials, then surface the
+    storage error. The blip strikes the FAIL tell itself (the critical
+    write); a blip on the diagnostic fail_reason attr is absorbed entirely —
+    see test_fail_reason_blip_does_not_skip_fail_tell."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_at={0})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    storage = study._storage
+    original = storage.set_trial_state_values
+    blipped: list[int] = []
+
+    def blippy(trial_id, state, values=None):
+        if state == TrialState.FAIL and not blipped:
+            blipped.append(trial_id)
+            raise RuntimeError("transient storage blip")
+        return original(trial_id, state=state, values=values)
+
+    monkeypatch.setattr(storage, "set_trial_state_values", blippy)
+    with pytest.raises(RuntimeError, match="transient storage blip"):
+        optimize_vectorized(
+            study, obj, n_trials=8, batch_size=8, bisect_on_error=False
+        )
+    counts = _states(study)
+    # The containment loop visited all 8 despite the blip, and run()'s
+    # catch-all sweep retried the blipped trial before re-raising: nothing
+    # is left RUNNING, and the caller still sees the storage error.
+    assert blipped
+    assert counts[TrialState.FAIL] == 8
+    assert counts[TrialState.RUNNING] == 0
+
+
+def test_callback_error_mid_batch_fails_untold_remainder():
+    """Regression (code review): a user callback raising mid-notify used to
+    strand the batch's evaluated-but-untold remainder RUNNING; run()'s
+    containment sweep must FAIL them before the callback error surfaces."""
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+
+    def bomb(_study, frozen):
+        if frozen.number == 2:
+            raise RuntimeError("callback exploded")
+
+    with pytest.raises(RuntimeError, match="callback exploded"):
+        optimize_vectorized(study, obj, n_trials=8, batch_size=8, callbacks=[bomb])
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.COMPLETE] == 3  # trials 0-2 were told pre-bomb
+    assert counts[TrialState.FAIL] == 5
+    failed = [t for t in study.trials if t.state == TrialState.FAIL]
+    assert all("batch aborted" in t.system_attrs["fail_reason"] for t in failed)
+
+
+def test_fail_reason_blip_does_not_skip_fail_tell(monkeypatch):
+    """Regression (code review): same single-try coupling as
+    fail_and_notify_trials — a transient blip on the diagnostic fail_reason
+    write must not skip the FAIL tell and strand the trial RUNNING."""
+    from optuna_tpu.parallel.executor import ResilientBatchExecutor
+
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    obj = VectorizedObjective(_quad, SPACE)
+    ex = ResilientBatchExecutor(study, obj)
+    trials = study.ask_batch(2)
+    for trial in trials:
+        for name, dist in SPACE.items():
+            trial._suggest(name, dist)
+    storage = study._storage
+    original = storage.set_trial_system_attr
+
+    def blip_first(trial_id, key, value):
+        if trial_id == trials[0]._trial_id and key == "fail_reason":
+            raise ConnectionError("transient attr-write blip")
+        return original(trial_id, key, value)
+
+    monkeypatch.setattr(storage, "set_trial_system_attr", blip_first)
+    ex._fail_trials(trials, "batch dispatch raised: boom")
+    counts = _states(study)
+    assert counts[TrialState.FAIL] == 2
+    assert counts[TrialState.RUNNING] == 0
+
+
+def test_persistently_raising_callback_cannot_strand_trials_running():
+    """Regression (code review): a callback that raises *unconditionally*
+    used to abort the containment sweep's own notify loop after its first
+    FAIL tell, stranding the rest of the batch RUNNING forever on a
+    heartbeat-less storage. _fail_trials defers notification until every
+    trial holds a terminal state, so the callback error propagates but
+    can't undo the containment."""
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+
+    def always_bomb(_study, _frozen):
+        raise RuntimeError("callback always explodes")
+
+    with pytest.raises(RuntimeError, match="callback always explodes"):
+        optimize_vectorized(
+            study, obj, n_trials=8, batch_size=8, callbacks=[always_bomb]
+        )
+    counts = _states(study)
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.COMPLETE] == 1  # told before its callback blew up
+    assert counts[TrialState.FAIL] == 7
+
+
+def test_nested_invocation_from_callback_is_rejected():
+    """Regression (code review): a nested optimize_vectorized launched from
+    a callback used to reset the outer loop's stop flag (clobbering a
+    pending stop()); parity with the serial loop is to forbid nesting."""
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    errors: list[RuntimeError] = []
+
+    def nested(inner_study, _frozen):
+        try:
+            optimize_vectorized(inner_study, obj, n_trials=4, batch_size=4)
+        except RuntimeError as err:
+            errors.append(err)
+
+    optimize_vectorized(study, obj, n_trials=4, batch_size=4, callbacks=[nested])
+    assert len(errors) == 4  # once per finished trial's callback
+    assert all("Nested invocation" in str(err) for err in errors)
+    assert len(study.trials) == 4
+
+
+# ------------------------------------------- retry-clone system-attr hygiene
+
+
+def test_retry_callback_strips_executor_attrs_but_keeps_lineage():
+    study = optuna_tpu.create_study()
+    failed = create_trial(
+        state=TrialState.FAIL,
+        params={"x": 0.5},
+        distributions={"x": FloatDistribution(0.0, 1.0)},
+        system_attrs={
+            EXECUTOR_ATTR_PREFIX + "dispatch": {"batch": "dead/0", "slot": 3},
+            "fail_reason": "batch dispatch raised: RuntimeError('boom')",
+            "retry_history": [],
+        },
+    )
+    study.add_trial(failed)
+    RetryFailedTrialCallback()(study, study.trials[0])
+
+    clone = study.trials[1]
+    assert clone.state == TrialState.WAITING
+    assert not any(k.startswith(EXECUTOR_ATTR_PREFIX) for k in clone.system_attrs)
+    # The dead attempt's diagnostic stays on the original, not the clone.
+    assert "fail_reason" not in clone.system_attrs
+    # Lineage attrs survive the strip.
+    assert clone.system_attrs["failed_trial"] == 0
+    assert clone.system_attrs["retry_history"] == [0]
+    assert clone.system_attrs["fixed_params"] == {"x": 0.5}
+
+
+def test_executor_writes_prefixed_dispatch_bookkeeping(tmp_path):
+    """Dispatch bookkeeping is written only where failover can strand a
+    batch (heartbeat storages); heartbeat-less studies skip the B extra
+    writes per batch entirely."""
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/hb.db", heartbeat_interval=60, grace_period=120
+    )
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(storage=storage, sampler=RandomSampler(seed=0))
+    optimize_vectorized(study, obj, n_trials=8, batch_size=4)
+    for trial in study.trials:
+        record = trial.system_attrs[EXECUTOR_ATTR_PREFIX + "dispatch"]
+        assert 0 <= record["slot"] < 4
+        assert "/" in record["batch"]
+    # Two distinct batches left two distinct batch tags.
+    tags = {
+        t.system_attrs[EXECUTOR_ATTR_PREFIX + "dispatch"]["batch"]
+        for t in study.trials
+    }
+    assert len(tags) == 2
+
+    plain = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(plain, VectorizedObjective(_quad, SPACE), n_trials=4, batch_size=4)
+    assert not any(
+        k.startswith(EXECUTOR_ATTR_PREFIX) for t in plain.trials for k in t.system_attrs
+    )
+
+
+# -------------------------------------------------- the acceptance scenario
+
+
+def test_chaos_study_with_kill_reap_and_drain_converges_exactly(tmp_path):
+    """ISSUE 4 acceptance: NaN trials + one mid-batch crash + one worker
+    death in a single vectorized study. After a survivor's reap pass and a
+    drain run over the re-enqueued clones: zero trials RUNNING, every
+    healthy trial COMPLETE exactly once, and the best value identical to the
+    fault-free run."""
+    # Fault-free reference run (same sampler seed => same parameter draws).
+    clean = optuna_tpu.create_study(sampler=RandomSampler(seed=9))
+    optimize_vectorized(clean, VectorizedObjective(_quad, SPACE), n_trials=24, batch_size=8)
+    clean_values = sorted(t.value for t in clean.trials)
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/vchaos.db",
+        heartbeat_interval=60,
+        grace_period=120,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=2),
+    )
+    study = optuna_tpu.create_study(
+        study_name="vchaos", storage=storage, sampler=RandomSampler(seed=9)
+    )
+    # Dispatch schedule: batch0 = dispatch 0 (NaN at slot 2), batch1 =
+    # dispatch 1 (transient crash; bisected halves are dispatches 2+3),
+    # batch2 = dispatch 4 (worker death mid-dispatch).
+    obj = FaultyVectorizedObjective(
+        _quad, SPACE, nan_at={0: (2,)}, raise_at={1}, kill_at={4}
+    )
+    with pytest.raises(SimulatedWorkerDeath):
+        optimize_vectorized(study, obj, n_trials=24, batch_size=8)
+
+    # The death punched through containment: its whole batch is stranded
+    # RUNNING, exactly what heartbeat failover exists to reap.
+    assert _states(study)[TrialState.RUNNING] == 8
+
+    # The dead worker's heartbeats recede past the grace period; a survivor
+    # reaps the batch at its next boundary.
+    con = storage._conn()
+    con.execute("UPDATE trial_heartbeats SET heartbeat = heartbeat - 100000")
+    con.commit()
+    survivor = optuna_tpu.load_study(study_name="vchaos", storage=storage)
+    survivor.sampler = RandomSampler(seed=99)  # irrelevant: clones fix params
+    fail_stale_trials(survivor)
+
+    reaped = survivor.trials
+    clones = [t for t in reaped if t.state == TrialState.WAITING]
+    assert len(clones) == 8
+    assert sum(t.state == TrialState.RUNNING for t in reaped) == 0
+    # Executor bookkeeping was stripped from the clones; lineage survived.
+    assert not any(
+        k.startswith(EXECUTOR_ATTR_PREFIX) for c in clones for k in c.system_attrs
+    )
+    assert all("fixed_params" in c.system_attrs for c in clones)
+
+    # The NaN quarantine victim is re-enqueued through the same callback
+    # (operator-driven here; tell-FAIL deliberately does not auto-fire it).
+    retry = RetryFailedTrialCallback()
+    for t in reaped:
+        if t.state == TrialState.FAIL and "non-finite" in t.system_attrs.get("fail_reason", ""):
+            retry(survivor, t)
+
+    waiting = [t for t in survivor.trials if t.state == TrialState.WAITING]
+    assert len(waiting) == 9
+    # Drain: ask_batch claims every WAITING clone first; fixed_params
+    # round-trip so each clone re-runs its original parameters.
+    optimize_vectorized(
+        survivor, VectorizedObjective(_quad, SPACE), n_trials=len(waiting), batch_size=8
+    )
+
+    final = survivor.trials
+    counts = {s: sum(t.state == s for t in final) for s in TrialState}
+    assert counts[TrialState.RUNNING] == 0
+    assert counts[TrialState.COMPLETE] == 24  # every healthy trial, exactly once
+    final_values = sorted(t.value for t in final if t.state == TrialState.COMPLETE)
+    assert final_values == clean_values
+    assert survivor.best_value == clean.best_value
