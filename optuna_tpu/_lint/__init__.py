@@ -15,6 +15,8 @@ TPU004   stray print / jax.debug.print in package code
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 EXE001   non-finite quarantine policy sets drifted from the canonical one
+SMP001   sampler fallback policy sets drifted from the canonical one
+SMP002   bare Cholesky in sampler code (route through ladder_cholesky)
 PY001    broad ``except Exception`` without a documented reason
 LNT000   file failed to parse
 LNT001   malformed suppression pragma (reason is mandatory)
@@ -46,6 +48,10 @@ def all_rules() -> list[Rule]:
         TPU004StrayDebugOutput,
     )
     from optuna_tpu._lint.rules_py import PY001BroadExcept
+    from optuna_tpu._lint.rules_sampler import (
+        SMP001FallbackPolicySync,
+        SMP002LadderCholeskyOnly,
+    )
     from optuna_tpu._lint.rules_storage import (
         EXE001NonFinitePolicySync,
         STO001ReplayRegistrySync,
@@ -60,5 +66,7 @@ def all_rules() -> list[Rule]:
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
         EXE001NonFinitePolicySync(),
+        SMP001FallbackPolicySync(),
+        SMP002LadderCholeskyOnly(),
         PY001BroadExcept(),
     ]

@@ -81,6 +81,12 @@ class Config:
     exe001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.NON_FINITE_POLICY_REGISTRY
     )
+    smp001_targets: tuple[tuple[str, str, str], ...] = registry.SMP001_TARGETS
+    smp001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.FALLBACK_POLICY_REGISTRY
+    )
+    smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
+    smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)
     base_dir: str | None = None  # dir containing the config file, for display paths
 

@@ -11,6 +11,11 @@ in reviewers' heads:
 * :data:`NON_FINITE_POLICY_REGISTRY` — the batch executor's non-finite
   quarantine policies; rule **EXE001** keeps the executor's literal set and
   the fault-injection chaos matrix in sync (see :data:`EXE001_TARGETS`).
+* :data:`FALLBACK_POLICY_REGISTRY` — the sampler resilience layer's
+  fallback policies; rule **SMP001** keeps the ``GuardedSampler`` literal
+  set and the fault-injection chaos matrix in sync (see
+  :data:`SMP001_TARGETS`). :data:`SMP002_CHOLESKY_HELPER` names the single
+  blessed Cholesky call site for sampler code (rule **SMP002**).
 * :data:`DEVICE_MODULE_PATHS` — the f32-hardened, sync-free modules where
   the TPU rules apply. Everything the paper's "one fused dispatch per
   suggestion" latency argument rests on lives here.
@@ -85,6 +90,39 @@ EXE001_TARGETS: tuple[tuple[str, str, str], ...] = (
     ),
 )
 
+#: The sampler fallback policies the resilience layer accepts, with the
+#: containment semantics each one promises. Two code sites carry a
+#: hand-written copy (see :data:`SMP001_TARGETS`); rule **SMP001** fails the
+#: lint if either drifts from this registry.
+FALLBACK_POLICY_REGISTRY: dict[str, str] = {
+    "independent": "degrade: a sampler failure falls back to independent/random sampling",
+    "raise": "strict: record the fallback attr, then re-raise the sampler's error",
+}
+
+#: The hand-maintained copies SMP001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+SMP001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/samplers/_resilience.py",
+        "FALLBACK_POLICIES",
+        "the resilience layer's accepted policy literals (validated at construction)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "FALLBACK_CHAOS_POLICIES",
+        "chaos matrix: every fallback policy must have an injection scenario",
+    ),
+)
+
+#: The single blessed Cholesky call site for sampler code (rule **SMP002**):
+#: every kernel solve in ``optuna_tpu/samplers/`` must go through the
+#: jitter-ladder helper there, which escalates diagonal jitter in-graph until
+#: the factor is finite — a bare ``jnp.linalg.cholesky`` silently returns NaN
+#: on an ill-conditioned Gram matrix on TPU instead of raising.
+SMP002_SAMPLER_PATHS: tuple[str, ...] = ("optuna_tpu/samplers/",)
+SMP002_CHOLESKY_HELPER: str = "optuna_tpu/samplers/_resilience.py"
+
 #: Path fragments (posix, package-qualified) classifying a module as a
 #: device module: f32-hardened, host-sync-free inside jit. A trailing slash
 #: means "the whole subtree". Mirrored by ``[tool.graphlint] device-paths``
@@ -93,6 +131,7 @@ DEVICE_MODULE_PATHS: tuple[str, ...] = (
     "optuna_tpu/ops/",
     "optuna_tpu/gp/",
     "optuna_tpu/samplers/_tpe/_kernels.py",
+    "optuna_tpu/samplers/_resilience.py",
     "optuna_tpu/parallel/executor.py",
 )
 

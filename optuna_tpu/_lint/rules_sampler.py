@@ -1,0 +1,68 @@
+"""Sampler-resilience rules: SMP001 fallback-policy registry sync, SMP002
+single Cholesky call site.
+
+SMP001 is the STO001/EXE001 pattern pointed at the sampler resilience
+layer: the fallback policy set exists in two hand-written copies
+(``samplers/_resilience.py::FALLBACK_POLICIES`` — validated at
+construction — and the chaos matrix
+``testing/fault_injection.py::FALLBACK_CHAOS_POLICIES``), each statically
+compared against the canonical ``registry.FALLBACK_POLICY_REGISTRY``.
+
+SMP002 enforces the jitter-ladder contract mechanically: on TPU a bare
+``jnp.linalg.cholesky`` silently returns NaN factors on an ill-conditioned
+Gram matrix, so every Cholesky in sampler code must route through
+``samplers/_resilience.py::ladder_cholesky`` (whose own blessed bare call
+carries the pragma). The rule flags any ``*.cholesky(...)`` call under the
+configured sampler paths.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Sequence
+
+from optuna_tpu._lint.config import _device_path_matches
+from optuna_tpu._lint.engine import Finding, ModuleContext, Rule
+from optuna_tpu._lint.rules_storage import _RegistrySyncRule
+
+
+class SMP001FallbackPolicySync(_RegistrySyncRule):
+    id = "SMP001"
+    title = "sampler fallback policy sets out of sync"
+    noun = "fallback policies"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.smp001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.smp001_targets
+
+
+class SMP002LadderCholeskyOnly(Rule):
+    id = "SMP002"
+    title = "bare Cholesky call in sampler code"
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not any(
+            _device_path_matches(ctx.path, pattern)
+            for pattern in ctx.config.smp002_paths
+        ):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None
+            )
+            if name != "cholesky":
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "bare cholesky in sampler code: on TPU it returns NaN factors "
+                "on an ill-conditioned Gram matrix instead of raising — route "
+                f"through {ctx.config.smp002_helper}::ladder_cholesky "
+                "(escalating in-graph jitter, device-side isfinite verdict)",
+            )

@@ -74,8 +74,13 @@ def _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters):
 
 
 def _state_for(params, X, y, cat_mask, mask):
+    from optuna_tpu.samplers._resilience import ladder_cholesky
+
     K = _kernel_with_noise(X, params, cat_mask, mask)
-    L = jnp.linalg.cholesky(K)
+    # Jitter-ladder factorization: duplicate design rows (routine once retry
+    # clones re-run identical params) make K rank-deficient, and on TPU a
+    # bare cholesky returns NaN silently instead of raising.
+    L = ladder_cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
     return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
 
@@ -168,7 +173,14 @@ def _maximize_logei(
             x, cur = sweep(x, cur)
 
     winner = jnp.argmax(cur)
-    return x[winner], cur[winner]
+    x_win = x[winner]
+    # Final in-graph isfinite mask over the proposal (ring 1 of the sampler
+    # resilience contract): should the L-BFGS ascent ever walk a coordinate
+    # to NaN/Inf, fall back per-coordinate to the best preliminary candidate
+    # — finite by construction (Sobol decode + observed incumbents).
+    prelim_best = candidates[jnp.argmax(vals)]
+    x_win = jnp.where(jnp.isfinite(x_win), x_win, prelim_best)
+    return x_win, cur[winner]
 
 
 @partial(

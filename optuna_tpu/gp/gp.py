@@ -93,7 +93,17 @@ def _kernel_with_noise(
     n = X.shape[-2]
     # Real rows get (noise + jitter); padded rows get huge noise, which makes
     # their alpha ~ 0 and their MLL contribution parameter-independent.
-    diag = jnp.where(mask > 0, params.noise + _JITTER, _PAD_NOISE)
+    # mask doubles as a count weight (samplers/_resilience.py::
+    # collapse_duplicate_rows): a row standing for k exact-duplicate
+    # observations carries mask=k and observation noise noise/k. At fixed
+    # kernel params this reproduces the full-data posterior exactly; the
+    # MLL is an approximation — the within-group scatter term (its noise
+    # evidence) is dropped, a deliberate trade for a non-singular Gram on
+    # duplicate-heavy histories. Ordinary rows have mask=1, where the
+    # division is exact and nothing changes.
+    diag = jnp.where(
+        mask > 0, (params.noise + _JITTER) / jnp.maximum(mask, 1.0), _PAD_NOISE
+    )
     return K + jnp.eye(n, dtype=X.dtype) * diag
 
 
@@ -177,8 +187,13 @@ def _finalize_state(
         scale=jnp.exp(raw[d]),
         noise=jnp.exp(raw[d + 1]) + minimum_noise,
     )
+    from optuna_tpu.samplers._resilience import ladder_cholesky
+
     K = _kernel_with_noise(X, params, cat_mask, mask)
-    L = jnp.linalg.cholesky(K)
+    # Posterior factorization rides the jitter ladder: the fit's own loss
+    # guards against a failed Cholesky (non-finite -> 1e10), but the final
+    # state must deliver a usable factor even for a rank-deficient Gram.
+    L = ladder_cholesky(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
     return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
 
@@ -195,11 +210,17 @@ def fit_gp(
     minimum_noise: float = DEFAULT_MINIMUM_NOISE_VAR,
     n_restarts: int = 4,
     seed: int = 0,
+    counts: np.ndarray | None = None,
 ) -> tuple[GPState, np.ndarray]:
     """Fit kernel params by MAP (MLL + priors) with batched multi-start
     L-BFGS; returns the fitted state and the raw log-params for warm starts
     (reference ``fit_kernel_params:452`` retries with defaults on failure —
-    here the default start is *always* in the batch, so the retry is free)."""
+    here the default start is *always* in the batch, so the retry is free).
+    ``counts`` (optional, per-row) marks rows that stand for that many
+    exact-duplicate observations (see ``samplers/_resilience.py::
+    collapse_duplicate_rows``); the mask carries them so each such row's
+    observation noise is divided by its count (posterior-exact at fixed
+    kernel params; the fitted MLL drops the within-group scatter term)."""
     n, d = X.shape
     N = _bucket(n)
     Xp = np.zeros((N, d), dtype=np.float32)
@@ -207,7 +228,7 @@ def fit_gp(
     yp = np.zeros(N, dtype=np.float32)
     yp[:n] = y
     maskp = np.zeros(N, dtype=np.float32)
-    maskp[:n] = 1.0
+    maskp[:n] = 1.0 if counts is None else counts
 
     default = np.zeros(d + 2, dtype=np.float32)
     default[:d] = 0.0  # inv_sq_ls = 1

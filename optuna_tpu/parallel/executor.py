@@ -49,6 +49,11 @@ from optuna_tpu.storages._heartbeat import (
     is_heartbeat_enabled,
 )
 from optuna_tpu.storages._retry import RetryPolicy
+from optuna_tpu.samplers._resilience import (
+    FALLBACK_POLICIES,
+    SAMPLER_FALLBACK_ATTR_PREFIX,
+    non_finite_param_names,
+)
 from optuna_tpu.trial._state import TrialState
 from optuna_tpu.trial._trial import Trial
 
@@ -77,6 +82,49 @@ NON_FINITE_POLICIES: dict[str, str] = {
 
 class DispatchTimeoutError(OptunaTPUError, TimeoutError):
     """A device dispatch overran ``dispatch_deadline_s`` and was abandoned."""
+
+
+def run_with_deadline(
+    fn: Callable[[], "object"],
+    deadline_s: float,
+    clock: Callable[[], float] = time.monotonic,
+    *,
+    describe: str = "device dispatch",
+    thread_name: str = "optuna-tpu-dispatch",
+) -> "object":
+    """Run ``fn`` on a watchdog thread; raise :class:`DispatchTimeoutError`
+    when it overruns ``deadline_s`` (measured on the injectable ``clock``).
+
+    The hung thread is abandoned (daemon) and its eventual result, if any,
+    discarded — the caller takes its failure path. Shared by the batch
+    executor's dispatch watchdog and the sampler resilience layer's fit
+    watchdog (:mod:`optuna_tpu.samplers._resilience`): both need a hang to
+    become a contained failure, not a stuck study.
+    """
+    box: list = []
+    failure: list[BaseException] = []
+
+    def _target() -> None:
+        try:
+            box.append(fn())
+        except BaseException as err:  # graphlint: ignore[PY001] -- thread trampoline: the error is re-raised verbatim on the dispatching thread below, nothing is swallowed
+            failure.append(err)
+
+    worker = threading.Thread(target=_target, name=thread_name, daemon=True)
+    start = clock()
+    worker.start()
+    while worker.is_alive():
+        remaining = deadline_s - (clock() - start)
+        if remaining <= 0:
+            break
+        worker.join(timeout=min(0.05, remaining))
+    if worker.is_alive():
+        raise DispatchTimeoutError(
+            f"{describe} exceeded the {deadline_s}s deadline"
+        )
+    if failure:
+        raise failure[0]
+    return box[0]
 
 
 class NonFiniteObjectiveError(OptunaTPUError, ValueError):
@@ -135,6 +183,7 @@ class ResilientBatchExecutor:
         batch_axis: str = "trials",
         callbacks: Sequence[Callable] | None = None,
         non_finite: str = "fail",
+        fallback: str | None = None,
         bisect_on_error: bool = True,
         retry_policy: RetryPolicy | None = None,
         dispatch_deadline_s: float | None = None,
@@ -144,6 +193,19 @@ class ResilientBatchExecutor:
             raise ValueError(
                 f"non_finite must be one of {sorted(NON_FINITE_POLICIES)}; "
                 f"got {non_finite!r}."
+            )
+        if fallback is None:
+            # Inherit the study's declared policy: a user who built the
+            # study with sampler_fallback='raise' asked for loud sampler
+            # failures, and the executor's own containment must not quietly
+            # contradict that. Unguarded studies default to 'independent'.
+            fallback = getattr(study.sampler, "fallback", None)
+            if fallback not in FALLBACK_POLICIES:
+                fallback = "independent"
+        if fallback not in FALLBACK_POLICIES:
+            raise ValueError(
+                f"fallback must be one of {sorted(FALLBACK_POLICIES)}; "
+                f"got {fallback!r}."
             )
         if batch_size is not None and batch_size < 1:
             # An empty batch would loop forever in run(): ask_batch(0)
@@ -155,6 +217,8 @@ class ResilientBatchExecutor:
         self._batch_axis = batch_axis
         self._callbacks = list(callbacks or ())
         self._non_finite = non_finite
+        self._fallback = fallback
+        self._batch_fallback_reason: str | None = None
         self._bisect = bisect_on_error
         self._policy = retry_policy if retry_policy is not None else RetryPolicy()
         # Leaf/timeout strikes share the retry policy's attempt count but
@@ -278,14 +342,36 @@ class ResilientBatchExecutor:
 
     def _ask_batch(self, b: int) -> tuple[list[Trial], list | None]:
         """Create the batch's trials (one storage commit). A sampler that
-        raises in ``sample_relative_batch`` escapes *before* any trial
-        exists — nothing to contain."""
+        raises in ``sample_relative_batch`` does so *before* any trial
+        exists; under ``fallback='independent'`` the batch degrades to
+        guarded per-trial suggestion (sampler-fault containment — storage
+        faults during ask still take the batch-FAIL path) instead of
+        aborting the run."""
         study = self._study
         proposals = None
+        self._batch_fallback_reason = None
         if hasattr(study.sampler, "sample_relative_batch"):
-            proposals = study.sampler.sample_relative_batch(
-                study, self._objective.search_space, b
-            )
+            try:
+                proposals = study.sampler.sample_relative_batch(
+                    study, self._objective.search_space, b
+                )
+            except Exception as err:  # graphlint: ignore[PY001] -- sampler-fault containment boundary: a batch-fit crash degrades this batch to independent sampling under fallback='independent' ('raise' re-raises)
+                if self._fallback == "raise":
+                    raise
+                self._batch_fallback_reason = f"{type(err).__name__}: {err}"[:500]
+                _logger.warning(
+                    f"sampler batch suggestion raised {err!r}; falling back "
+                    "to independent sampling for this batch."
+                )
+            else:
+                if proposals is None:
+                    # A GuardedSampler swallows its inner sampler's batch-fit
+                    # crash and returns None; distinguish that from an honest
+                    # decline (startup phase) so a broken fit degrades this
+                    # batch ONCE instead of being re-attempted per trial.
+                    self._batch_fallback_reason = getattr(
+                        study.sampler, "last_batch_fallback_reason", None
+                    )
         return study.ask_batch(b), proposals
 
     def _prepare_batch(self, trials: list[Trial], proposals: list | None) -> None:
@@ -302,8 +388,65 @@ class ResilientBatchExecutor:
         tag_dispatch = is_heartbeat_enabled(study._storage)
         for i, trial in enumerate(trials):
             if proposals is not None:
+                proposal = proposals[i]
+                bad = non_finite_param_names(proposal, space)
+                if bad:
+                    # Per-trial non-finite quarantine on the proposal batch:
+                    # only the poisoned trial degrades to independent dims;
+                    # its batch-mates keep their joint proposals.
+                    reason = (
+                        f"non-finite proposal for {bad}: "
+                        f"{ {k: proposal[k] for k in bad} }"
+                    )
+                    if self._fallback == "raise":
+                        raise ValueError(reason)
+                    self._note_sampler_fallback(trial, "relative_batch", reason)
+                    proposal = {k: v for k, v in proposal.items() if k not in bad}
                 trial.relative_search_space = space
-                trial.relative_params = proposals[i]
+                trial.relative_params = proposal
+            elif self._batch_fallback_reason is not None:
+                # The batch fit raised before trials existed: pin an empty
+                # relative proposal so every dim goes through the sampler's
+                # independent path, and record why on each trial.
+                trial.relative_search_space = space
+                trial.relative_params = {}
+                self._note_sampler_fallback(
+                    trial, "relative_batch", self._batch_fallback_reason
+                )
+            elif self._needs_relative(trial):
+                # Per-trial lazy relative sampling (no batch hook, or the
+                # sampler declined). Force it under containment now: a
+                # sampler crash here degrades THIS trial to independent
+                # sampling instead of taking the whole batch down the FAIL
+                # path — storage faults during the suggest writes below
+                # still batch-FAIL as before. Faithful to the lazy path:
+                # trials that would never have sampled relatively (empty
+                # relative space, every space param pinned by fixed_params —
+                # retry clones) are not forced through a fit they'd have
+                # skipped, so the sampler's RNG stream and per-batch cost
+                # match the pre-guard behavior exactly.
+                try:
+                    relative = trial._ensure_relative_params()
+                except Exception as err:  # graphlint: ignore[PY001] -- sampler-fault containment boundary: a per-trial fit crash degrades this trial to independent sampling under fallback='independent' ('raise' re-raises)
+                    if self._fallback == "raise":
+                        raise
+                    self._note_sampler_fallback(
+                        trial, "relative", f"{type(err).__name__}: {err}"[:500]
+                    )
+                    trial.relative_params = {}
+                else:
+                    bad = non_finite_param_names(relative, trial.relative_search_space)
+                    if bad:
+                        reason = (
+                            f"non-finite proposal for {bad}: "
+                            f"{ {k: relative[k] for k in bad} }"
+                        )
+                        if self._fallback == "raise":
+                            raise ValueError(reason)
+                        self._note_sampler_fallback(trial, "relative", reason)
+                        trial.relative_params = {
+                            k: v for k, v in relative.items() if k not in bad
+                        }
             for name, dist in space.items():
                 # Claimed retry clones carry fixed_params, which _suggest
                 # honors before any sampler proposal — lineage round-trips.
@@ -314,6 +457,35 @@ class ResilientBatchExecutor:
                     EXECUTOR_ATTR_PREFIX + "dispatch",
                     {"batch": batch_tag, "slot": i},
                 )
+
+    def _needs_relative(self, trial: Trial) -> bool:
+        """Would the lazy suggest path invoke ``sample_relative`` for this
+        trial? True iff some objective-space param is in the trial's relative
+        search space and not already pinned by ``fixed_params``."""
+        fixed = trial._cached_frozen_trial.system_attrs.get("fixed_params") or {}
+        return any(
+            name in trial.relative_search_space and name not in fixed
+            for name in self._objective.search_space
+        )
+
+    def _note_sampler_fallback(self, trial: Trial, phase: str, reason: str) -> None:
+        """Record why a trial's suggestion degraded — same attr namespace as
+        :class:`~optuna_tpu.samplers._resilience.GuardedSampler` (NOT
+        ``batch_exec:``-prefixed: fallback lineage describes the logical
+        trial and must survive retry-clone attr stripping)."""
+        try:
+            self._study._storage.set_trial_system_attr(
+                trial._trial_id, SAMPLER_FALLBACK_ATTR_PREFIX + phase, reason[:500]
+            )
+        except Exception as err:  # graphlint: ignore[PY001] -- the attr is diagnostics; a storage blip on it must not turn a contained sampler fault into a batch abort
+            _logger.warning(
+                f"recording sampler fallback for trial {trial.number} raised "
+                f"{err!r}; continuing with the fallback anyway."
+            )
+        _logger.warning(
+            f"trial {trial.number}: sampler suggestion degraded to the "
+            f"independent path during {phase}: {reason}"
+        )
 
     def _run_batch(self, trials: list[Trial]) -> None:
         """Evaluate + tell one (sub-)batch with full containment."""
@@ -367,34 +539,9 @@ class ResilientBatchExecutor:
     def _dispatch(self, args: dict) -> tuple[np.ndarray, np.ndarray]:
         if self._deadline_s is None:
             return self._realize(args)
-        box: list = []
-        failure: list[BaseException] = []
-
-        def _target() -> None:
-            try:
-                box.append(self._realize(args))
-            except BaseException as err:  # graphlint: ignore[PY001] -- thread trampoline: the error is re-raised verbatim on the dispatching thread below, nothing is swallowed
-                failure.append(err)
-
-        worker = threading.Thread(
-            target=_target, name="optuna-tpu-dispatch", daemon=True
+        return run_with_deadline(
+            lambda: self._realize(args), self._deadline_s, self._clock
         )
-        start = self._clock()
-        worker.start()
-        while worker.is_alive():
-            remaining = self._deadline_s - (self._clock() - start)
-            if remaining <= 0:
-                break
-            worker.join(timeout=min(0.05, remaining))
-        if worker.is_alive():
-            # The hung dispatch is abandoned (daemon thread); its eventual
-            # result, if any, is discarded — the trials take the FAIL path.
-            raise DispatchTimeoutError(
-                f"device dispatch exceeded the {self._deadline_s}s deadline"
-            )
-        if failure:
-            raise failure[0]
-        return box[0]
 
     def _contain(self, trials: list[Trial], err: Exception) -> None:
         """A dispatch over ``trials`` raised ``err``: salvage what we can,

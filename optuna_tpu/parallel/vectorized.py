@@ -132,6 +132,7 @@ def optimize_vectorized(
     callbacks: Sequence[Callable] | None = None,
     *,
     non_finite: str = "fail",
+    fallback: str | None = None,
     bisect_on_error: bool = True,
     retry_policy: "RetryPolicy | None" = None,
     dispatch_deadline_s: float | None = None,
@@ -146,7 +147,11 @@ def optimize_vectorized(
     Execution is delegated to
     :class:`~optuna_tpu.parallel.executor.ResilientBatchExecutor`:
     ``non_finite`` picks the NaN/Inf quarantine policy
-    (``'fail'``/``'raise'``/``'clip'``), ``bisect_on_error`` isolates poison
+    (``'fail'``/``'raise'``/``'clip'``), ``fallback`` picks the sampler-fault
+    policy (``'independent'`` degrades a raising/NaN-proposing sampler to
+    per-trial independent sampling with ``sampler_fallback:`` attrs recorded;
+    ``'raise'`` surfaces it; ``None`` — the default — inherits a
+    ``GuardedSampler`` study's own policy), ``bisect_on_error`` isolates poison
     trials by batch bisection instead of failing the whole dispatch,
     ``retry_policy`` paces OOM batch-halving, and ``dispatch_deadline_s``
     bounds a hung device dispatch.
@@ -161,6 +166,7 @@ def optimize_vectorized(
         batch_axis=batch_axis,
         callbacks=callbacks,
         non_finite=non_finite,
+        fallback=fallback,
         bisect_on_error=bisect_on_error,
         retry_policy=retry_policy,
         dispatch_deadline_s=dispatch_deadline_s,

@@ -17,6 +17,7 @@ __all__ = [
     "CmaEsSampler",
     "GPSampler",
     "GridSampler",
+    "GuardedSampler",
     "LazyRandomState",
     "MOTPESampler",
     "NSGAIISampler",
@@ -33,6 +34,7 @@ _LAZY = {
     "MOTPESampler": ("optuna_tpu.samplers._tpe.sampler", "MOTPESampler"),
     "TPESampler": ("optuna_tpu.samplers._tpe.sampler", "TPESampler"),
     "GPSampler": ("optuna_tpu.samplers._gp.sampler", "GPSampler"),
+    "GuardedSampler": ("optuna_tpu.samplers._resilience", "GuardedSampler"),
     "CmaEsSampler": ("optuna_tpu.samplers._cmaes", "CmaEsSampler"),
     "NSGAIISampler": ("optuna_tpu.samplers.nsgaii._sampler", "NSGAIISampler"),
     "NSGAIIISampler": ("optuna_tpu.samplers._nsgaiii._sampler", "NSGAIIISampler"),

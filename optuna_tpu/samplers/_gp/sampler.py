@@ -293,19 +293,23 @@ class GPSampler(BaseSampler):
 
         if n_objectives == 1:
             # Internal convention: maximize standardized score.
+            from optuna_tpu.samplers._resilience import collapse_duplicate_rows
+
             raw_vals = np.asarray([t.value for t in trials], dtype=np.float64)
             score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
             y, _, _ = _standardize(score)
+            Xc, yc, counts = collapse_duplicate_rows(X, y)
             state, raw_params = fit_gp(
-                X,
-                y.astype(np.float32),
+                Xc,
+                yc.astype(np.float32),
                 is_cat,
                 warm_start_raw=warm[0] if warm else None,
                 seed=seed,
                 minimum_noise=1e-7 if self._deterministic else 1e-5,
+                counts=counts,
             )
             self._kernel_params_cache[sig] = [raw_params]
-            best = float(np.max(y))
+            best = float(np.max(yc))
 
             if running is not None and len(running) > 0:
                 acqf_name, data = self._build_qlogei(state, cat_mask, running, best, seed)
@@ -358,12 +362,22 @@ class GPSampler(BaseSampler):
         import jax.numpy as jnp
 
         from optuna_tpu.gp.gp import _bucket
+        from optuna_tpu.samplers._resilience import collapse_duplicate_rows
 
         rng = self._rng.rng
-        n, d = X.shape
+        d = X.shape[1]
         raw_vals = np.asarray([t.value for t in trials], dtype=np.float64)
         score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
         y, _, _ = _standardize(score)
+
+        # Degenerate-history conditioning: exact-duplicate design rows
+        # (retry clones re-running identical params) collapse to one row
+        # whose mask carries the observation count — the Gram matrix loses
+        # its exactly-singular rows, the fit keeps the evidence (noise/k on
+        # the averaged target). Duplicate-free histories pass through
+        # unchanged (bit-identical packing).
+        X, y, counts = collapse_duplicate_rows(X, y)
+        n = X.shape[0]
 
         N = _bucket(n + pad_extra)
         Xp = np.zeros((N, d), dtype=np.float32)
@@ -371,7 +385,7 @@ class GPSampler(BaseSampler):
         yp = np.zeros(N, dtype=np.float32)
         yp[:n] = y
         maskp = np.zeros(N, dtype=np.float32)
-        maskp[:n] = 1.0
+        maskp[:n] = counts
 
         default = np.zeros(d + 2, dtype=np.float32)
         default[d + 1] = np.log(1e-2)
@@ -593,6 +607,7 @@ class GPSampler(BaseSampler):
         from optuna_tpu.gp.acqf import QLogEIData
         from optuna_tpu.gp.gp import GPState, _kernel_with_noise, matern52
         from optuna_tpu.ops.qmc import normal_qmc_sample
+        from optuna_tpu.samplers._resilience import ladder_cholesky
 
         X_obs = state.X  # (N, d) padded
         mask = state.mask
@@ -605,7 +620,11 @@ class GPSampler(BaseSampler):
         v = jax.scipy.linalg.solve_triangular(state.L, k_or, lower=True)  # (N, R)
         mean_r = k_or.T @ state.alpha
         cov_r = k_rr - v.T @ v + jnp.eye(R) * 1e-5
-        L_r = jnp.linalg.cholesky(cov_r)
+        # Jitter-ladder factorizations (SMP002): two running trials at
+        # identical params — routine with retry clones in flight — make
+        # cov_r exactly singular, and a bare cholesky would hand back NaN
+        # fantasies without raising.
+        L_r = ladder_cholesky(cov_r)
         z = jnp.asarray(
             normal_qmc_sample(_N_FANTASIES, R, seed=seed), dtype=jnp.float32
         )  # (F, R)
@@ -616,7 +635,7 @@ class GPSampler(BaseSampler):
         X_ext = jnp.concatenate([X_obs, Xr], axis=0)
         mask_ext = jnp.concatenate([mask, jnp.ones(R, dtype=mask.dtype)])
         K_ext = _kernel_with_noise(X_ext, state.params, cat_mask, mask_ext)
-        L_ext = jnp.linalg.cholesky(K_ext)
+        L_ext = ladder_cholesky(K_ext)
 
         y_ext = jnp.concatenate(
             [jnp.broadcast_to(state.y, (_N_FANTASIES, N)), y_f], axis=1
@@ -818,6 +837,13 @@ class _DeviceSpace:
 
 
 def _standardize(values: np.ndarray) -> tuple[np.ndarray, float, float]:
+    from optuna_tpu.samplers._resilience import clip_objective_values
+
+    # ±inf values are storage-legal and must not reach the mean: one inf
+    # poisons every standardized target even when the sd guard below fires.
+    # Clipping to the float32 extremes keeps the ordering (these targets
+    # become f32 on device anyway) while making mu/sd finite.
+    values = clip_objective_values(values)
     mu = float(np.mean(values))
     sd = float(np.std(values))
     if sd <= 1e-12 or not np.isfinite(sd):

@@ -1,0 +1,433 @@
+"""Cross-sampler resilience: the suggestion path must never poison a study.
+
+On TPU ``jnp.linalg.cholesky`` does not raise on an ill-conditioned Gram
+matrix — it silently returns NaN factors, and one NaN suggestion poisons
+every downstream trial that conditions on it. Degenerate histories are
+*routine*, not exotic: retry clones re-run identical params (exact-duplicate
+rows), early studies have constant or single-trial histories, and ``±inf``
+objectives are storage-legal. GP practice answers with jitter-escalated
+Cholesky and degenerate-history conditioning (Snoek et al., *Practical
+Bayesian Optimization*), and define-by-run HPO (Akiba et al., *Optuna*)
+demands that a sampler failure degrade to independent sampling, never abort
+the study. This module provides the three containment rings
+(ARCHITECTURE.md "Sampler resilience" has the failure matrix):
+
+1. **In-graph numerical guards** — :func:`ladder_cholesky` (escalating
+   diagonal jitter, device-side ``isfinite`` verdict on the factor, zero
+   host sync; the single blessed Cholesky call site for sampler code —
+   graphlint rule **SMP002**), plus the host-side degenerate-history
+   conditioners :func:`clip_objective_values` (±inf → float32 max before
+   standardization) and :func:`collapse_duplicate_rows` (exact-duplicate
+   design rows collapse to one row with a count weight).
+2. **Fallback chain** — :class:`GuardedSampler`, a transparent
+   :class:`~optuna_tpu.samplers._base.BaseSampler` wrapper that catches
+   sampler exceptions *and* non-finite proposals per trial, falls back to
+   the sampler's independent/random path under a
+   ``fallback='independent'|'raise'`` policy (:data:`FALLBACK_POLICIES`),
+   records ``sampler_fallback:`` system attrs with the reason, and warns
+   once per study. ``Study(..., sampler_fallback=...)`` and
+   ``optimize_vectorized(..., fallback=...)`` wire it in directly.
+3. **Fit watchdog** — an injectable-clock deadline on relative fitting
+   (``fit_deadline_s``, reusing the
+   :func:`~optuna_tpu.parallel.executor.run_with_deadline` /
+   :class:`~optuna_tpu.parallel.executor.DispatchTimeoutError` machinery),
+   so a hung GP fit becomes a fallback, not a stuck study.
+
+Chaos coverage: ``testing/fault_injection.py`` provides
+``PathologicalHistoryPlan`` / ``FaultySampler``; ``tests/test_sampler_faults.py``
+proves GP, TPE, CMA-ES and NSGA-II complete fixed trial budgets with zero
+NaN params and zero study aborts under every plan.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+import numpy as np
+
+from optuna_tpu.distributions import BaseDistribution, CategoricalDistribution
+from optuna_tpu.logging import get_logger
+from optuna_tpu.samplers._base import BaseSampler
+from optuna_tpu.trial._frozen import FrozenTrial
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    from optuna_tpu.study.study import Study
+
+_logger = get_logger(__name__)
+
+#: The accepted ``fallback=`` policy literals and what each does when a
+#: sampler fails. Canonical copy: graphlint rule **SMP001** cross-checks
+#: this set against ``_lint/registry.py::FALLBACK_POLICY_REGISTRY`` and the
+#: chaos matrix in ``testing/fault_injection.py`` — adding a policy here
+#: without a chaos scenario is a lint failure.
+FALLBACK_POLICIES: dict[str, str] = {
+    "independent": "degrade: a sampler failure falls back to independent/random sampling",
+    "raise": "strict: record the fallback attr, then re-raise the sampler's error",
+}
+
+#: System-attr namespace recording why a trial's suggestion fell back.
+#: Deliberately *not* under ``batch_exec:`` (``storages/_callbacks.py::
+#: EXECUTOR_ATTR_PREFIX``): fallback lineage describes the logical trial's
+#: sampling, so retry-clone attr stripping must keep it.
+SAMPLER_FALLBACK_ATTR_PREFIX = "sampler_fallback:"
+
+_F32_MAX = float(np.finfo(np.float32).max)
+
+#: Jitter ladder: multiples of the Gram diagonal scale tried in order until
+#: the factor is finite. The first rung (0) is the bare matrix — the happy
+#: path costs exactly one factorization.
+_LADDER_INITIAL_JITTER = 1e-6
+_LADDER_GROWTH = 100.0
+_LADDER_MAX_RUNGS = 4
+
+
+# ------------------------------------------------------- ring 1: in-graph
+
+def ladder_cholesky(K, *, initial_jitter: float = _LADDER_INITIAL_JITTER):
+    """Cholesky with an in-graph jitter ladder: factor ``K`` as-is, and while
+    the factor is non-finite escalate additive diagonal jitter
+    (``initial_jitter · 100^rung`` of the diagonal scale, up to
+    ``100^{max_rungs-1}``) and refactor.
+
+    Everything — the ``isfinite`` verdict included — runs on device inside
+    the surrounding trace (``lax.while_loop``), so there is no host sync and
+    the happy path pays exactly one factorization. A rank-deficient Gram
+    matrix (duplicate design rows, constant targets, f32 underflow) resolves
+    to a finite factor of a slightly-more-regularized ``K`` instead of
+    silently returning NaN the way a bare ``jnp.linalg.cholesky`` does on
+    TPU. 2-D matrices only (the batched fits factor per-objective states
+    separately).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = K.shape[-1]
+    eye = jnp.eye(n, dtype=K.dtype)
+    diag = jnp.diagonal(K)
+    # Jitter scales with the matrix, floored at 1.0 so an all-zero Gram
+    # (possible when every row collapsed to the origin) still regularizes.
+    scale = jnp.maximum(jnp.max(jnp.abs(diag)), jnp.asarray(1.0, K.dtype))
+
+    def _unfinished(state):
+        rung, L = state
+        return (rung < _LADDER_MAX_RUNGS) & ~jnp.all(jnp.isfinite(L))
+
+    def _next_rung(state):
+        rung, _ = state
+        jitter = initial_jitter * (_LADDER_GROWTH ** rung.astype(K.dtype)) * scale
+        return rung + 1, jnp.linalg.cholesky(K + eye * jitter)  # graphlint: ignore[SMP002] -- the ladder's own escalation rung: this call IS the guarded retry the rule points everyone at
+
+    first = jnp.linalg.cholesky(K)  # graphlint: ignore[SMP002] -- this IS the ladder helper: the one blessed bare call, guarded by the escalation loop below
+    _, L = jax.lax.while_loop(
+        _unfinished, _next_rung, (jnp.asarray(0, jnp.int32), first)
+    )
+    return L
+
+
+def clip_objective_values(values: np.ndarray) -> np.ndarray:
+    """Clip ``±inf`` (and beyond-float32 magnitudes like ``1e308``) to the
+    float32 extremes so a mean/std standardization stays finite end to end.
+
+    Host-side, applied *before* standardization: an ``inf`` objective is
+    storage-legal (worst-possible score), but one ``inf`` in the mean
+    poisons every standardized target. NaN never reaches here — the tell
+    path converts NaN values to FAIL before they can be COMPLETE.
+    """
+    return np.clip(values, -_F32_MAX, _F32_MAX)
+
+
+def collapse_duplicate_rows(
+    X: np.ndarray, y: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Collapse exact-duplicate design rows to one row with a count weight.
+
+    Returns ``(X_unique, y_mean, counts)`` with first-occurrence order
+    preserved; duplicate groups average their targets and carry the group
+    size in ``counts``. A count-aware GP treats the averaged observation as
+    ``count`` repeats by dividing that row's observation noise by the count:
+    at fixed kernel params this reproduces the full-data posterior exactly,
+    while the fitted MLL drops the within-group scatter term (some noise
+    evidence) — a deliberate trade for a non-singular Gram. Retry clones
+    re-running identical params are the routine producer of such histories.
+    Duplicate-free input is returned unchanged (same order, same values —
+    fault-free studies are bit-identical).
+    """
+    n = len(X)
+    ones = np.ones(n, dtype=np.float32)
+    if n == 0:
+        return X, y, ones
+    uniq, first, inverse, counts = np.unique(
+        X, axis=0, return_index=True, return_inverse=True, return_counts=True
+    )
+    if len(uniq) == n:
+        return X, y, ones
+    order = np.argsort(first)  # chronological (first-occurrence) order
+    sums = np.zeros(len(uniq), dtype=np.result_type(y.dtype, np.float32))
+    np.add.at(sums, inverse, y)
+    y_mean = (sums / counts)[order].astype(y.dtype)
+    return (
+        uniq[order].astype(X.dtype),
+        y_mean,
+        counts[order].astype(np.float32),
+    )
+
+
+def _is_non_finite_number(value: Any) -> bool:
+    if isinstance(value, bool):
+        return False
+    if isinstance(value, (float, np.floating)):
+        return not math.isfinite(float(value))
+    return False
+
+
+def non_finite_param_names(
+    params: dict[str, Any],
+    search_space: dict[str, BaseDistribution] | None = None,
+) -> list[str]:
+    """Names of proposed params carrying NaN/±inf values. Categorical dims
+    are exempt when the search space is known — a choice may legally *be*
+    the float ``nan`` object."""
+    bad = []
+    for name, value in params.items():
+        if search_space is not None and isinstance(
+            search_space.get(name), CategoricalDistribution
+        ):
+            continue
+        if _is_non_finite_number(value):
+            bad.append(name)
+    return bad
+
+
+# ------------------------------------------------- rings 2+3: the wrapper
+
+class GuardedSampler(BaseSampler):
+    """Containment wrapper: any sampler failure degrades per-trial instead
+    of aborting the study.
+
+    Guards every sampler hook: an exception from (or a non-finite proposal
+    out of) ``infer_relative_search_space`` / ``sample_relative`` /
+    ``sample_relative_batch`` / ``sample_independent`` is recorded as a
+    ``sampler_fallback:<phase>`` system attr on the trial (study, for the
+    batch hook — no trials exist yet), warned once per study, and resolved
+    per the ``fallback`` policy: ``'independent'`` degrades to the wrapped
+    sampler's independent path (a :class:`RandomSampler` if that path is
+    itself broken); ``'raise'`` re-raises after recording, for callers that
+    prefer a loud stop. ``fit_deadline_s`` bounds each relative fit on an
+    injectable clock — a hung fit is abandoned on its watchdog thread and
+    becomes an ordinary fallback.
+
+    Wrapping is free on the happy path: no extra RNG draws, no extra
+    storage reads — fault-free studies are bit-identical to the unwrapped
+    sampler's.
+    """
+
+    def __init__(
+        self,
+        sampler: BaseSampler,
+        *,
+        fallback: str = "independent",
+        fit_deadline_s: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if fallback not in FALLBACK_POLICIES:
+            raise ValueError(
+                f"fallback must be one of {sorted(FALLBACK_POLICIES)}; "
+                f"got {fallback!r}."
+            )
+        self._sampler = sampler
+        self._fallback = fallback
+        self._fit_deadline_s = fit_deadline_s
+        self._clock = clock
+        self._warned_studies: set[int] = set()
+        self._fallback_random: BaseSampler | None = None
+        #: Why the most recent ``sample_relative_batch`` call *failed* (None
+        #: when it succeeded or merely declined). The batch executor reads
+        #: this to tell the two Nones apart: a decline routes to per-trial
+        #: relative sampling, a failure degrades the whole batch to
+        #: independent sampling at once — never B re-attempts of a broken
+        #: (or hung) fit.
+        self.last_batch_fallback_reason: str | None = None
+
+    @property
+    def sampler(self) -> BaseSampler:
+        """The wrapped sampler."""
+        return self._sampler
+
+    @property
+    def fallback(self) -> str:
+        """The active fallback policy — the batch executor inherits it so
+        ``optimize_vectorized`` on a guarded study honors the same policy."""
+        return self._fallback
+
+    def __str__(self) -> str:
+        return f"GuardedSampler({self._sampler})"
+
+    # -------------------------------------------------------------- plumbing
+
+    def _random(self) -> BaseSampler:
+        if self._fallback_random is None:
+            from optuna_tpu.samplers._random import RandomSampler
+
+            self._fallback_random = RandomSampler()
+        return self._fallback_random
+
+    def _timed(self, fn: Callable[[], Any], describe: str) -> Any:
+        if self._fit_deadline_s is None:
+            return fn()
+        # Lazy import: executor lazily imports this module for its own
+        # fallback knob — neither side pays a cycle at import time.
+        from optuna_tpu.parallel.executor import run_with_deadline
+
+        return run_with_deadline(
+            fn,
+            self._fit_deadline_s,
+            self._clock,
+            describe=f"sampler {describe}",
+            thread_name="optuna-tpu-sampler-fit",
+        )
+
+    def _contain(
+        self,
+        study: "Study",
+        trial: FrozenTrial | None,
+        phase: str,
+        err: BaseException,
+    ) -> None:
+        """Record the fallback, warn once per study, honor the policy."""
+        reason = f"{type(err).__name__}: {err}"[:500]
+        key = SAMPLER_FALLBACK_ATTR_PREFIX + phase
+        try:
+            if trial is not None:
+                study._storage.set_trial_system_attr(trial._trial_id, key, reason)
+            else:
+                study._storage.set_study_system_attr(study._study_id, key, reason)
+        except Exception as attr_err:  # graphlint: ignore[PY001] -- the attr is diagnostics; a storage blip on it must not turn a contained sampler failure into a study abort
+            _logger.warning(
+                f"recording sampler fallback attr {key!r} raised {attr_err!r}; "
+                "continuing with the fallback anyway."
+            )
+        if self._fallback == "raise":
+            raise err
+        if study._study_id not in self._warned_studies:
+            self._warned_studies.add(study._study_id)
+            _logger.warning(
+                f"{type(self._sampler).__name__} failed during {phase} "
+                f"({reason}); falling back to independent sampling. Further "
+                "fallbacks in this study are recorded in "
+                f"'{SAMPLER_FALLBACK_ATTR_PREFIX}*' system attrs without a log line."
+            )
+
+    # ----------------------------------------------------------------- hooks
+
+    def reseed_rng(self) -> None:
+        self._sampler.reseed_rng()
+
+    def infer_relative_search_space(
+        self, study: "Study", trial: FrozenTrial
+    ) -> dict[str, BaseDistribution]:
+        try:
+            return self._sampler.infer_relative_search_space(study, trial)
+        except Exception as err:  # graphlint: ignore[PY001] -- ring-2 containment boundary: any sampler crash degrades this trial to independent sampling instead of aborting the study ('raise' policy re-raises in _contain)
+            self._contain(study, trial, "search_space", err)
+            return {}
+
+    def sample_relative(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        search_space: dict[str, BaseDistribution],
+    ) -> dict[str, Any]:
+        try:
+            params = self._timed(
+                lambda: self._sampler.sample_relative(study, trial, search_space),
+                "relative fit",
+            )
+        except Exception as err:  # graphlint: ignore[PY001] -- ring-2 containment boundary: any sampler crash (or fit-watchdog timeout) degrades this trial to independent sampling ('raise' policy re-raises in _contain)
+            self._contain(study, trial, "relative", err)
+            return {}
+        bad = non_finite_param_names(params, search_space)
+        if bad:
+            self._contain(
+                study,
+                trial,
+                "relative",
+                ValueError(
+                    f"non-finite proposal for {bad}: "
+                    f"{ {k: params[k] for k in bad} }"
+                ),
+            )
+            return {k: v for k, v in params.items() if k not in bad}
+        return params
+
+    def sample_relative_batch(
+        self,
+        study: "Study",
+        search_space: dict[str, BaseDistribution],
+        batch_size: int,
+    ) -> list[dict[str, Any]] | None:
+        """Guarded batch ask. Returns None — the per-trial path, which this
+        wrapper guards trial by trial — when the wrapped sampler lacks the
+        hook, declines, or fails."""
+        self.last_batch_fallback_reason = None
+        inner = getattr(self._sampler, "sample_relative_batch", None)
+        if inner is None:
+            return None
+        try:
+            return self._timed(
+                lambda: inner(study, search_space, batch_size), "batch relative fit"
+            )
+        except Exception as err:  # graphlint: ignore[PY001] -- ring-2 containment boundary: a batch-fit crash degrades the whole batch to independent sampling ('raise' policy re-raises in _contain)
+            self.last_batch_fallback_reason = f"{type(err).__name__}: {err}"[:500]
+            self._contain(study, None, "relative_batch", err)
+            return None
+
+    def sample_independent(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        param_name: str,
+        param_distribution: BaseDistribution,
+    ) -> Any:
+        try:
+            value = self._sampler.sample_independent(
+                study, trial, param_name, param_distribution
+            )
+        except Exception as err:  # graphlint: ignore[PY001] -- ring-2 containment boundary (last ring before random): the independent path itself failing falls to a plain RandomSampler ('raise' policy re-raises in _contain)
+            self._contain(study, trial, f"independent:{param_name}", err)
+            return self._random().sample_independent(
+                study, trial, param_name, param_distribution
+            )
+        if not isinstance(
+            param_distribution, CategoricalDistribution
+        ) and _is_non_finite_number(value):
+            self._contain(
+                study,
+                trial,
+                f"independent:{param_name}",
+                ValueError(f"non-finite independent sample {value!r}"),
+            )
+            return self._random().sample_independent(
+                study, trial, param_name, param_distribution
+            )
+        return value
+
+    def before_trial(self, study: "Study", trial: FrozenTrial) -> None:
+        try:
+            self._sampler.before_trial(study, trial)
+        except Exception as err:  # graphlint: ignore[PY001] -- ring-2 containment boundary: a before_trial crash (e.g. state restore) must not strand the just-created trial ('raise' policy re-raises in _contain)
+            self._contain(study, trial, "before_trial", err)
+
+    def after_trial(
+        self,
+        study: "Study",
+        trial: FrozenTrial,
+        state: TrialState,
+        values: Sequence[float] | None,
+    ) -> None:
+        try:
+            self._sampler.after_trial(study, trial, state, values)
+        except Exception as err:  # graphlint: ignore[PY001] -- ring-2 containment boundary: an after_trial crash (state persist, constraints eval) must not abort the finished trial's tell ('raise' policy re-raises in _contain)
+            self._contain(study, trial, "after_trial", err)

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from optuna_tpu.ops import truncnorm
+from optuna_tpu.samplers._tpe.parzen_estimator import SIGMA_DOMAIN_FLOOR
 
 
 def _component_log_pdf(
@@ -141,6 +142,11 @@ def _build_num_dim(obs, n, low, high, consider_endpoints, magic_clip, n_k):
     else:
         minsigma = jnp.asarray(EPS_BUILD, obs.dtype)
     sigmas = jnp.clip(sigmas, minsigma, maxsigma)
+    # Zero-variance bandwidth floor (must mirror the host estimator —
+    # parzen_estimator.py::SIGMA_DOMAIN_FLOOR): all-identical observations
+    # have zero neighbor gaps, and a delta-width kernel degenerates the f32
+    # standardization downstream.
+    sigmas = jnp.maximum(sigmas, SIGMA_DOMAIN_FLOOR * (high - low))
 
     mus = jnp.where(obs_mask, obs, prior_mu)
     sigmas = jnp.where(obs_mask, sigmas, prior_sigma)

@@ -29,6 +29,17 @@ from optuna_tpu.distributions import (
 
 EPS = 1e-12
 
+#: Zero-variance bandwidth floor, as a fraction of the (transformed) domain
+#: width. With magic clip disabled the reference floors sigma at EPS, which
+#: for an all-identical observation set (constant objectives, retry clones)
+#: collapses the KDE to a delta — tolerable in f64, degenerate on the f32
+#: device path where the (x - mu)/sigma standardization explodes. Any
+#: non-degenerate history has neighbor-gap sigmas orders of magnitude above
+#: this floor, so only zero-variance dims feel it. The in-graph build
+#: (:mod:`optuna_tpu.samplers._tpe._kernels`) applies the identical floor —
+#: the build-parity suite holds the two together.
+SIGMA_DOMAIN_FLOOR = 1e-7
+
 
 class _ParzenEstimatorParameters(NamedTuple):
     consider_prior: bool
@@ -235,6 +246,7 @@ class _ParzenEstimator:
         else:
             minsigma = EPS
         sigmas = np.asarray(np.clip(sigmas, minsigma, maxsigma))
+        sigmas = np.maximum(sigmas, SIGMA_DOMAIN_FLOOR * (high - low))
 
         if consider_prior:
             mus = np.append(mus, prior_mu)

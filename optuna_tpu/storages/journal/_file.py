@@ -13,8 +13,10 @@ import abc
 import errno
 import json
 import os
+import struct
 import time
 import uuid
+import zlib
 from typing import Any
 
 from optuna_tpu.logging import get_logger
@@ -24,6 +26,48 @@ _logger = get_logger(__name__)
 
 LOCK_FILE_SUFFIX = ".lock"
 RENAME_FILE_SUFFIX = ".rename"
+
+#: Snapshot framing: magic + little-endian CRC32 of the payload, prepended
+#: by :func:`frame_snapshot` and verified by :func:`unframe_snapshot`. A
+#: snapshot is a pure replay optimization, so integrity failures (torn
+#: write, bit rot, a pre-CRC legacy file) degrade to "no snapshot" — full
+#: journal replay — instead of feeding corrupt bytes to ``pickle.loads``,
+#: whose failure modes on garbage range far outside ``UnpicklingError``.
+SNAPSHOT_MAGIC = b"OTSNAP1\n"
+_SNAPSHOT_CRC_STRUCT = struct.Struct("<I")
+
+
+def frame_snapshot(payload: bytes) -> bytes:
+    """Prepend the magic + CRC32 header to a raw snapshot payload."""
+    return SNAPSHOT_MAGIC + _SNAPSHOT_CRC_STRUCT.pack(zlib.crc32(payload)) + payload
+
+
+def unframe_snapshot(data: bytes | None, *, source: str) -> bytes | None:
+    """Verify and strip the snapshot frame; None when absent or corrupt.
+
+    Checksum-before-unpickle: the caller can narrow its unpickling guard to
+    ``pickle.UnpicklingError`` (version drift) because corrupt *bytes* are
+    caught here, by CRC, and reported as a missing snapshot.
+    """
+    if data is None:
+        return None
+    header = len(SNAPSHOT_MAGIC) + _SNAPSHOT_CRC_STRUCT.size
+    if len(data) < header or not data.startswith(SNAPSHOT_MAGIC):
+        _logger.warning(
+            f"Journal snapshot at {source} lacks the CRC header (legacy or "
+            "corrupt); ignoring it and replaying the journal from scratch."
+        )
+        return None
+    (expected,) = _SNAPSHOT_CRC_STRUCT.unpack_from(data, len(SNAPSHOT_MAGIC))
+    payload = data[header:]
+    if zlib.crc32(payload) != expected:
+        _logger.warning(
+            f"Journal snapshot at {source} failed its CRC32 check (torn "
+            "write or corruption); ignoring it and replaying the journal "
+            "from scratch."
+        )
+        return None
+    return payload
 
 
 def _steal_stale_lock(lockfile: str, grace_period: float) -> bool:
@@ -263,7 +307,7 @@ class JournalFileBackend(BaseJournalBackend):
     def save_snapshot(self, snapshot: bytes) -> None:
         tmp = self._snapshot_path + f".{uuid.uuid4().hex[:8]}"
         with open(tmp, "wb") as f:
-            f.write(snapshot)
+            f.write(frame_snapshot(snapshot))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snapshot_path)
@@ -271,6 +315,7 @@ class JournalFileBackend(BaseJournalBackend):
     def load_snapshot(self) -> bytes | None:
         try:
             with open(self._snapshot_path, "rb") as f:
-                return f.read()
+                data = f.read()
         except OSError:
             return None
+        return unframe_snapshot(data, source=self._snapshot_path)

@@ -10,6 +10,7 @@ import json
 from typing import Any
 
 from optuna_tpu.storages.journal._base import BaseJournalBackend
+from optuna_tpu.storages.journal._file import frame_snapshot, unframe_snapshot
 
 
 class JournalRedisBackend(BaseJournalBackend):
@@ -50,10 +51,13 @@ class JournalRedisBackend(BaseJournalBackend):
             pipe.execute()
 
     def save_snapshot(self, snapshot: bytes) -> None:
-        self._redis.set(f"{self._prefix}:snapshot", snapshot)
+        # Same CRC32 frame as the file backend: the checksum is verified
+        # before any byte reaches pickle, whatever transport stored it.
+        self._redis.set(f"{self._prefix}:snapshot", frame_snapshot(snapshot))
 
     def load_snapshot(self) -> bytes | None:
-        return self._redis.get(f"{self._prefix}:snapshot")
+        data = self._redis.get(f"{self._prefix}:snapshot")
+        return unframe_snapshot(data, source=f"{self._prefix}:snapshot")
 
     def __getstate__(self) -> dict[str, Any]:
         state = self.__dict__.copy()

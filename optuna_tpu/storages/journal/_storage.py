@@ -299,12 +299,21 @@ class JournalStorage(BaseStorage):
         self._replay = _ReplayResult()
         snapshot = self._backend.load_snapshot()
         if snapshot is not None:
+            # Byte integrity is the backend's job now: load_snapshot verifies
+            # a CRC32 header (journal/_file.py::unframe_snapshot) and reports
+            # torn/corrupt/legacy snapshots as None. That shrinks the
+            # once-broad except (corrupt bytes raise OverflowError /
+            # MemoryError / arbitrary __setstate__ errors) to the honest
+            # version-drift survivors: UnpicklingError for protocol/opcode
+            # mismatch, AttributeError/ImportError for a checksum-valid
+            # snapshot written by a release whose classes moved or changed
+            # shape. Full replay stays the fallback either way.
             try:
                 restored = pickle.loads(snapshot)
                 if isinstance(restored, _ReplayResult):
                     self._replay = restored
                     self._replay.own_results = {}
-            except Exception:  # graphlint: ignore[PY001] -- corrupt pickle bytes raise far outside UnpicklingError (OverflowError, MemoryError, KeyError from __setstate__...); a snapshot is a pure optimization, every flavor falls back to full replay
+            except (pickle.UnpicklingError, AttributeError, ImportError):
                 _logger.warning("Failed to load journal snapshot; replaying from scratch.")
         self._sync()
 

@@ -49,6 +49,8 @@ class Study:
         storage: "str | BaseStorage",
         sampler: "BaseSampler | None" = None,
         pruner: "BasePruner | None" = None,
+        *,
+        sampler_fallback: str | None = None,
     ) -> None:
         from optuna_tpu.pruners import MedianPruner
         from optuna_tpu.storages import get_storage
@@ -61,6 +63,15 @@ class Study:
         self._directions = storage.get_study_directions(study_id)
 
         self.sampler = sampler or _default_sampler(self._directions)
+        if sampler_fallback is not None:
+            # Direct ask-path integration of the sampler resilience layer:
+            # every suggestion this study asks for (ask, ask_batch, the
+            # optimize loops) runs under GuardedSampler containment — a
+            # sampler failure degrades per the policy instead of aborting.
+            from optuna_tpu.samplers._resilience import GuardedSampler
+
+            if not isinstance(self.sampler, GuardedSampler):
+                self.sampler = GuardedSampler(self.sampler, fallback=sampler_fallback)
         self.pruner = pruner or MedianPruner()
 
         self._thread_local = _ThreadLocalStudyAttribute()
@@ -473,6 +484,7 @@ def create_study(
     direction: str | StudyDirection | None = None,
     load_if_exists: bool = False,
     directions: Sequence[str | StudyDirection] | None = None,
+    sampler_fallback: str | None = None,
 ) -> Study:
     """Create (or load, with ``load_if_exists``) a study (reference ``study.py:1203``)."""
     from optuna_tpu.storages import get_storage
@@ -514,7 +526,13 @@ def create_study(
             raise
 
     study_name = storage_obj.get_study_name_from_id(study_id)
-    return Study(study_name=study_name, storage=storage_obj, sampler=sampler, pruner=pruner)
+    return Study(
+        study_name=study_name,
+        storage=storage_obj,
+        sampler=sampler,
+        pruner=pruner,
+        sampler_fallback=sampler_fallback,
+    )
 
 
 def load_study(
@@ -523,6 +541,7 @@ def load_study(
     storage: "str | BaseStorage",
     sampler: "BaseSampler | None" = None,
     pruner: "BasePruner | None" = None,
+    sampler_fallback: str | None = None,
 ) -> Study:
     """Load an existing study (reference ``study.py:1358``)."""
     from optuna_tpu.storages import get_storage
@@ -536,7 +555,13 @@ def load_study(
                 f"{storage} does not contain exactly 1 study. Specify `study_name`."
             )
         study_name = studies[0].study_name
-    return Study(study_name=study_name, storage=storage_obj, sampler=sampler, pruner=pruner)
+    return Study(
+        study_name=study_name,
+        storage=storage_obj,
+        sampler=sampler,
+        pruner=pruner,
+        sampler_fallback=sampler_fallback,
+    )
 
 
 def delete_study(*, study_name: str, storage: "str | BaseStorage") -> None:

@@ -19,6 +19,13 @@ the faults they claim to absorb. This module provides:
   device-dispatch-level faults (NaN-at-position, crash-at-dispatch,
   OOM-shaped errors, hangs, worker kills) for chaos-testing the resilient
   batch executor (:mod:`optuna_tpu.parallel.executor`).
+* Sampler chaos (:mod:`optuna_tpu.samplers._resilience` is the layer under
+  test): :class:`PathologicalHistoryPlan` seeds a study with the degenerate
+  histories that NaN-poison unguarded samplers (all-identical params,
+  constant values, ``±inf``/1e308 values, duplicated retry clones,
+  single-trial history — :data:`PATHOLOGICAL_HISTORY_PLANS` is the matrix),
+  and :class:`FaultySampler` raises / hangs / proposes NaN at the n-th
+  relative suggestion.
 
 Typical chaos test::
 
@@ -295,6 +302,245 @@ class FaultyVectorizedObjective:
             return inner(args)
 
         return _faulty
+
+
+# ------------------------------------------------------------- sampler chaos
+
+
+# Chaos matrix for the sampler resilience layer's fallback policies: every
+# policy literal ``GuardedSampler``/the executor accept maps to the injection
+# scenario the chaos suite must run against it. Deliberately a hand-written
+# literal (not an import of ``samplers._resilience.FALLBACK_POLICIES``):
+# graphlint rule SMP001 cross-checks both against ``_lint/registry.py::
+# FALLBACK_POLICY_REGISTRY`` — adding a policy without deciding how to
+# chaos-test it is a lint failure (the STO001/EXE001 pattern).
+FALLBACK_CHAOS_POLICIES: dict[str, str] = {
+    "independent": "inject sampler raise/hang/NaN; the budget completes via "
+    "independent sampling, fallback attrs on exactly the degraded trials",
+    "raise": "inject sampler raise; the error surfaces to the caller after "
+    "the fallback attr is recorded",
+}
+
+
+def _random_params(
+    rng: "np.random.RandomState", search_space: Mapping[str, Any]
+) -> dict[str, Any]:
+    """Uniform params over a search space (host-side, for history seeding)."""
+    from optuna_tpu.distributions import CategoricalDistribution
+
+    params: dict[str, Any] = {}
+    for name, dist in search_space.items():
+        if isinstance(dist, CategoricalDistribution):
+            params[name] = dist.choices[rng.randint(len(dist.choices))]
+        else:
+            value = rng.uniform(dist.low, dist.high)
+            params[name] = dist.to_external_repr(dist.to_internal_repr(value))
+    return params
+
+
+def _fixed_params(search_space: Mapping[str, Any]) -> dict[str, Any]:
+    """One deterministic point (midpoint / first choice) of a search space."""
+    from optuna_tpu.distributions import CategoricalDistribution
+
+    params: dict[str, Any] = {}
+    for name, dist in search_space.items():
+        if isinstance(dist, CategoricalDistribution):
+            params[name] = dist.choices[0]
+        else:
+            value = 0.5 * (dist.low + dist.high)
+            params[name] = dist.to_external_repr(dist.to_internal_repr(value))
+    return params
+
+
+@dataclass(frozen=True)
+class PathologicalHistoryPlan:
+    """One degenerate-history scenario the sampler resilience rings must
+    absorb: :meth:`populate` seeds a study with ``n_trials`` COMPLETE trials
+    whose params/values follow the pathology. Every plan in
+    :data:`PATHOLOGICAL_HISTORY_PLANS` must leave every sampler able to
+    finish a fresh trial budget with finite params and zero aborts
+    (``tests/test_sampler_faults.py``).
+
+    ``params_fn(index, rng, search_space)`` -> external-repr params;
+    ``value_fn(index)`` -> the scalar objective value (replicated across
+    objectives for multi-objective studies); ``clone_attrs`` additionally
+    tags odd-indexed trials as retry clones of their predecessor
+    (``failed_trial``/``retry_history``/``fixed_params``), the lineage shape
+    ``RetryFailedTrialCallback`` produces.
+    """
+
+    name: str
+    description: str
+    n_trials: int
+    params_fn: Callable[[int, "np.random.RandomState", Mapping[str, Any]], dict]
+    value_fn: Callable[[int], float]
+    clone_attrs: bool = False
+
+    def populate(self, study: Any, search_space: Mapping[str, Any], *, seed: int = 0) -> None:
+        from optuna_tpu.trial._frozen import create_trial
+        from optuna_tpu.trial._state import TrialState
+
+        rng = np.random.RandomState(seed)
+        n_objectives = len(study.directions)
+        for i in range(self.n_trials):
+            params = self.params_fn(i, rng, search_space)
+            system_attrs: dict[str, Any] = {}
+            if self.clone_attrs and i % 2 == 1:
+                system_attrs = {
+                    "failed_trial": i - 1,
+                    "retry_history": [i - 1],
+                    "fixed_params": params,
+                }
+            study.add_trial(
+                create_trial(
+                    state=TrialState.COMPLETE,
+                    params=params,
+                    distributions=dict(search_space),
+                    values=[self.value_fn(i)] * n_objectives,
+                    system_attrs=system_attrs or None,
+                )
+            )
+
+
+#: The degenerate histories every sampler must survive (a row per failure
+#: matrix entry in ARCHITECTURE.md "Sampler resilience"). Duplicates come in
+#: two flavors: every row identical (a Gram matrix of rank one) and
+#: pairwise-duplicated retry clones carrying real retry lineage attrs.
+PATHOLOGICAL_HISTORY_PLANS: tuple[PathologicalHistoryPlan, ...] = (
+    PathologicalHistoryPlan(
+        name="identical_params",
+        description="every trial at the same point: the Gram matrix is rank one",
+        n_trials=8,
+        params_fn=lambda i, rng, space: _fixed_params(space),
+        value_fn=lambda i: 0.1 * i,
+    ),
+    PathologicalHistoryPlan(
+        name="constant_values",
+        description="objective constant: zero-variance standardization/bandwidths",
+        n_trials=8,
+        params_fn=lambda i, rng, space: _random_params(rng, space),
+        value_fn=lambda i: 0.0,
+    ),
+    PathologicalHistoryPlan(
+        name="inf_values",
+        description="±inf objectives: one inf poisons an unclipped mean",
+        n_trials=8,
+        params_fn=lambda i, rng, space: _random_params(rng, space),
+        value_fn=lambda i: (float("inf"), float("-inf"), 1.0)[i % 3],
+    ),
+    PathologicalHistoryPlan(
+        name="huge_values",
+        description="±1e308 objectives: finite in f64, overflow in f32",
+        n_trials=8,
+        params_fn=lambda i, rng, space: _random_params(rng, space),
+        value_fn=lambda i: (1e308, -1e308, 2.0)[i % 3],
+    ),
+    PathologicalHistoryPlan(
+        name="retry_clones",
+        description="B duplicated retry clones: pairwise-identical rows with lineage attrs",
+        n_trials=8,
+        params_fn=lambda i, rng, space: (
+            _random_params(np.random.RandomState(1000 + i // 2), space)
+        ),
+        value_fn=lambda i: 0.05 * (i // 2),
+        clone_attrs=True,
+    ),
+    PathologicalHistoryPlan(
+        name="single_trial",
+        description="one-observation history: degenerate splits and variances",
+        n_trials=1,
+        params_fn=lambda i, rng, space: _random_params(rng, space),
+        value_fn=lambda i: 1.0,
+    ),
+)
+
+
+class FaultySampler:
+    """A sampler whose *relative* suggestions misbehave on schedule.
+
+    Wraps any :class:`~optuna_tpu.samplers._base.BaseSampler`; all knobs are
+    keyed by the 0-based ``sample_relative`` call index (``suggests`` counts
+    them): ``raise_at`` raises ``error_factory(index)``, ``hang_at`` sleeps
+    ``hang_s`` seconds first (tripping a ``fit_deadline_s`` watchdog), and
+    ``nan_at`` returns a NaN proposal for every non-categorical dimension —
+    exactly what an unguarded ill-conditioned GP emits. ``force_relative``
+    claims the intersection search space even when the wrapped sampler would
+    not, so the faults actually fire over plain inner samplers.
+    """
+
+    def __init__(
+        self,
+        inner: Any,
+        *,
+        raise_at: Collection[int] = (),
+        hang_at: Collection[int] = (),
+        nan_at: Collection[int] = (),
+        hang_s: float = 30.0,
+        force_relative: bool = False,
+        error_factory: Callable[[int], Exception] = lambda index: RuntimeError(
+            f"injected sampler crash at suggest #{index}"
+        ),
+    ) -> None:
+        self._inner = inner
+        self.raise_at = frozenset(raise_at)
+        self.hang_at = frozenset(hang_at)
+        self.nan_at = frozenset(nan_at)
+        self.hang_s = hang_s
+        self.error_factory = error_factory
+        self.suggests = 0
+        self._force_relative = force_relative
+        if force_relative:
+            from optuna_tpu.search_space import IntersectionSearchSpace
+
+            self._intersection = IntersectionSearchSpace()
+
+    def reseed_rng(self) -> None:
+        self._inner.reseed_rng()
+
+    def infer_relative_search_space(self, study: Any, trial: Any) -> dict:
+        if self._force_relative:
+            return {
+                name: dist
+                for name, dist in self._intersection.calculate(study).items()
+                if not dist.single()
+            }
+        return self._inner.infer_relative_search_space(study, trial)
+
+    def sample_relative(self, study: Any, trial: Any, search_space: dict) -> dict:
+        from optuna_tpu.distributions import CategoricalDistribution
+
+        index = self.suggests
+        self.suggests += 1
+        if index in self.hang_at:
+            time.sleep(self.hang_s)
+        if index in self.raise_at:
+            raise self.error_factory(index)
+        if index in self.nan_at:
+            return {
+                name: (
+                    dist.choices[0]
+                    if isinstance(dist, CategoricalDistribution)
+                    else float("nan")
+                )
+                for name, dist in search_space.items()
+            }
+        if self._force_relative:
+            # The wrapped sampler never claimed this space; healthy calls
+            # decline the relative proposal so dims resolve independently.
+            return {}
+        return self._inner.sample_relative(study, trial, search_space)
+
+    def sample_independent(self, study: Any, trial: Any, name: str, dist: Any) -> Any:
+        return self._inner.sample_independent(study, trial, name, dist)
+
+    def before_trial(self, study: Any, trial: Any) -> None:
+        self._inner.before_trial(study, trial)
+
+    def after_trial(self, study: Any, trial: Any, state: Any, values: Any) -> None:
+        self._inner.after_trial(study, trial, state, values)
+
+    def __str__(self) -> str:
+        return f"FaultySampler({self._inner})"
 
 
 def tear_journal_tail(file_path: str, keep_bytes: int = 7) -> int:

@@ -218,6 +218,24 @@ class StorageTestCase:
         assert storage.get_trial_user_attrs(tid) == {"lr": 0.1}
         assert storage.get_trial_system_attrs(tid) == {"retry_of": 3}
 
+    def test_sampler_fallback_attrs_round_trip(self, storage: BaseStorage) -> None:
+        """Fallback lineage (`sampler_fallback:` attrs written by the sampler
+        resilience layer mid-RUNNING) must survive the trial's whole
+        lifecycle: readable while RUNNING, intact after the terminal write,
+        and visible through both the single-trial and bulk read paths."""
+        sid = storage.create_new_study(MINIMIZE)
+        tid = storage.create_new_trial(sid)
+        reason = "ValueError: non-finite proposal for ['x']"
+        storage.set_trial_system_attr(tid, "sampler_fallback:relative", reason)
+        storage.set_trial_system_attr(
+            tid, "sampler_fallback:independent:y", "RuntimeError: injected"
+        )
+        assert storage.get_trial(tid).system_attrs["sampler_fallback:relative"] == reason
+        storage.set_trial_state_values(tid, TrialState.COMPLETE, [1.0])
+        got = storage.get_all_trials(sid)[0].system_attrs
+        assert got["sampler_fallback:relative"] == reason
+        assert got["sampler_fallback:independent:y"] == "RuntimeError: injected"
+
     def test_get_all_trials_state_filter_and_copy(self, storage: BaseStorage) -> None:
         sid = storage.create_new_study(MINIMIZE)
         for k in range(6):

@@ -1,0 +1,18 @@
+"""SMP002 positive fixture: bare Cholesky calls in (configured) sampler code."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_posterior(K):
+    L = jnp.linalg.cholesky(K)  # EXPECT: SMP002
+    return L
+
+
+def host_factor(K):
+    return np.linalg.cholesky(K)  # EXPECT: SMP002
+
+
+def fantasize(cov):
+    from jax.scipy.linalg import cholesky
+
+    return cholesky(cov)  # EXPECT: SMP002

@@ -128,6 +128,52 @@ def test_exe001_gate_rejects_drift():
     assert all("explode" in f.message for f in drifted)
 
 
+def test_smp001_registry_matches_runtime_sets():
+    """The canonical fallback policy registry equals the *runtime* values
+    of both hand-written copies (the lint compares them statically)."""
+    from optuna_tpu.samplers._resilience import FALLBACK_POLICIES
+    from optuna_tpu.testing.fault_injection import FALLBACK_CHAOS_POLICIES
+
+    canonical = set(lint_registry.FALLBACK_POLICY_REGISTRY)
+    assert set(FALLBACK_POLICIES) == canonical
+    assert set(FALLBACK_CHAOS_POLICIES) == canonical
+
+
+def test_smp001_gate_rejects_drift():
+    """Point SMP001 at the real files with a registry containing a policy the
+    code does not know: both copies must be reported as drifted — adding a
+    fallback policy without a chaos scenario is a lint failure."""
+    fat_registry = dict(lint_registry.FALLBACK_POLICY_REGISTRY)
+    fat_registry["shrug"] = "made-up policy to prove the check is live"
+    config = Config(smp001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.smp001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "SMP001"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("shrug" in f.message for f in drifted)
+
+
+def test_smp002_gate_fires_on_a_bare_cholesky_in_samplers():
+    """Prove SMP002 is live against the real tree: a scan of the samplers
+    subtree with the resilience module's pragmas ignored must flag exactly
+    the ladder helper's own (blessed) calls — i.e. the rule sees through to
+    every bare cholesky under optuna_tpu/samplers/."""
+    result = run_lint(
+        [os.path.join(PKG, "samplers")],
+        Config(enable=("SMP002",), base_dir=REPO_ROOT),
+    )
+    # The tree is clean because the only bare calls are the ladder's own,
+    # suppressed by pragma — they must show up in the suppressed list.
+    assert not result.findings, [f.format() for f in result.findings]
+    smp002_suppressed = [
+        f for f, _ in result.suppressed if f.rule == "SMP002"
+    ]
+    assert len(smp002_suppressed) == 2
+    assert all("_resilience.py" in f.path for f in smp002_suppressed)
+
+
 def test_pyproject_device_paths_mirror_registry():
     """[tool.graphlint] device-paths (the operator-visible classification)
     must stay identical to the canonical DEVICE_MODULE_PATHS — the executor
@@ -135,6 +181,7 @@ def test_pyproject_device_paths_mirror_registry():
     config = load_config(PYPROJECT)
     assert tuple(config.device_paths) == lint_registry.DEVICE_MODULE_PATHS
     assert "optuna_tpu/parallel/executor.py" in config.device_paths
+    assert "optuna_tpu/samplers/_resilience.py" in config.device_paths
 
 
 # ------------------------------------------------------- fixture self-tests
@@ -159,6 +206,10 @@ RULE_CASES = [
     ("tpu004", lambda name: Config(base_dir=REPO_ROOT)),
     ("py001", lambda name: Config(base_dir=REPO_ROOT)),
     ("sto002", lambda name: Config(base_dir=REPO_ROOT, sto002_paths=("fixtures/lint/",))),
+    (
+        "smp002",
+        lambda name: Config(base_dir=REPO_ROOT, smp002_paths=(f"fixtures/lint/{name}",)),
+    ),
 ]
 
 

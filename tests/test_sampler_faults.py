@@ -1,0 +1,518 @@
+"""Chaos suite for the sampler resilience layer (ISSUE 5).
+
+The suggestion path must never poison or kill a study. These tests prove the
+three containment rings of ``optuna_tpu/samplers/_resilience.py`` against
+injected pathology:
+
+* **ring 1 (in-graph guards)** — the jitter-ladder Cholesky resolves a
+  deliberately rank-deficient Gram matrix (duplicate rows) to a finite
+  factor with no host sync (TPU001 cleanliness is enforced by the lint gate:
+  ``_resilience.py`` is device-classified), inf objectives are clipped
+  before standardization, exact-duplicate rows collapse with count weights,
+  and zero-variance TPE bandwidths are floored;
+* **ring 2 (fallback chain)** — ``GuardedSampler`` (and the executor's
+  ``fallback=`` ask path) catch raising/NaN-proposing samplers, degrade the
+  affected trials to independent sampling, and record
+  ``sampler_fallback:`` attrs on exactly those trials;
+* **ring 3 (fit watchdog)** — a hung fit trips ``fit_deadline_s`` and
+  becomes an ordinary fallback.
+
+The acceptance matrix: GP, TPE, CMA-ES and NSGA-II each complete a fixed
+trial budget over every ``PathologicalHistoryPlan`` (identical params,
+constant values, ±inf / 1e308 values, duplicated retry clones, single-trial
+history) with zero NaN/Inf params stored and zero study aborts; wrapping a
+healthy sampler changes nothing (bit-identical fault-free runs).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.samplers import (
+    CmaEsSampler,
+    GPSampler,
+    GuardedSampler,
+    NSGAIISampler,
+    RandomSampler,
+    TPESampler,
+)
+from optuna_tpu.samplers._resilience import (
+    SAMPLER_FALLBACK_ATTR_PREFIX,
+    clip_objective_values,
+    collapse_duplicate_rows,
+    ladder_cholesky,
+    non_finite_param_names,
+)
+from optuna_tpu.storages import RetryFailedTrialCallback
+from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
+from optuna_tpu.testing.fault_injection import (
+    PATHOLOGICAL_HISTORY_PLANS,
+    FaultySampler,
+    PathologicalHistoryPlan,
+)
+from optuna_tpu.trial._frozen import create_trial
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {
+    "x": FloatDistribution(-1.0, 1.0),
+    "y": FloatDistribution(0.0, 2.0),
+}
+
+BUDGET = 3
+
+
+def _objective(trial):
+    x = trial.suggest_float("x", -1.0, 1.0)
+    y = trial.suggest_float("y", 0.0, 2.0)
+    return (x - 0.2) ** 2 + (y - 1.0) ** 2
+
+
+def _objective_multi(trial):
+    x = trial.suggest_float("x", -1.0, 1.0)
+    y = trial.suggest_float("y", 0.0, 2.0)
+    return (x - 0.2) ** 2, (y - 1.0) ** 2
+
+
+def _fallback_trials(study):
+    return sorted(
+        t.number
+        for t in study.trials
+        if any(k.startswith(SAMPLER_FALLBACK_ATTR_PREFIX) for k in t.system_attrs)
+    )
+
+
+def _assert_budget_clean(study, plan_trials: int) -> None:
+    """The whole budget completed; every stored param of every trial is
+    finite; nothing aborted or stranded."""
+    fresh = [t for t in study.trials if t.number >= plan_trials]
+    assert len(fresh) == BUDGET
+    assert all(t.state == TrialState.COMPLETE for t in fresh), [
+        (t.number, t.state) for t in fresh
+    ]
+    for t in study.trials:
+        for name, value in t.params.items():
+            assert math.isfinite(float(value)), (t.number, name, value)
+
+
+SAMPLER_FACTORIES = {
+    "tpe": lambda: TPESampler(seed=3, n_startup_trials=2),
+    "gp": lambda: GPSampler(seed=3, n_startup_trials=2),
+    "cmaes": lambda: CmaEsSampler(seed=3, n_startup_trials=1),
+    "nsgaii": lambda: NSGAIISampler(seed=3, population_size=4),
+}
+
+
+# ------------------------------------------------- chaos acceptance matrix
+
+
+@pytest.mark.parametrize("plan", PATHOLOGICAL_HISTORY_PLANS, ids=lambda p: p.name)
+@pytest.mark.parametrize("sampler_name", sorted(SAMPLER_FACTORIES))
+def test_sampler_completes_budget_on_pathological_history(sampler_name, plan):
+    """THE acceptance matrix: every sampler finishes its budget over every
+    degenerate history — no NaN params, no aborts."""
+    multi = sampler_name == "nsgaii"
+    study = optuna_tpu.create_study(
+        directions=["minimize", "minimize"] if multi else ["minimize"],
+        sampler=GuardedSampler(SAMPLER_FACTORIES[sampler_name]()),
+    )
+    plan.populate(study, SPACE, seed=11)
+    study.optimize(_objective_multi if multi else _objective, n_trials=BUDGET)
+    _assert_budget_clean(study, plan.n_trials)
+
+
+def test_plans_cover_the_documented_pathologies():
+    """The matrix itself stays honest: every documented degenerate-history
+    shape has a plan (a row in the ARCHITECTURE failure matrix)."""
+    names = {p.name for p in PATHOLOGICAL_HISTORY_PLANS}
+    assert names == {
+        "identical_params",
+        "constant_values",
+        "inf_values",
+        "huge_values",
+        "retry_clones",
+        "single_trial",
+    }
+    for plan in PATHOLOGICAL_HISTORY_PLANS:
+        assert plan.description
+
+
+# --------------------------------------------- ring 2: the fallback chain
+
+
+def _seed_history(study, n=2, seed=5):
+    rng = np.random.RandomState(seed)
+    for i in range(n):
+        study.add_trial(
+            create_trial(
+                state=TrialState.COMPLETE,
+                params={"x": float(rng.uniform(-1, 1)), "y": float(rng.uniform(0, 2))},
+                distributions=dict(SPACE),
+                values=[float(i)],
+            )
+        )
+
+
+def test_raising_sampler_falls_back_on_exactly_the_faulted_trials():
+    faulty = FaultySampler(RandomSampler(seed=1), raise_at={1, 3}, force_relative=True)
+    study = optuna_tpu.create_study(sampler=GuardedSampler(faulty))
+    _seed_history(study)
+    study.optimize(_objective, n_trials=6)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    # One relative suggestion per fresh trial: suggest indices 1 and 3 are
+    # trials 3 and 5 (numbers offset by the 2 seeded trials).
+    assert _fallback_trials(study) == [3, 5]
+    reasons = [
+        t.system_attrs[SAMPLER_FALLBACK_ATTR_PREFIX + "relative"]
+        for t in study.trials
+        if t.number in (3, 5)
+    ]
+    assert all("injected sampler crash" in r for r in reasons)
+
+
+def test_nan_proposing_sampler_never_stores_nan_params():
+    faulty = FaultySampler(RandomSampler(seed=1), nan_at={0, 2}, force_relative=True)
+    study = optuna_tpu.create_study(sampler=GuardedSampler(faulty))
+    _seed_history(study)
+    study.optimize(_objective, n_trials=5)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    for t in study.trials:
+        assert not non_finite_param_names(t.params), (t.number, t.params)
+    assert _fallback_trials(study) == [2, 4]
+    reason = study.trials[2].system_attrs[SAMPLER_FALLBACK_ATTR_PREFIX + "relative"]
+    assert "non-finite proposal" in reason
+
+
+def test_fallback_raise_policy_surfaces_the_error_after_recording():
+    faulty = FaultySampler(RandomSampler(seed=1), raise_at={0}, force_relative=True)
+    study = optuna_tpu.create_study(
+        sampler=GuardedSampler(faulty, fallback="raise")
+    )
+    _seed_history(study)
+    with pytest.raises(RuntimeError, match="injected sampler crash"):
+        study.optimize(_objective, n_trials=2)
+    # The attr landed before the raise; the trial FAILed instead of hanging.
+    assert _fallback_trials(study) == [2]
+    assert study.trials[2].state == TrialState.FAIL
+
+
+def test_guarded_sampler_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="fallback must be one of"):
+        GuardedSampler(RandomSampler(), fallback="shrug")
+
+
+def test_study_sampler_fallback_knob_wraps():
+    study = optuna_tpu.create_study(
+        sampler=TPESampler(seed=0), sampler_fallback="independent"
+    )
+    assert isinstance(study.sampler, GuardedSampler)
+    assert isinstance(study.sampler.sampler, TPESampler)
+    # Already-guarded samplers are not double-wrapped.
+    study2 = optuna_tpu.create_study(
+        sampler=GuardedSampler(TPESampler(seed=0)), sampler_fallback="independent"
+    )
+    assert not isinstance(study2.sampler.sampler, GuardedSampler)
+
+
+def test_wrapping_is_free_fault_free_runs_are_bit_identical():
+    """Ring-2 acceptance: the guard consumes no RNG and changes nothing when
+    the sampler is healthy — same seeds, same params, same best value."""
+    for make in (
+        lambda: TPESampler(seed=7, n_startup_trials=2),
+        lambda: CmaEsSampler(seed=7, n_startup_trials=1),
+    ):
+        plain = optuna_tpu.create_study(sampler=make())
+        plain.optimize(_objective, n_trials=6)
+        guarded = optuna_tpu.create_study(sampler=GuardedSampler(make()))
+        guarded.optimize(_objective, n_trials=6)
+        assert _fallback_trials(guarded) == []
+        assert [t.params for t in plain.trials] == [t.params for t in guarded.trials]
+        assert plain.best_value == guarded.best_value
+
+
+# ------------------------------------------------- ring 3: the fit watchdog
+
+
+def test_hung_fit_trips_the_watchdog_and_falls_back():
+    faulty = FaultySampler(
+        RandomSampler(seed=1), hang_at={0}, hang_s=0.5, force_relative=True
+    )
+    study = optuna_tpu.create_study(
+        sampler=GuardedSampler(faulty, fit_deadline_s=0.05)
+    )
+    _seed_history(study)
+    study.optimize(_objective, n_trials=3)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert _fallback_trials(study) == [2]
+    reason = study.trials[2].system_attrs[SAMPLER_FALLBACK_ATTR_PREFIX + "relative"]
+    assert "DispatchTimeoutError" in reason and "deadline" in reason
+
+
+def test_watchdog_uses_the_injectable_clock():
+    ticks = iter([0.0, 1000.0, 2000.0])
+    faulty = FaultySampler(
+        RandomSampler(seed=1), hang_at={0}, hang_s=0.3, force_relative=True
+    )
+    study = optuna_tpu.create_study(
+        sampler=GuardedSampler(faulty, fit_deadline_s=60.0, clock=lambda: next(ticks))
+    )
+    _seed_history(study)
+    study.optimize(_objective, n_trials=1)
+    # A 60s deadline tripped instantly on the fake clock: wall time stayed
+    # bounded by hang_s, not the deadline.
+    assert _fallback_trials(study) == [2]
+
+
+# ----------------------------------------------- ring 1: numerical guards
+
+
+def test_ladder_cholesky_resolves_rank_deficient_gram_in_graph():
+    """Acceptance: duplicate rows make the Gram exactly singular; the bare
+    factor is NaN, the ladder's is finite, in one jit program (no host
+    round-trip — the escalation is a lax.while_loop on device)."""
+    import jax
+    import jax.numpy as jnp
+
+    X = np.array([[0.3, 0.7]] * 5 + [[0.9, 0.1]], np.float32)
+    K = np.exp(-((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)).astype(np.float32)
+    bare = jnp.linalg.cholesky(jnp.asarray(K))
+    assert not bool(jnp.all(jnp.isfinite(bare)))
+
+    laddered = jax.jit(ladder_cholesky)(jnp.asarray(K))
+    assert bool(jnp.all(jnp.isfinite(laddered)))
+    # The factor reproduces a (slightly jittered) K: still a usable solve.
+    recon = np.asarray(laddered @ laddered.T)
+    assert np.allclose(recon, K, atol=1e-2)
+
+
+def test_ladder_cholesky_happy_path_matches_bare():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    A = rng.randn(6, 6).astype(np.float32)
+    K = A @ A.T + 6 * np.eye(6, dtype=np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ladder_cholesky(jnp.asarray(K))),
+        np.asarray(jnp.linalg.cholesky(jnp.asarray(K))),
+    )
+
+
+def test_standardize_clips_inf_values():
+    """Satellite regression: a history containing inf used to poison the
+    mean even though the sd guard fired."""
+    from optuna_tpu.samplers._gp.sampler import _standardize
+
+    values = np.array([np.inf, -np.inf, 1.0, 2.0], dtype=np.float64)
+    y, mu, sd = _standardize(values)
+    assert np.all(np.isfinite(y)) and np.isfinite(mu) and np.isfinite(sd)
+    # Ordering survives the clip: inf is still the best standardized score.
+    assert y[0] == np.max(y) and y[1] == np.min(y)
+
+    clipped = clip_objective_values(np.array([1e308, -1e308, np.inf]))
+    assert np.all(np.isfinite(clipped))
+
+
+def test_collapse_duplicate_rows_counts_and_order():
+    X = np.array([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0], [0.5, 0.5]], np.float32)
+    y = np.array([2.0, 5.0, 4.0, 7.0])
+    Xc, yc, counts = collapse_duplicate_rows(X, y)
+    assert Xc.tolist() == [[1.0, 0.0], [0.0, 1.0], [0.5, 0.5]]
+    assert yc.tolist() == [3.0, 5.0, 7.0]  # duplicates averaged
+    assert counts.tolist() == [2.0, 1.0, 1.0]
+    # Duplicate-free input passes through untouched (same objects' values).
+    Xs, ys, ones = collapse_duplicate_rows(X[1:], y[1:])
+    assert Xs is X[1:] or np.array_equal(Xs, X[1:])
+    assert ones.tolist() == [1.0, 1.0, 1.0]
+
+
+def test_gp_suggestions_finite_on_duplicate_history():
+    """Acceptance: GP over a rank-one design (every trial at one point)
+    emits finite suggestions — the ladder + collapse path end to end."""
+    study = optuna_tpu.create_study(sampler=GPSampler(seed=2, n_startup_trials=2))
+    plan = next(p for p in PATHOLOGICAL_HISTORY_PLANS if p.name == "identical_params")
+    plan.populate(study, SPACE, seed=3)
+    study.optimize(_objective, n_trials=2)
+    for t in study.trials:
+        assert not non_finite_param_names(t.params)
+
+
+def test_tpe_zero_variance_bandwidth_floor():
+    """All-identical observations with magic clip off: the domain-relative
+    floor keeps sigmas positive instead of collapsing to EPS deltas."""
+    from optuna_tpu.samplers._tpe.parzen_estimator import (
+        SIGMA_DOMAIN_FLOOR,
+        _ParzenEstimator,
+        _ParzenEstimatorParameters,
+    )
+
+    params = _ParzenEstimatorParameters(
+        consider_prior=True,
+        prior_weight=1.0,
+        consider_magic_clip=False,
+        consider_endpoints=False,
+        weights=lambda n: np.ones(n),
+        multivariate=False,
+        categorical_distance_func={},
+    )
+    obs = np.full(8, 0.25)
+    est = _ParzenEstimator({"x": obs}, {"x": FloatDistribution(-1.0, 1.0)}, params)
+    sigmas = est.pack()["sigmas"][:8, 0]
+    assert np.all(sigmas >= SIGMA_DOMAIN_FLOOR * 2.0)  # domain width = 2
+
+
+# ----------------------------------- satellite: fallback lineage survival
+
+
+def test_fallback_attrs_survive_retry_clone_stripping():
+    """`sampler_fallback:` attrs are logical-trial lineage: the retry
+    callback must keep them while stripping executor (`batch_exec:`)
+    bookkeeping and `fail_reason`."""
+    study = optuna_tpu.create_study()
+    study.add_trial(
+        create_trial(
+            state=TrialState.FAIL,
+            params={"x": 0.1, "y": 1.0},
+            distributions=dict(SPACE),
+            system_attrs={
+                SAMPLER_FALLBACK_ATTR_PREFIX + "relative": "RuntimeError: boom",
+                EXECUTOR_ATTR_PREFIX + "dispatch": {"batch": "a/0", "slot": 3},
+                "fail_reason": "batch dispatch raised",
+            },
+        )
+    )
+    RetryFailedTrialCallback()(study, study.trials[0])
+    clone = study.trials[1]
+    assert clone.state == TrialState.WAITING
+    attrs = clone.system_attrs
+    assert attrs[SAMPLER_FALLBACK_ATTR_PREFIX + "relative"] == "RuntimeError: boom"
+    assert not any(k.startswith(EXECUTOR_ATTR_PREFIX) for k in attrs)
+    assert "fail_reason" not in attrs
+    assert attrs["fixed_params"] == {"x": 0.1, "y": 1.0}
+
+
+# ------------------------------------------- executor ask-path fallback
+
+
+class _BatchRaisingSampler(RandomSampler):
+    def sample_relative_batch(self, study, search_space, n):
+        raise RuntimeError("batch fit crashed")
+
+
+class _RelativeRaisingSampler(RandomSampler):
+    def infer_relative_search_space(self, study, trial):
+        return dict(SPACE)
+
+    def sample_relative(self, study, trial, search_space):
+        raise RuntimeError("per-trial fit crashed")
+
+
+def _vector_objective():
+    from optuna_tpu.parallel import VectorizedObjective
+
+    return VectorizedObjective(
+        lambda p: (p["x"] - 0.2) ** 2 + (p["y"] - 1.0) ** 2, dict(SPACE)
+    )
+
+
+def test_executor_batch_sampler_crash_degrades_to_independent():
+    from optuna_tpu.parallel import optimize_vectorized
+
+    study = optuna_tpu.create_study(sampler=_BatchRaisingSampler(seed=0))
+    optimize_vectorized(study, _vector_objective(), n_trials=8, batch_size=4)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert len(study.trials) == 8
+    for t in study.trials:
+        assert not non_finite_param_names(t.params)
+        assert "batch fit crashed" in t.system_attrs[
+            SAMPLER_FALLBACK_ATTR_PREFIX + "relative_batch"
+        ]
+
+
+def test_executor_per_trial_sampler_crash_degrades_to_independent():
+    from optuna_tpu.parallel import optimize_vectorized
+
+    study = optuna_tpu.create_study(sampler=_RelativeRaisingSampler(seed=0))
+    optimize_vectorized(study, _vector_objective(), n_trials=6, batch_size=3)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    for t in study.trials:
+        assert "per-trial fit crashed" in t.system_attrs[
+            SAMPLER_FALLBACK_ATTR_PREFIX + "relative"
+        ]
+
+
+def test_executor_fallback_raise_policy_surfaces_sampler_error():
+    from optuna_tpu.parallel import optimize_vectorized
+
+    study = optuna_tpu.create_study(sampler=_BatchRaisingSampler(seed=0))
+    with pytest.raises(RuntimeError, match="batch fit crashed"):
+        optimize_vectorized(
+            study, _vector_objective(), n_trials=8, batch_size=4, fallback="raise"
+        )
+    # The crash struck before any trial existed: nothing stranded RUNNING.
+    assert all(t.state != TrialState.RUNNING for t in study.trials)
+
+
+class _CountingBatchRaisingSampler(RandomSampler):
+    def __init__(self, seed=0):
+        super().__init__(seed=seed)
+        self.batch_calls = 0
+        self.relative_calls = 0
+
+    def infer_relative_search_space(self, study, trial):
+        return dict(SPACE)
+
+    def sample_relative(self, study, trial, search_space):
+        self.relative_calls += 1
+        return {}
+
+    def sample_relative_batch(self, study, search_space, n):
+        self.batch_calls += 1
+        raise RuntimeError("batch fit crashed")
+
+
+def test_guarded_batch_crash_degrades_the_batch_once_not_per_trial():
+    """A GuardedSampler-contained batch-fit crash must not be re-attempted
+    B more times through the per-trial relative path: the executor reads
+    `last_batch_fallback_reason` and pins the whole batch independent."""
+    from optuna_tpu.parallel import optimize_vectorized
+
+    inner = _CountingBatchRaisingSampler(seed=0)
+    study = optuna_tpu.create_study(sampler=GuardedSampler(inner))
+    optimize_vectorized(study, _vector_objective(), n_trials=8, batch_size=4)
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert inner.batch_calls == 2  # one attempt per batch
+    assert inner.relative_calls == 0  # never re-attempted per trial
+    for t in study.trials:
+        assert "batch fit crashed" in t.system_attrs[
+            SAMPLER_FALLBACK_ATTR_PREFIX + "relative_batch"
+        ]
+
+
+def test_executor_inherits_guarded_study_raise_policy():
+    """create_study(sampler_fallback='raise') + default optimize_vectorized:
+    the executor must not silently downgrade the study's declared policy."""
+    from optuna_tpu.parallel import optimize_vectorized
+
+    study = optuna_tpu.create_study(
+        sampler=_BatchRaisingSampler(seed=0), sampler_fallback="raise"
+    )
+    assert isinstance(study.sampler, GuardedSampler)
+    with pytest.raises(RuntimeError, match="batch fit crashed"):
+        optimize_vectorized(study, _vector_objective(), n_trials=8, batch_size=4)
+    # An explicit executor knob still overrides the inherited policy.
+    optimize_vectorized(
+        study, _vector_objective(), n_trials=4, batch_size=4, fallback="independent"
+    )
+    assert sum(t.state == TrialState.COMPLETE for t in study.trials) == 4
+
+
+def test_executor_rejects_unknown_fallback_policy():
+    from optuna_tpu.parallel.executor import ResilientBatchExecutor
+
+    study = optuna_tpu.create_study()
+    with pytest.raises(ValueError, match="fallback must be one of"):
+        ResilientBatchExecutor(study, _vector_objective(), fallback="shrug")

@@ -197,6 +197,50 @@ def test_journal_snapshot_roundtrip(tmp_path):
         js.SNAPSHOT_INTERVAL = old
 
 
+def test_journal_snapshot_crc_rejects_corruption_before_unpickle(tmp_path):
+    """A torn/corrupt snapshot must be caught by the CRC32 header and degrade
+    to full replay — pickle never sees the bytes."""
+    from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+    from optuna_tpu.storages.journal._file import frame_snapshot
+
+    path = str(tmp_path / "crc.journal")
+    s = JournalStorage(JournalFileBackend(path))
+    s.create_new_study([StudyDirection.MINIMIZE], "alpha")
+
+    backend = JournalFileBackend(path)
+    # Legacy/garbage snapshot (no frame): ignored, full replay works.
+    with open(path + ".snapshot", "wb") as f:
+        f.write(b"\x80\x04garbage-that-would-crash-unpickling")
+    assert backend.load_snapshot() is None
+    assert len(JournalStorage(JournalFileBackend(path)).get_all_studies()) == 1
+
+    # Framed but bit-flipped payload: CRC mismatch, same degrade.
+    framed = bytearray(frame_snapshot(b"payload-bytes"))
+    framed[-1] ^= 0xFF
+    with open(path + ".snapshot", "wb") as f:
+        f.write(bytes(framed))
+    assert backend.load_snapshot() is None
+    assert len(JournalStorage(JournalFileBackend(path)).get_all_studies()) == 1
+
+
+def test_journal_snapshot_version_drift_degrades_to_replay(tmp_path):
+    """A checksum-VALID snapshot whose pickle references classes this release
+    does not have (version drift: AttributeError/ImportError, not
+    UnpicklingError) must also degrade to full replay, not crash open."""
+    from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+
+    path = str(tmp_path / "drift.journal")
+    s = JournalStorage(JournalFileBackend(path))
+    s.create_new_study([StudyDirection.MINIMIZE], "alpha")
+
+    # A hand-built pickle naming a module that does not exist: honest bytes
+    # (CRC passes), unpicklable content (ModuleNotFoundError).
+    drifted = b"coptuna_tpu.no_such_module\nNoSuchClass\n."
+    JournalFileBackend(path).save_snapshot(drifted)
+    s2 = JournalStorage(JournalFileBackend(path))
+    assert len(s2.get_all_studies()) == 1
+
+
 def test_rdb_persistence_across_instances(tmp_path):
     from optuna_tpu.storages._rdb.storage import RDBStorage
 
