@@ -57,6 +57,27 @@ def _silence() -> None:
     optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
 
 
+def _reset_phase_telemetry() -> None:
+    """Arm the telemetry spine for a timed window: recording on, registry
+    cleared, so the emitted per-phase breakdown covers exactly the timed
+    trials (warm-up/compile work is excluded the same way the wall clock
+    excludes it)."""
+    from optuna_tpu import telemetry
+
+    telemetry.enable()
+    telemetry.reset()
+
+
+def _phase_breakdown() -> dict:
+    """{phase: {total_s, count}} from the spans recorded since the last
+    reset — the breakdown that localizes which of ask/fit/propose/dispatch/
+    tell paid for a regression (the r03->r04 question the trajectory file
+    could not answer)."""
+    from optuna_tpu import telemetry
+
+    return telemetry.phase_totals()
+
+
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
@@ -120,6 +141,7 @@ def run_ours_gp(
         sampler=GPSampler(seed=0, n_startup_trials=n_startup, speculative_chain=chain)
     )
     study.optimize(hartmann20, n_trials=n_warmup)
+    _reset_phase_telemetry()
     t0 = time.time()
     study.optimize(hartmann20, n_trials=n_timed)
     dt = time.time() - t0
@@ -138,6 +160,7 @@ def run_ours_gp_end_to_end(n_total: int, chain: int = 8) -> tuple[float, float]:
     study = optuna_tpu.create_study(
         sampler=GPSampler(seed=0, speculative_chain=chain)
     )
+    _reset_phase_telemetry()
     t0 = time.time()
     study.optimize(hartmann20, n_trials=n_total)
     return time.time() - t0, study.best_value
@@ -157,6 +180,7 @@ def run_ours_tpe(n_warmup: int, n_timed: int, objective=None) -> tuple[float, fl
     warm.optimize(objective, n_trials=n_warmup + n_timed)
     study = optuna_tpu.create_study(sampler=TPESampler(seed=0))
     study.optimize(objective, n_trials=n_warmup)
+    _reset_phase_telemetry()
     t0 = time.time()
     study.optimize(objective, n_trials=n_timed)
     dt = time.time() - t0
@@ -173,6 +197,7 @@ def run_ours_cmaes(n_warmup: int, n_timed: int) -> tuple[float, float]:
     warm.optimize(lambda t: rastrigin(t, dim=50), n_trials=120)  # compile gens
     study = optuna_tpu.create_study(sampler=CmaEsSampler(seed=0, popsize=40))
     study.optimize(lambda t: rastrigin(t, dim=50), n_trials=n_warmup)
+    _reset_phase_telemetry()
     t0 = time.time()
     study.optimize(lambda t: rastrigin(t, dim=50), n_trials=n_timed)
     dt = time.time() - t0
@@ -253,6 +278,7 @@ def run_ours_mlp_vectorized(
         sampler=TPESampler(seed=0, multivariate=True, constant_liar=True, n_startup_trials=10)
     )
     optimize_vectorized(study, obj, n_trials=n_warmup, batch_size=batch_size)
+    _reset_phase_telemetry()
     t0 = time.time()
     optimize_vectorized(study, obj, n_trials=n_timed, batch_size=batch_size)
     dt = time.time() - t0
@@ -303,6 +329,7 @@ def run_ours_nsga2(n_warmup: int, n_timed: int, objective=None, hv_ref=(1.1, 10.
         directions=["minimize", "minimize"], sampler=NSGAIISampler(seed=0, population_size=50)
     )
     study.optimize(objective, n_trials=n_warmup)
+    _reset_phase_telemetry()
     t0 = time.time()
     study.optimize(objective, n_trials=n_timed)
     dt = time.time() - t0
@@ -901,6 +928,11 @@ def main() -> None:
             extra["front_hv_reference"] = round(float(base[1]), 4)
         metric = "nsga2_trials_per_sec_zdt1"
 
+    # Per-phase breakdown from the telemetry spans recorded over the timed
+    # window (ask / ask.fit / ask.propose / dispatch / tell / storage.op):
+    # the instrument that localizes a trials/s regression to the phase that
+    # paid for it (ROADMAP item 5 — the r03->r04 drop had no such signal).
+    extra["phases"] = _phase_breakdown()
     watchdog.update(metric=metric, value=round(ours_rate, 3))
     watchdog.phase("emit")
     if base is not None:
@@ -927,6 +959,37 @@ def main() -> None:
         out["fallback"] = True  # tunnel was down; NOT an accelerator number
     watchdog.finish()
     print(json.dumps(out))
+    _record_trajectory(out, mode="quick" if args.quick else "full")
+
+
+def _record_trajectory(out: dict, mode: str) -> None:
+    """Append the completed result to the committed BENCH_TRAJECTORY.json
+    and report the regression-gate verdict to stderr (the slow-marked gate
+    test in tests/test_perf_gate.py turns the verdict into a CI failure).
+    Best-effort by design: a trajectory-file problem must not cost the run
+    its one JSON line. Opt out with OPTUNA_TPU_BENCH_NO_TRAJECTORY=1."""
+    if os.environ.get("OPTUNA_TPU_BENCH_NO_TRAJECTORY"):
+        return
+    try:
+        import bench_trajectory
+
+        verdict = bench_trajectory.check_regression(
+            bench_trajectory.load_trajectory(),
+            metric=out["metric"],
+            mode=mode,
+            platform=out.get("platform", "unknown"),
+            value=out["value"],
+        )
+        # A failing value is recorded for the ledger but flagged so it can
+        # never become the next run's baseline (no rerun-until-green).
+        entry = bench_trajectory.append_entry(
+            out, mode=mode, regressed=verdict is not None
+        )
+        _log(f"trajectory: appended {entry['round']} to {bench_trajectory.trajectory_path()}")
+        if verdict is not None:
+            _log(f"REGRESSION: {verdict}")
+    except Exception as exc:
+        _log(f"trajectory append failed (non-fatal): {exc!r}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,144 @@
+"""Committed perf-trajectory appender + regression gate (ROADMAP item 5).
+
+``BENCH_TRAJECTORY.json`` is the committed, append-only record of the
+ours-side bench numbers across PR rounds — the cross-round-comparable
+figure per ARCHITECTURE.md (``vs_baseline`` moves when the *pinned
+reference* is recaptured, so only the ours-side trials/s gates). The file
+exists because the r03 -> r04 regression (10.9 -> 8.3 trials/s) went
+unnoticed for a full round and r05 died without a number at all: every
+completed ``bench.py`` run now appends its result here, and the
+``slow``-marked gate test (``tests/test_perf_gate.py``) fails on a >10%
+ours-side drop against the last comparable entry.
+
+Comparability key: (metric, mode, platform). Quick-mode and full-mode runs
+measure different trial depths, and a CPU-fallback number must never gate
+(or be gated by) an accelerator number. Partial (watchdog-emitted) and
+null-value entries are recorded for the historical ledger but excluded
+from gating.
+
+Deliberately a repo-root module beside ``bench.py`` (not packaged):
+importing it never blocks signals or touches jax, so tests and tooling can
+load the gate logic without inheriting the bench's process-level setup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TRAJECTORY.json"
+)
+
+#: Gate threshold: a new ours-side value below (1 - this) x the last
+#: comparable value fails the perf gate.
+MAX_REGRESSION_FRAC = 0.10
+
+
+def trajectory_path() -> str:
+    return os.environ.get("OPTUNA_TPU_BENCH_TRAJECTORY_PATH", DEFAULT_PATH)
+
+
+def load_trajectory(path: str | None = None) -> dict:
+    path = path or trajectory_path()
+    if not os.path.exists(path):
+        return {"gate": {"max_regression_frac": MAX_REGRESSION_FRAC}, "entries": []}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def comparable_entries(
+    trajectory: dict, metric: str, mode: str, platform: str
+) -> list[dict]:
+    """Entries this (metric, mode, platform) gates against: same key, a real
+    (non-null, non-partial) value."""
+    return [
+        e
+        for e in trajectory.get("entries", ())
+        if e.get("metric") == metric
+        and e.get("mode") == mode
+        and e.get("platform") == platform
+        and e.get("value") is not None
+        and not e.get("partial")
+        and not e.get("regressed")
+    ]
+
+
+def check_regression(
+    trajectory: dict,
+    metric: str,
+    mode: str,
+    platform: str,
+    value: float,
+    threshold: float | None = None,
+) -> str | None:
+    """None when the gate passes (or has no comparable baseline yet); a
+    human-readable failure message on a >threshold ours-side regression."""
+    if threshold is None:
+        threshold = float(
+            trajectory.get("gate", {}).get("max_regression_frac", MAX_REGRESSION_FRAC)
+        )
+    history = comparable_entries(trajectory, metric, mode, platform)
+    if not history:
+        return None
+    last = history[-1]
+    floor = last["value"] * (1.0 - threshold)
+    if value < floor:
+        drop = 1.0 - value / last["value"]
+        return (
+            f"perf gate: {metric} [{mode}/{platform}] regressed "
+            f"{drop:.1%} ({last['value']} -> {value} trials/s; entry "
+            f"{last.get('round', '?')}, floor {floor:.3f} at "
+            f"{threshold:.0%} tolerance)"
+        )
+    return None
+
+
+def append_entry(
+    result: dict[str, Any],
+    mode: str,
+    path: str | None = None,
+    now: float | None = None,
+    regressed: bool = False,
+) -> dict:
+    """Append one bench result (the parsed JSON line ``bench.py`` printed)
+    and rewrite the file. Returns the appended entry. Partial lines are
+    appended too — a dead round should leave a tombstone, not silence
+    (the r05 lesson) — but never gate. ``regressed`` marks an entry that
+    FAILED the gate when it was recorded: it stays in the ledger but is
+    excluded from gating, so a regression cannot launder itself into the
+    next run's baseline by merely being re-run — accepting a slowdown
+    means editing the committed file (removing the flag) under review,
+    not rerunning until green."""
+    path = path or trajectory_path()
+    trajectory = load_trajectory(path)
+    entries = trajectory.setdefault("entries", [])
+    entry: dict[str, Any] = {
+        "round": f"local-{len(entries) + 1}",
+        "captured": time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.localtime(now if now is not None else time.time())
+        ),
+        "metric": result.get("metric"),
+        "mode": mode,
+        "platform": result.get("platform"),
+        "value": result.get("value"),
+        "vs_baseline": result.get("vs_baseline"),
+    }
+    if regressed:
+        entry["regressed"] = True
+    if result.get("partial"):
+        entry["partial"] = True
+        entry["partial_reason"] = result.get("partial_reason")
+    if result.get("fallback"):
+        entry["fallback"] = True
+    if result.get("phases"):
+        entry["phases"] = result["phases"]
+    entries.append(entry)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(trajectory, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    return entry

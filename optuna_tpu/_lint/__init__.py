@@ -12,6 +12,7 @@ TPU001   host sync (float()/.item()/np.asarray) inside a jit trace
 TPU002   jit built per-call / static args with unhashable defaults
 TPU003   float64 in an f32-hardened device module
 TPU004   stray print / jax.debug.print in package code
+OBS001   telemetry/logging call inside a jit trace of a device module
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 EXE001   non-finite quarantine policy sets drifted from the canonical one
@@ -42,6 +43,7 @@ from optuna_tpu._lint.config import Config, find_pyproject, load_config  # noqa:
 def all_rules() -> list[Rule]:
     """One fresh instance of every graphlint rule, in reporting order."""
     from optuna_tpu._lint.rules_device import (
+        OBS001TelemetryInTrace,
         TPU001HostSyncInJit,
         TPU002RecompileHazard,
         TPU003DtypeDrift,
@@ -63,6 +65,7 @@ def all_rules() -> list[Rule]:
         TPU002RecompileHazard(),
         TPU003DtypeDrift(),
         TPU004StrayDebugOutput(),
+        OBS001TelemetryInTrace(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
         EXE001NonFinitePolicySync(),

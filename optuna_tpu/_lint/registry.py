@@ -16,9 +16,14 @@ in reviewers' heads:
   set and the fault-injection chaos matrix in sync (see
   :data:`SMP001_TARGETS`). :data:`SMP002_CHOLESKY_HELPER` names the single
   blessed Cholesky call site for sampler code (rule **SMP002**).
+* :data:`TELEMETRY_PHASE_REGISTRY` / :data:`TELEMETRY_COUNTER_REGISTRY` —
+  the observability vocabulary (span/phase names shared by profiler
+  annotations and metrics histograms; containment-counter families);
+  ``tests/test_telemetry.py`` fails if ``telemetry.py``'s literals drift.
 * :data:`DEVICE_MODULE_PATHS` — the f32-hardened, sync-free modules where
-  the TPU rules apply. Everything the paper's "one fused dispatch per
-  suggestion" latency argument rests on lives here.
+  the TPU rules apply (and where rule **OBS001** forbids telemetry/logging
+  calls inside traced scopes). Everything the paper's "one fused dispatch
+  per suggestion" latency argument rests on lives here.
 * :data:`HOST_BOUNDARY_F64` — the reviewed host-side functions inside
   device modules that legitimately touch float64 (rule **TPU003** skips
   them). Every entry documents why that boundary is host-only.
@@ -114,6 +119,37 @@ SMP001_TARGETS: tuple[tuple[str, str, str], ...] = (
         "chaos matrix: every fallback policy must have an injection scenario",
     ),
 )
+
+#: The telemetry phase vocabulary (one name set for profiler annotations AND
+#: metrics histograms): canonical mirror of ``telemetry.py::PHASES``.
+#: ``tests/test_telemetry.py`` fails if the two drift — a phase added to the
+#: instrumentation without joining the documented vocabulary is a test
+#: failure, the STO001 discipline applied to observability names.
+TELEMETRY_PHASE_REGISTRY: dict[str, str] = {
+    "ask": "trial creation + parameter suggestion (Study.ask / ask_batch)",
+    "ask.search_space": "relative search-space construction inside the sampler",
+    "ask.fit": "surrogate fit inputs + fitting (host packing, GP/TPE fit)",
+    "ask.propose": "acquisition optimization / fused proposal dispatch",
+    "dispatch": "objective execution (serial call or batched device dispatch)",
+    "tell": "result commit + callbacks (study.tell / batch tell loop)",
+    "storage.op": "one logical storage operation (retries + backoff included)",
+}
+
+#: The containment-counter families: canonical mirror of
+#: ``telemetry.py::COUNTERS`` (same drift test). Every family must have a
+#: chaos scenario in ``tests/test_telemetry_chaos.py``.
+TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
+    "storage.retry": "RetryPolicy replayed a transiently-failed call",
+    "grpc.redial": "gRPC client dropped a wedged channel and dialed fresh",
+    "grpc.op_token_dedup": "gRPC server deduped a replayed replay-unsafe write",
+    "sampler.fallback": "(suffixed by phase) a suggestion degraded to the independent path",
+    "executor.quarantine": "a non-finite trial was quarantined as FAIL",
+    "executor.bisection": "a failed dispatch was bisected to isolate poison trials",
+    "executor.oom_halving": "an OOM-shaped dispatch error halved the batch",
+    "executor.dispatch_timeout": "a device dispatch overran its deadline and was abandoned",
+    "heartbeat.reap": "a stale (dead-worker) RUNNING trial was reaped to FAIL",
+    "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
+}
 
 #: The single blessed Cholesky call site for sampler code (rule **SMP002**):
 #: every kernel solve in ``optuna_tpu/samplers/`` must go through the

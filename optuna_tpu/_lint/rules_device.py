@@ -1,12 +1,18 @@
 """Device-kernel rules: TPU001 host sync, TPU002 recompile hazard,
-TPU003 dtype drift, TPU004 stray debug output.
+TPU003 dtype drift, TPU004 stray debug output, OBS001 observability taps
+in traced scopes.
 
 The TPU rules encode the invariants ARCHITECTURE.md's design stance rests
 on: inside a jit trace nothing may force a host round-trip (TPU001), jit
 wrappers are built once at module scope so the executable cache is keyed
 stably (TPU002), and f32-hardened modules never let float64 near a device
 graph (TPU003). JAX makes violations invisible until a recompile storm or
-NaN shows up on hardware — hence static analysis.
+NaN shows up on hardware — hence static analysis. OBS001 extends TPU001's
+stance to the telemetry spine: instrumentation is host-side by contract
+(``telemetry.py``'s overhead promise), so a ``telemetry.*``/logger call
+inside a jit-decorated function or ``lax`` loop body of a device module is
+a bug even when it would trace successfully — at best it runs at trace
+time (recording garbage once per compile), at worst it forces a host sync.
 """
 
 from __future__ import annotations
@@ -194,6 +200,69 @@ def _ancestors(node: ast.AST, parents: dict[ast.AST, ast.AST]) -> Iterator[ast.A
     while cur is not None:
         yield cur
         cur = parents.get(cur)
+
+
+class OBS001TelemetryInTrace(Rule):
+    id = "OBS001"
+    title = "telemetry/logging call inside a jit trace"
+
+    #: Module aliases whose calls are observability taps wherever they point
+    #: (``telemetry.count(...)``, ``logging_module.warn_once(...)``).
+    _TAP_ROOTS = {"telemetry", "logging", "logging_module"}
+    #: Logger method names — flagged when called on something logger-shaped.
+    _LOG_METHODS = {
+        "debug", "info", "warning", "warn", "error", "exception", "critical", "log",
+    }
+    #: Receiver names that identify a logger object by convention.
+    _LOGGER_NAMES = {"logger", "_logger", "log"}
+    #: Bare-name calls that are observability taps regardless of receiver.
+    _TAP_FUNCS = {"warn_once", "get_logger"}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not ctx.is_device:
+            return
+        traced = _traced_scopes(ctx.tree)
+        if not traced:
+            return
+        parents = _walk_parents(ctx.tree)
+        roots = [n for n in traced if not any(p in traced for p in _ancestors(n, parents))]
+        seen: set[int] = set()
+        for root in roots:
+            body = root.body if isinstance(root.body, list) else [root.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if id(node) in seen or not isinstance(node, ast.Call):
+                        continue
+                    seen.add(id(node))
+                    hit = self._classify(node)
+                    if hit is not None:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"{hit} inside a traced scope of a device module: "
+                            "instrumentation is host-side by contract (it must "
+                            "never add a host sync or trace-time side effect "
+                            "to a device graph); record around the dispatch, "
+                            "not inside it",
+                        )
+
+    def _classify(self, node: ast.Call) -> str | None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in self._TAP_FUNCS:
+                return f"{func.id}()"
+            return None
+        chain = _attr_chain(func)
+        if not chain:
+            return None
+        if chain[0] in self._TAP_ROOTS:
+            return ".".join(chain) + "()"
+        if (
+            len(chain) >= 2
+            and chain[-1] in self._LOG_METHODS
+            and chain[-2] in self._LOGGER_NAMES
+        ):
+            return ".".join(chain) + "()"
+        return None
 
 
 class TPU002RecompileHazard(Rule):

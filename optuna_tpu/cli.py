@@ -2,7 +2,8 @@
 
 Parity target: ``optuna/cli.py:814-977`` — 11 subcommands including shell
 level ``ask``/``tell`` for driving distributed loops from scripts, with
-json/table/yaml output formats (``:156-273``).
+json/table/yaml output formats (``:156-273``); plus the ``metrics`` dump of
+the telemetry registry (``optuna_tpu/telemetry.py``, no reference analog).
 
 Entry points: ``python -m optuna_tpu.cli ...`` or the ``optuna-tpu`` console
 script.
@@ -212,6 +213,45 @@ def _cmd_tell(args: argparse.Namespace) -> None:
     )
 
 
+def _cmd_metrics(args: argparse.Namespace) -> None:
+    """Dump the telemetry registry (see :mod:`optuna_tpu.telemetry`).
+
+    Without ``--endpoint`` the dump is this process's registry — empty unless
+    ``OPTUNA_TPU_TELEMETRY`` was set or the invoked workflow recorded
+    something; with ``--endpoint`` it is fetched from a serving process (the
+    gRPC proxy's ``metrics_port``), which is where a live study's numbers
+    actually accumulate.
+    """
+    from optuna_tpu import telemetry
+
+    if args.endpoint:
+        import urllib.request
+
+        base = args.endpoint.rstrip("/")
+        path = "/metrics.json" if args.format == "json" else "/metrics"
+        if base.endswith("/metrics.json") or base.endswith("/metrics"):
+            # A full path pins the format; a silent mismatch would hand
+            # Prometheus text to a JSON consumer (or vice versa).
+            implied = "json" if base.endswith("/metrics.json") else "prom"
+            if implied != args.format:
+                raise CLIUsageError(
+                    f"endpoint path {base!r} serves {implied!r} but "
+                    f"--format={args.format}; pass the matching --format or "
+                    "give the base URL (e.g. http://host:9090) and let the "
+                    "format pick the path."
+                )
+            url = base
+        else:
+            url = base + path
+        with urllib.request.urlopen(url, timeout=10) as response:
+            print(response.read().decode(), end="")
+        return
+    if args.format == "json":
+        print(json.dumps(telemetry.snapshot(), sort_keys=True))
+    else:
+        print(telemetry.render_prometheus(), end="")
+
+
 def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="optuna-tpu")
     parser.add_argument("--storage", default=None, help="DB/journal/grpc URL")
@@ -267,6 +307,15 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sampler", default=None)
     p.add_argument("--sampler-kwargs", default=None)
     p.add_argument("--search-space", default=None)
+
+    p = add("metrics", _cmd_metrics)
+    p.add_argument("-f", "--format", default="json", choices=["json", "prom"])
+    p.add_argument(
+        "--endpoint",
+        default=None,
+        help="fetch from a serving process (e.g. http://host:9090) instead of "
+        "this process's registry",
+    )
 
     p = add("tell", _cmd_tell)
     p.add_argument("--study-name", required=True)

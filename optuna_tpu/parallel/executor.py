@@ -33,6 +33,7 @@ gets to tell, and layer 3 exists precisely to reap what it strands.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -40,8 +41,9 @@ from typing import TYPE_CHECKING, Callable, Sequence
 
 import numpy as np
 
+from optuna_tpu import _tracing, telemetry
 from optuna_tpu.exceptions import OptunaTPUError, UpdateFinishedTrialError
-from optuna_tpu.logging import get_logger
+from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
 from optuna_tpu.storages._heartbeat import (
     fail_stale_trials,
@@ -65,6 +67,15 @@ if TYPE_CHECKING:
     from optuna_tpu.trial._frozen import FrozenTrial
 
 _logger = get_logger(__name__)
+
+# Phase names resolved once at module scope (the study-loop vocabulary,
+# telemetry.PHASES) so the per-batch hot path never builds a string.
+_TRACE_ASK = telemetry.trace_name("ask")
+_TRACE_DISPATCH = telemetry.trace_name("dispatch")
+_TRACE_TELL = telemetry.trace_name("tell")
+
+#: Monotonic per-executor run tokens (see ``_run_token``).
+_executor_seq = itertools.count()
 
 
 #: The accepted ``non_finite=`` policy literals and what each does to a
@@ -246,7 +257,10 @@ class ResilientBatchExecutor:
         self._guarded = objective.guarded(mesh, batch_axis, non_finite)
         # Distinguishes this executor's dispatch bookkeeping from any other
         # worker's in the shared storage (debuggability, not correctness).
-        self._run_token = f"{os.getpid():x}.{id(self) & 0xFFFFFF:x}"
+        # Monotonic (not id(self)-based): the token also keys warn_once
+        # suppression, and a recycled address must not inherit a dead
+        # executor's already-warned state.
+        self._run_token = f"{os.getpid():x}.{next(_executor_seq):x}"
 
     # ------------------------------------------------------------------- loop
 
@@ -266,49 +280,71 @@ class ResilientBatchExecutor:
         study._thread_local.in_optimize_loop = True  # callbacks may stop()
         try:
             done = 0
-            while done < n_trials and not study._stop_flag:
-                if is_heartbeat_enabled(study._storage):
-                    # Batch boundary reap: a dead peer's stranded batch is
-                    # failed + re-enqueued before we ask, so ask_batch below
-                    # claims the WAITING clones first.
-                    fail_stale_trials(study)
-                b = min(self._batch_size, n_trials - done)
-                size_before = self._batch_size
-                self._oom_seen = False
-                trials, proposals = self._ask_batch(b)
-                try:
-                    # Parameter suggestion runs *inside* the heartbeat
-                    # (whose __enter__ records a synchronous first beat, so
-                    # a worker killed mid-suggest still strands a reapable
-                    # batch).
-                    with get_batch_heartbeat_thread(
-                        [t._trial_id for t in trials], study._storage
-                    ):
-                        self._prepare_batch(trials, proposals)
-                        self._run_batch(trials)
-                except Exception as err:  # graphlint: ignore[PY001] -- last-line containment sweep: whatever escaped between ask and tell must not leave trials RUNNING; the original error re-raises below. BaseException (worker death) punches through for heartbeat failover
-                    # Catch-all sweep over the batch: anything that escaped
-                    # the inner containment — the heartbeat's first beat, a
-                    # sampler raising mid-suggest, a user callback raising
-                    # mid-notify, a storage blip during containment itself —
-                    # must not leave created-or-evaluated trials RUNNING
-                    # (on a heartbeat-less storage nothing would ever reap
-                    # them). _fail_trials skips already-terminal trials, so
-                    # the sweep is idempotent over whatever containment did
-                    # manage to commit.
-                    try:
-                        self._fail_trials(trials, f"batch aborted: {err!r}")
-                    except Exception as sweep_err:  # graphlint: ignore[PY001] -- the storage is down mid-sweep; the original batch error matters more than the sweep's, so log and fall through to the raise
-                        _logger.warning(
-                            f"containment sweep after a batch error itself "
-                            f"raised {sweep_err!r}; surfacing the original "
-                            "error."
-                        )
-                    raise
-                done += len(trials)
-                self._maybe_grow(len(trials), size_before)
+            # OPTUNA_TPU_TRACE covers the vectorized loop the same way
+            # Study.optimize is covered: one env switch profiles either.
+            with _tracing.maybe_trace_from_env():
+                while done < n_trials and not study._stop_flag:
+                    done += self._run_one_batch(n_trials - done)
         finally:
             study._thread_local.in_optimize_loop = False
+
+    def _run_one_batch(self, remaining: int) -> int:
+        """One ask -> heartbeat(suggest + dispatch + tell) cycle; returns the
+        batch width advanced."""
+        study = self._study
+        if is_heartbeat_enabled(study._storage):
+            # Batch boundary reap: a dead peer's stranded batch is
+            # failed + re-enqueued before we ask, so ask_batch below
+            # claims the WAITING clones first.
+            fail_stale_trials(study)
+        b = min(self._batch_size, remaining)
+        size_before = self._batch_size
+        self._oom_seen = False
+        # The logical "ask" phase spans two non-contiguous blocks (batch
+        # creation here, parameter suggestion inside the heartbeat below),
+        # so the durations are stitched into ONE histogram observation per
+        # batch — two span() blocks would double the count and halve the
+        # apparent per-batch ask latency.
+        ask_t0 = self._clock()
+        with _tracing.annotate(_TRACE_ASK):
+            trials, proposals = self._ask_batch(b)
+        ask_seconds = self._clock() - ask_t0
+        try:
+            # Parameter suggestion runs *inside* the heartbeat
+            # (whose __enter__ records a synchronous first beat, so
+            # a worker killed mid-suggest still strands a reapable
+            # batch).
+            with get_batch_heartbeat_thread(
+                [t._trial_id for t in trials], study._storage
+            ):
+                ask_t0 = self._clock()
+                with _tracing.annotate(_TRACE_ASK):
+                    self._prepare_batch(trials, proposals)
+                telemetry.observe_phase(
+                    "ask", ask_seconds + (self._clock() - ask_t0)
+                )
+                self._run_batch(trials)
+        except Exception as err:  # graphlint: ignore[PY001] -- last-line containment sweep: whatever escaped between ask and tell must not leave trials RUNNING; the original error re-raises below. BaseException (worker death) punches through for heartbeat failover
+            # Catch-all sweep over the batch: anything that escaped
+            # the inner containment — the heartbeat's first beat, a
+            # sampler raising mid-suggest, a user callback raising
+            # mid-notify, a storage blip during containment itself —
+            # must not leave created-or-evaluated trials RUNNING
+            # (on a heartbeat-less storage nothing would ever reap
+            # them). _fail_trials skips already-terminal trials, so
+            # the sweep is idempotent over whatever containment did
+            # manage to commit.
+            try:
+                self._fail_trials(trials, f"batch aborted: {err!r}")
+            except Exception as sweep_err:  # graphlint: ignore[PY001] -- the storage is down mid-sweep; the original batch error matters more than the sweep's, so log and fall through to the raise
+                _logger.warning(
+                    f"containment sweep after a batch error itself "
+                    f"raised {sweep_err!r}; surfacing the original "
+                    "error."
+                )
+            raise
+        self._maybe_grow(len(trials), size_before)
+        return len(trials)
 
     # ----------------------------------------------------------------- phases
 
@@ -472,7 +508,11 @@ class ResilientBatchExecutor:
         """Record why a trial's suggestion degraded — same attr namespace as
         :class:`~optuna_tpu.samplers._resilience.GuardedSampler` (NOT
         ``batch_exec:``-prefixed: fallback lineage describes the logical
-        trial and must survive retry-clone attr stripping)."""
+        trial and must survive retry-clone attr stripping). Every occurrence
+        is counted (``sampler.fallback.<phase-family>``) and attributed on
+        the trial; the log warns once per (run, condition) via
+        :func:`~optuna_tpu.logging.warn_once`."""
+        telemetry.count("sampler.fallback." + phase.split(":", 1)[0])
         try:
             self._study._storage.set_trial_system_attr(
                 trial._trial_id, SAMPLER_FALLBACK_ATTR_PREFIX + phase, reason[:500]
@@ -482,9 +522,14 @@ class ResilientBatchExecutor:
                 f"recording sampler fallback for trial {trial.number} raised "
                 f"{err!r}; continuing with the fallback anyway."
             )
-        _logger.warning(
+        warn_once(
+            _logger,
+            f"executor_fallback:{self._run_token}:{phase.split(':', 1)[0]}",
             f"trial {trial.number}: sampler suggestion degraded to the "
-            f"independent path during {phase}: {reason}"
+            f"independent path during {phase}: {reason}. Further {phase} "
+            "fallbacks in this run are recorded in "
+            f"'{SAMPLER_FALLBACK_ATTR_PREFIX}*' trial attrs (and the "
+            "sampler.fallback telemetry counter) without a log line.",
         )
 
     def _run_batch(self, trials: list[Trial]) -> None:
@@ -494,7 +539,8 @@ class ResilientBatchExecutor:
         except Exception as err:  # graphlint: ignore[PY001] -- containment boundary: every dispatch error becomes FAIL tells (plus bisection/halving); BaseException (worker death, Ctrl-C) punches through for heartbeat failover
             self._contain(trials, err)
             return
-        self._tell_batch(trials, values, finite)
+        with _tracing.annotate(_TRACE_TELL), telemetry.span("tell"):
+            self._tell_batch(trials, values, finite)
 
     def _eval(self, trials: list[Trial]) -> tuple[np.ndarray, np.ndarray]:
         import jax.numpy as jnp
@@ -537,11 +583,12 @@ class ResilientBatchExecutor:
         return np.asarray(values), np.asarray(finite)
 
     def _dispatch(self, args: dict) -> tuple[np.ndarray, np.ndarray]:
-        if self._deadline_s is None:
-            return self._realize(args)
-        return run_with_deadline(
-            lambda: self._realize(args), self._deadline_s, self._clock
-        )
+        with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"):
+            if self._deadline_s is None:
+                return self._realize(args)
+            return run_with_deadline(
+                lambda: self._realize(args), self._deadline_s, self._clock
+            )
 
     def _contain(self, trials: list[Trial], err: Exception) -> None:
         """A dispatch over ``trials`` raised ``err``: salvage what we can,
@@ -555,6 +602,7 @@ class ResilientBatchExecutor:
             # paces the backoff.
             self._oom_attempts += 1
             self._oom_seen = True
+            telemetry.count("executor.oom_halving")
             if b >= self._batch_size:
                 # Only a full-width dispatch is capacity evidence: later
                 # batches start at the halved size until _maybe_grow earns
@@ -595,10 +643,12 @@ class ResilientBatchExecutor:
             # bisection salvaging the halves doesn't launder the evidence.
             self._timeout_strikes += 1
             self._timeout_width = max(self._timeout_width, b)
+            telemetry.count("executor.dispatch_timeout")
             if self._timeout_strikes >= self._strike_budget:
                 self._fail_trials(trials, f"batch dispatch raised: {err!r}")
                 raise err
         if self._bisect and b > 1:
+            telemetry.count("executor.bisection")
             _logger.warning(
                 f"dispatch of {b} trials raised {err!r}; bisecting to isolate "
                 "the poison trial(s)."
@@ -689,6 +739,7 @@ class ResilientBatchExecutor:
                 self._notify(frozen)
             else:
                 poisoned.append(trial.number)
+                telemetry.count("executor.quarantine")
                 # Notification rides _fail_trials so its reap-race guard
                 # also suppresses callbacks for a trial another worker
                 # already finished.

@@ -16,6 +16,7 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
+from optuna_tpu import _tracing, telemetry
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.logging import get_logger
 from optuna_tpu.samplers._base import (
@@ -33,6 +34,13 @@ if TYPE_CHECKING:
     from optuna_tpu.study.study import Study
 
 _logger = get_logger(__name__)
+
+# The ask-phase split (telemetry.PHASES): search-space build vs surrogate
+# fit vs proposal dispatch — resolved once so the hot path builds no strings.
+# The same names annotate the jax.profiler timeline when a trace is active.
+_TRACE_SPACE = telemetry.trace_name("ask.search_space")
+_TRACE_FIT = telemetry.trace_name("ask.fit")
+_TRACE_PROPOSE = telemetry.trace_name("ask.propose")
 
 _N_FANTASIES = 128
 _STABILIZING_NOISE = 1e-10
@@ -204,12 +212,15 @@ class GPSampler(BaseSampler):
     def infer_relative_search_space(
         self, study: "Study", trial: FrozenTrial
     ) -> dict[str, BaseDistribution]:
-        search_space = {}
-        for name, distribution in self._intersection_search_space.calculate(study).items():
-            if distribution.single():
-                continue
-            search_space[name] = distribution
-        return search_space
+        with _tracing.annotate(_TRACE_SPACE), telemetry.span("ask.search_space"):
+            search_space = {}
+            for name, distribution in self._intersection_search_space.calculate(
+                study
+            ).items():
+                if distribution.single():
+                    continue
+                search_space[name] = distribution
+            return search_space
 
     # --------------------------------------------------------------- sampling
 
@@ -299,15 +310,16 @@ class GPSampler(BaseSampler):
             score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
             y, _, _ = _standardize(score)
             Xc, yc, counts = collapse_duplicate_rows(X, y)
-            state, raw_params = fit_gp(
-                Xc,
-                yc.astype(np.float32),
-                is_cat,
-                warm_start_raw=warm[0] if warm else None,
-                seed=seed,
-                minimum_noise=1e-7 if self._deterministic else 1e-5,
-                counts=counts,
-            )
+            with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+                state, raw_params = fit_gp(
+                    Xc,
+                    yc.astype(np.float32),
+                    is_cat,
+                    warm_start_raw=warm[0] if warm else None,
+                    seed=seed,
+                    minimum_noise=1e-7 if self._deterministic else 1e-5,
+                    counts=counts,
+                )
             self._kernel_params_cache[sig] = [raw_params]
             best = float(np.max(yc))
 
@@ -331,15 +343,16 @@ class GPSampler(BaseSampler):
             )
 
         extra = X[-min(len(X), 4):]  # warm-start local search at recent incumbents
-        x_best, _ = optimize_acqf_mixed(
-            acqf_name,
-            data,
-            space,
-            rng,
-            extra_candidates=extra,
-            n_preliminary=self._n_preliminary_samples,
-            n_local_search=self._n_local_search,
-        )
+        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"):
+            x_best, _ = optimize_acqf_mixed(
+                acqf_name,
+                data,
+                space,
+                rng,
+                extra_candidates=extra,
+                n_preliminary=self._n_preliminary_samples,
+                n_local_search=self._n_local_search,
+            )
         return space.unnormalize_one(x_best)
 
     # --------------------------------------------------------- fused dispatch
@@ -489,9 +502,14 @@ class GPSampler(BaseSampler):
         from optuna_tpu.gp.optim_mixed import snap_steps
 
         dev = self._device_space(sig, space)
-        starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
-            study, space, X, trials, warm
-        )
+        # Phase split in the fused path: "ask.fit" is the host-side fit-input
+        # packing (history collapse, starts, padding); the single device
+        # program that fits AND proposes lands in "ask.propose" — the XLA
+        # dispatch is indivisible by design, so the split is host/device.
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+            starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
+                study, space, X, trials, warm
+            )
         minimum_noise = 1e-7 if self._deterministic else 1e-5
         args = (
             starts, Xp, yp, dev.cat_mask, maskp, dev.sobol_base, inc,
@@ -499,17 +517,20 @@ class GPSampler(BaseSampler):
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
         )
-        out = self._aot_call(
-            self._exec_key(dev, X.shape[1], Xp.shape[0], 0, starts.shape[0], fit_iters),
-            args,
-        )
-        if out is None:
-            out = gp_suggest_fused(
-                *args,
-                n_local_search=self._n_local_search,
-                fit_iters=fit_iters,
-                has_sweep=dev.has_sweep,
+        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"):
+            out = self._aot_call(
+                self._exec_key(
+                    dev, X.shape[1], Xp.shape[0], 0, starts.shape[0], fit_iters
+                ),
+                args,
             )
+            if out is None:
+                out = gp_suggest_fused(
+                    *args,
+                    n_local_search=self._n_local_search,
+                    fit_iters=fit_iters,
+                    has_sweep=dev.has_sweep,
+                )
         x_best, _, raw = out
         self._kernel_params_cache[sig] = [np.asarray(raw)]
         self._precompile_after_dispatch(
@@ -530,9 +551,10 @@ class GPSampler(BaseSampler):
         from optuna_tpu.gp.optim_mixed import snap_steps
 
         dev = self._device_space(sig, space)
-        starts, Xp, yp, maskp, inc, n, fit_iters = self._fused_inputs(
-            study, space, X, trials, warm, pad_extra=q
-        )
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+            starts, Xp, yp, maskp, inc, n, fit_iters = self._fused_inputs(
+                study, space, X, trials, warm, pad_extra=q
+            )
         minimum_noise = 1e-7 if self._deterministic else 1e-5
         args = (
             starts, Xp, yp, dev.cat_mask, maskp, jnp.asarray(n, jnp.int32),
@@ -540,18 +562,21 @@ class GPSampler(BaseSampler):
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
         )
-        out = self._aot_call(
-            self._exec_key(dev, X.shape[1], Xp.shape[0], q, starts.shape[0], fit_iters),
-            args,
-        )
-        if out is None:
-            out = gp_suggest_chain_fused(
-                *args,
-                q=q,
-                n_local_search=min(self._n_local_search, 6),
-                fit_iters=fit_iters,
-                has_sweep=dev.has_sweep,
+        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"):
+            out = self._aot_call(
+                self._exec_key(
+                    dev, X.shape[1], Xp.shape[0], q, starts.shape[0], fit_iters
+                ),
+                args,
             )
+            if out is None:
+                out = gp_suggest_chain_fused(
+                    *args,
+                    q=q,
+                    n_local_search=min(self._n_local_search, 6),
+                    fit_iters=fit_iters,
+                    has_sweep=dev.has_sweep,
+                )
         xs, _, raw = out
         self._kernel_params_cache[sig] = [np.asarray(raw)]
         self._precompile_after_dispatch(
@@ -678,18 +703,19 @@ class GPSampler(BaseSampler):
         states = []
         raws = []
         std_vals = np.empty_like(loss_vals, dtype=np.float32)
-        for k in range(M):
-            yk, _, _ = _standardize(loss_vals[:, k])
-            std_vals[:, k] = yk
-            st, raw = fit_gp(
-                X,
-                yk.astype(np.float32),
-                is_cat,
-                warm_start_raw=warm[k] if warm and len(warm) > k else None,
-                seed=seed + k,
-            )
-            states.append(st)
-            raws.append(raw)
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+            for k in range(M):
+                yk, _, _ = _standardize(loss_vals[:, k])
+                std_vals[:, k] = yk
+                st, raw = fit_gp(
+                    X,
+                    yk.astype(np.float32),
+                    is_cat,
+                    warm_start_raw=warm[k] if warm and len(warm) > k else None,
+                    seed=seed + k,
+                )
+                states.append(st)
+                raws.append(raw)
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
         worst = np.max(std_vals, axis=0)
@@ -722,11 +748,12 @@ class GPSampler(BaseSampler):
         cons = np.asarray(constraint_rows, dtype=np.float64)  # (n, C)
         states = []
         thresholds = []
-        for k in range(cons.shape[1]):
-            yk, mu, sd = _standardize(cons[:, k])
-            st, _ = fit_gp(X, yk.astype(np.float32), is_cat, seed=seed + 101 + k)
-            states.append(st)
-            thresholds.append((0.0 - mu) / sd)
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+            for k in range(cons.shape[1]):
+                yk, mu, sd = _standardize(cons[:, k])
+                st, _ = fit_gp(X, yk.astype(np.float32), is_cat, seed=seed + 101 + k)
+                states.append(st)
+                thresholds.append((0.0 - mu) / sd)
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
         return f"constrained_{acqf_name}", ConstrainedData(
             base=data,

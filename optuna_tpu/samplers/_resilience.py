@@ -41,14 +41,16 @@ NaN params and zero study aborts under every plan.
 
 from __future__ import annotations
 
+import itertools
 import math
 import time
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
+from optuna_tpu import telemetry
 from optuna_tpu.distributions import BaseDistribution, CategoricalDistribution
-from optuna_tpu.logging import get_logger
+from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.samplers._base import BaseSampler
 from optuna_tpu.trial._frozen import FrozenTrial
 from optuna_tpu.trial._state import TrialState
@@ -82,6 +84,11 @@ _F32_MAX = float(np.finfo(np.float32).max)
 _LADDER_INITIAL_JITTER = 1e-6
 _LADDER_GROWTH = 100.0
 _LADDER_MAX_RUNGS = 4
+
+#: Monotonic per-wrapper tokens for the warn-once keys: ``id(self)`` would
+#: recycle after GC, letting a dead wrapper's suppression silence a new
+#: wrapper's one-and-only warning in the process-global registry.
+_guard_instance_seq = itertools.count()
 
 
 # ------------------------------------------------------- ring 1: in-graph
@@ -241,7 +248,7 @@ class GuardedSampler(BaseSampler):
         self._fallback = fallback
         self._fit_deadline_s = fit_deadline_s
         self._clock = clock
-        self._warned_studies: set[int] = set()
+        self._warn_token = next(_guard_instance_seq)
         self._fallback_random: BaseSampler | None = None
         #: Why the most recent ``sample_relative_batch`` call *failed* (None
         #: when it succeeded or merely declined). The batch executor reads
@@ -296,9 +303,14 @@ class GuardedSampler(BaseSampler):
         phase: str,
         err: BaseException,
     ) -> None:
-        """Record the fallback, warn once per study, honor the policy."""
+        """Record the fallback (attr + telemetry counter), warn once per
+        study (:func:`~optuna_tpu.logging.warn_once`), honor the policy."""
         reason = f"{type(err).__name__}: {err}"[:500]
         key = SAMPLER_FALLBACK_ATTR_PREFIX + phase
+        # Count every containment event (family-bucketed: the per-param
+        # ``independent:<name>`` phases collapse to ``independent`` so the
+        # counter cardinality stays bounded by the hook vocabulary).
+        telemetry.count("sampler.fallback." + phase.split(":", 1)[0])
         try:
             if trial is not None:
                 study._storage.set_trial_system_attr(trial._trial_id, key, reason)
@@ -311,14 +323,15 @@ class GuardedSampler(BaseSampler):
             )
         if self._fallback == "raise":
             raise err
-        if study._study_id not in self._warned_studies:
-            self._warned_studies.add(study._study_id)
-            _logger.warning(
-                f"{type(self._sampler).__name__} failed during {phase} "
-                f"({reason}); falling back to independent sampling. Further "
-                "fallbacks in this study are recorded in "
-                f"'{SAMPLER_FALLBACK_ATTR_PREFIX}*' system attrs without a log line."
-            )
+        warn_once(
+            _logger,
+            f"guarded_sampler:{self._warn_token}:{study._study_id}",
+            f"{type(self._sampler).__name__} failed during {phase} "
+            f"({reason}); falling back to independent sampling. Further "
+            "fallbacks in this study are recorded in "
+            f"'{SAMPLER_FALLBACK_ATTR_PREFIX}*' system attrs (and the "
+            "sampler.fallback telemetry counter) without a log line.",
+        )
 
     # ----------------------------------------------------------------- hooks
 

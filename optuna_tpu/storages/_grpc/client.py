@@ -9,6 +9,7 @@ from __future__ import annotations
 import uuid
 from typing import Any, Callable, Container, Sequence
 
+from optuna_tpu import telemetry
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
@@ -88,6 +89,7 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
         """Drop the (possibly wedged) channel and dial a fresh one — a
         restarted server presents a new connection the old channel's HTTP/2
         session does not always recover on its own."""
+        telemetry.count("grpc.redial")
         old, self._channel = self._channel, None
         if old is not None:
             try:
@@ -137,14 +139,16 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
                 grpc.StatusCode.DEADLINE_EXCEEDED,
             )
 
-        ok, payload = decode_response(
-            self._retry_policy.call(
+        # One logical RPC = one storage.op span (transport retries, re-dials
+        # and backoff included): the latency the study loop actually waits.
+        with telemetry.span("storage.op"):
+            raw = self._retry_policy.call(
                 once,
                 describe=f"gRPC {method} to {self._host}:{self._port}",
                 is_retryable=transient,
                 on_retry=lambda err, attempt, delay: self._reconnect(),
             )
-        )
+        ok, payload = decode_response(raw)
         if not ok:
             raise payload
         return payload

@@ -12,6 +12,7 @@ from collections import OrderedDict
 from concurrent import futures
 from typing import TYPE_CHECKING
 
+from optuna_tpu import telemetry
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
@@ -75,6 +76,7 @@ def _make_handler(storage: BaseStorage):
                             # We own this token's execution.
                             token_in_flight[op_token] = threading.Event()
                 if replay is not None:
+                    telemetry.count("grpc.op_token_dedup")
                     _logger.info(
                         f"Replaying recorded response for retried {method_name} "
                         f"(op token {op_token[:8]}...)."
@@ -140,6 +142,7 @@ def run_grpc_proxy_server(
     port: int = 13000,
     thread_pool_size: int = 10,
     drain_grace: float | None = 15.0,
+    metrics_port: int | None = None,
 ) -> None:
     """Blocking server entry point (reference ``server.py:38``).
 
@@ -148,10 +151,21 @@ def run_grpc_proxy_server(
     (then are cancelled), and only afterwards does the process return —
     clients see clean completions or UNAVAILABLE-on-connect, which their
     retry policy absorbs, never a half-written response.
+
+    ``metrics_port`` additionally serves the process's telemetry registry
+    over HTTP (``/metrics`` Prometheus text, ``/metrics.json`` snapshot —
+    :func:`optuna_tpu.telemetry.serve_metrics`) and turns recording on: the
+    storage hub is where op-token dedup hits and server-side storage
+    latencies live, and a fleet scraper watches it without touching workers.
     """
     import signal
 
     server = make_grpc_server(storage, host, port, thread_pool_size)
+    metrics_server = None
+    if metrics_port is not None:
+        telemetry.enable()
+        metrics_server = telemetry.serve_metrics(metrics_port, host=host)
+        _logger.info(f"Telemetry endpoint at http://{host}:{metrics_port}/metrics")
     server.start()
     _logger.info(f"Server started at {host}:{port}")
     _logger.info("Listening...")
@@ -169,6 +183,8 @@ def run_grpc_proxy_server(
         except ValueError:
             pass  # not the main thread; caller owns signal handling
     server.wait_for_termination()
+    if metrics_server is not None:
+        metrics_server.shutdown()
     try:
         storage.remove_session()
     except Exception:  # graphlint: ignore[PY001] -- shutdown teardown: a failing session release must not mask a clean drain

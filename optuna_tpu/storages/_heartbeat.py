@@ -16,7 +16,7 @@ from contextlib import contextmanager
 from types import TracebackType
 from typing import TYPE_CHECKING, Callable, Iterator, Sequence
 
-from optuna_tpu import logging as logging_module
+from optuna_tpu import logging as logging_module, telemetry
 from optuna_tpu.exceptions import UpdateFinishedTrialError
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -275,4 +275,9 @@ def fail_stale_trials(study: "Study") -> None:
         return
     if not is_heartbeat_enabled(storage):
         return
-    fail_and_notify_trials(study, storage._get_stale_trial_ids(study._study_id))
+    reaped = fail_and_notify_trials(study, storage._get_stale_trial_ids(study._study_id))
+    if reaped:
+        # Counted here (not in fail_and_notify_trials): only this path is a
+        # dead-worker *reap* — ask_batch's unwinding cleanup shares the
+        # helper but is its own failure story.
+        telemetry.count("heartbeat.reap", len(reaped))

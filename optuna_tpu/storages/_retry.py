@@ -29,6 +29,7 @@ import random
 import time
 from typing import Any, Callable, Sequence
 
+from optuna_tpu import telemetry
 from optuna_tpu.exceptions import StorageInternalError
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage, _ForwardingStorage
@@ -167,6 +168,7 @@ class RetryPolicy:
                     and self._clock() - start + delay > self.deadline
                 ):
                     raise
+                telemetry.count("storage.retry")
                 _logger.warning(
                     f"{describe} failed transiently ({err!r}); "
                     f"retry {attempt}/{self.max_attempts - 1} in {delay:.3f}s."
@@ -221,9 +223,15 @@ class RetryingStorage(_ForwardingStorage):
         self._retry_non_idempotent = retry_non_idempotent
 
     def _forward(self, method: str, *args: Any, **kwargs: Any) -> Any:
-        if method in REPLAY_UNSAFE_METHODS and not self._retry_non_idempotent:
-            return super()._forward(method, *args, **kwargs)
-        return self._policy.call(
-            lambda: _ForwardingStorage._forward(self, method, *args, **kwargs),
-            describe=f"{type(self._backend).__name__}.{method}",
-        )
+        # One logical storage op = one span, retries and backoff included —
+        # the latency the *study loop* experiences, not the backend's. The
+        # span covers the replay-unsafe pass-through too: trial creates and
+        # the tell-path state commit are exactly the write latencies a
+        # phase-regression hunt needs visible.
+        with telemetry.span("storage.op"):
+            if method in REPLAY_UNSAFE_METHODS and not self._retry_non_idempotent:
+                return super()._forward(method, *args, **kwargs)
+            return self._policy.call(
+                lambda: _ForwardingStorage._forward(self, method, *args, **kwargs),
+                describe=f"{type(self._backend).__name__}.{method}",
+            )

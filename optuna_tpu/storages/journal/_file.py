@@ -19,6 +19,7 @@ import uuid
 import zlib
 from typing import Any
 
+from optuna_tpu import telemetry
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages.journal._base import BaseJournalBackend
 
@@ -136,10 +137,18 @@ class BaseJournalFileLock(abc.ABC):
         schedule = RetryPolicy(initial_backoff=0.002, max_backoff=0.05, multiplier=1.5)
         attempt = 0
         start = time.time()
+        contended = False
         while True:
             if try_lock():
                 self._owns = True
                 return True
+            if not contended:
+                # Counted once per contended acquire (not per poll): the
+                # metric tracks how often workers collide on the journal
+                # lock, not how long each collision lasted — the span-level
+                # storage.op latency already carries the waiting time.
+                contended = True
+                telemetry.count("journal.lock_contention")
             # The timeout gates EVERY path, including repeated takeover
             # attempts — a steal that keeps failing (filesystem flipped
             # read-only under a stale lock) must raise, not spin.

@@ -21,7 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
-from optuna_tpu import exceptions, logging as logging_module
+from optuna_tpu import exceptions, logging as logging_module, telemetry
 from optuna_tpu.progress_bar import _ProgressBar
 from optuna_tpu.study._tell import _tell_with_warning
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -32,6 +32,13 @@ if TYPE_CHECKING:
     from optuna_tpu.study.study import ObjectiveFuncType, Study
 
 _logger = logging_module.get_logger(__name__)
+
+# One vocabulary, two spellings: the profiler annotation names are derived
+# from the telemetry phase names at module scope, so the per-trial hot path
+# never builds a phase string.
+_TRACE_ASK = telemetry.trace_name("ask")
+_TRACE_DISPATCH = telemetry.trace_name("dispatch")
+_TRACE_TELL = telemetry.trace_name("tell")
 
 
 class _RunBudget:
@@ -136,22 +143,24 @@ def _execute_one(
     if is_heartbeat_enabled(study._storage):
         fail_stale_trials(study)
 
-    with _tracing.annotate("optuna_tpu.ask"):
+    with _tracing.annotate(_TRACE_ASK), telemetry.span("ask"):
         trial = study.ask()
     with get_heartbeat_thread(trial._trial_id, study._storage):
         with _tracing.annotate(f"optuna_tpu.trial.{trial.number}"):
-            outcome = _call_objective(func, trial)
+            with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"):
+                outcome = _call_objective(func, trial)
 
     # Misbehaving objectives (wrong arity, NaNs, non-floats) downgrade to
     # warnings via _tell_with_warning rather than aborting the whole loop.
     try:
-        frozen = _tell_with_warning(
-            study=study,
-            trial=trial,
-            value_or_values=outcome.values,
-            state=outcome.state,
-            suppress_warning=True,
-        )
+        with _tracing.annotate(_TRACE_TELL), telemetry.span("tell"):
+            frozen = _tell_with_warning(
+                study=study,
+                trial=trial,
+                value_or_values=outcome.values,
+                state=outcome.state,
+                suppress_warning=True,
+            )
     except Exception:  # graphlint: ignore[PY001] -- announce-then-reraise: nothing is swallowed, the trial's terminal state is logged on every failure flavor
         _announce(study, study._storage.get_trial(trial._trial_id), outcome)
         raise

@@ -361,6 +361,19 @@ class Study:
 
         return _trials_dataframe(self, attrs, multi_index)
 
+    def telemetry_snapshot(self) -> dict[str, Any]:
+        """The process-wide telemetry snapshot (see :mod:`optuna_tpu.telemetry`):
+        study-loop phase histograms plus every containment counter the
+        resilience layers fired (retries, fallbacks, quarantines, reaps).
+        Enable recording with ``OPTUNA_TPU_TELEMETRY=1`` or
+        ``telemetry.enable()`` — with telemetry disabled the snapshot is
+        empty, not an error. Process-wide by design: workers are
+        single-study processes in the distributed layout, and the registry
+        deliberately has no per-study sharding on the hot path."""
+        from optuna_tpu import telemetry
+
+        return telemetry.snapshot()
+
     def stop(self) -> None:
         """Request loop exit after the current trial (reference ``study.py:1033``)."""
         if not self._thread_local.in_optimize_loop:

@@ -1,0 +1,426 @@
+"""Telemetry spine: host-side metrics for the study loop and its containment.
+
+The robustness layers (retries, fallbacks, quarantines, bisections, reaps)
+fire invisibly — a warning line each, at best — and the end-to-end bench has
+no way to say *which phase* of the ask → dispatch → tell cycle paid for a
+regression. Asynchronous many-worker HPO (the architecture of Dorier et al.,
+arXiv:2210.00798) is undrivable without per-phase latency and degradation
+counters; the reference Optuna ships only logging and a progress bar (Akiba
+et al., arXiv:1907.10902). This module is the dependency-free (stdlib-only)
+metrics registry every layer reports into:
+
+* :class:`MetricsRegistry` — counters, gauges, and monotonic-clock
+  histograms with fixed log-spaced buckets; the clock is injectable like
+  :class:`~optuna_tpu.storages._retry.RetryPolicy`'s so tests assert
+  timings without real waiting.
+* ``span(name)`` — a context manager timing one phase of the study loop
+  into the ``phase.<name>`` histogram. Phase names come from the
+  :data:`PHASES` vocabulary, shared with the ``jax.profiler`` annotations
+  in :mod:`optuna_tpu._tracing` (via :func:`trace_name`) so profiler
+  timelines and metrics histograms line up one-to-one.
+* ``count(name)`` — containment counters (:data:`COUNTERS` vocabulary):
+  every event the resilience layers used to only log.
+* Exports — :func:`snapshot` (JSON-able dict, also
+  ``Study.telemetry_snapshot()``), :func:`render_prometheus` (text
+  exposition format, served by :func:`serve_metrics` / the gRPC proxy
+  server's ``metrics_port``), and the ``optuna-tpu metrics`` CLI dump.
+
+Overhead contract (mirrors ``_tracing.annotate``): telemetry is **off** by
+default, and the disabled hot path is one module-global check — ``count``
+returns immediately and ``span`` returns a shared singleton null context, so
+a disabled study loop allocates nothing per trial on this module's account
+(asserted by ``tests/test_telemetry.py``). Instrumentation lives strictly
+host-side: graphlint rule **OBS001** forbids telemetry/logging calls inside
+jit-decorated functions or ``lax`` loop bodies of device modules, so
+instrumentation can never add a host sync to a device graph.
+
+Enable with ``OPTUNA_TPU_TELEMETRY=1`` in the environment, or
+:func:`enable` / :func:`disable` at runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterator, Mapping
+
+__all__ = [
+    "COUNTERS",
+    "PHASES",
+    "MetricsRegistry",
+    "count",
+    "disable",
+    "enable",
+    "enabled",
+    "get_registry",
+    "observe",
+    "observe_phase",
+    "render_prometheus",
+    "reset",
+    "serve_metrics",
+    "set_gauge",
+    "snapshot",
+    "span",
+    "trace_name",
+]
+
+
+# ------------------------------------------------------------- vocabulary
+
+#: The study-loop phase vocabulary: every ``span()`` name and every
+#: ``_tracing.annotate`` phase annotation draws from this one dict, so the
+#: profiler timeline and the metrics histograms use identical names
+#: (``optuna_tpu.<phase>`` on the timeline, ``phase.<phase>`` in metrics).
+#: Canonical mirror: ``_lint/registry.py::TELEMETRY_PHASE_REGISTRY`` —
+#: ``tests/test_telemetry.py`` fails if the two drift.
+PHASES: dict[str, str] = {
+    "ask": "trial creation + parameter suggestion (Study.ask / ask_batch)",
+    "ask.search_space": "relative search-space construction inside the sampler",
+    "ask.fit": "surrogate fit inputs + fitting (host packing, GP/TPE fit)",
+    "ask.propose": "acquisition optimization / fused proposal dispatch",
+    "dispatch": "objective execution (serial call or batched device dispatch)",
+    "tell": "result commit + callbacks (study.tell / batch tell loop)",
+    "storage.op": "one logical storage operation (retries + backoff included)",
+}
+
+#: The containment-counter vocabulary: one entry per event family the
+#: resilience layers can fire. Families marked ``(suffixed)`` append a
+#: sub-family at the call site (e.g. ``sampler.fallback.relative``).
+#: Canonical mirror: ``_lint/registry.py::TELEMETRY_COUNTER_REGISTRY`` —
+#: ``tests/test_telemetry.py`` fails if the two drift.
+COUNTERS: dict[str, str] = {
+    "storage.retry": "RetryPolicy replayed a transiently-failed call",
+    "grpc.redial": "gRPC client dropped a wedged channel and dialed fresh",
+    "grpc.op_token_dedup": "gRPC server deduped a replayed replay-unsafe write",
+    "sampler.fallback": "(suffixed by phase) a suggestion degraded to the independent path",
+    "executor.quarantine": "a non-finite trial was quarantined as FAIL",
+    "executor.bisection": "a failed dispatch was bisected to isolate poison trials",
+    "executor.oom_halving": "an OOM-shaped dispatch error halved the batch",
+    "executor.dispatch_timeout": "a device dispatch overran its deadline and was abandoned",
+    "heartbeat.reap": "a stale (dead-worker) RUNNING trial was reaped to FAIL",
+    "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
+}
+
+_PHASE_METRIC_PREFIX = "phase."
+_TRACE_PREFIX = "optuna_tpu."
+
+
+def trace_name(phase: str) -> str:
+    """The ``jax.profiler`` annotation name for a :data:`PHASES` entry —
+    the one vocabulary, two spellings (``optuna_tpu.ask`` on the profiler
+    timeline, ``phase.ask`` in the metrics registry)."""
+    return _TRACE_PREFIX + phase
+
+
+# ------------------------------------------------------------ histograms
+
+#: Fixed log-spaced latency buckets (seconds): half-decade steps from 100 µs
+#: to ~100 s, the span between one in-process dict write and a hung-dispatch
+#: deadline. Fixed (not configurable per histogram) so every phase histogram
+#: is cross-comparable and the Prometheus series set stays bounded.
+BUCKET_BOUNDS: tuple[float, ...] = tuple(10.0 ** (k / 2.0) for k in range(-8, 5))
+
+
+class _Histogram:
+    __slots__ = ("count", "total", "bucket_counts")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.bucket_counts = [0] * (len(BUCKET_BOUNDS) + 1)  # +inf tail
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        for i, bound in enumerate(BUCKET_BOUNDS):
+            if value <= bound:
+                self.bucket_counts[i] += 1
+                return
+        self.bucket_counts[-1] += 1
+
+
+class _Span:
+    """Times one ``with`` block into the registry's phase histogram."""
+
+    __slots__ = ("_registry", "_name", "_start")
+
+    def __init__(self, registry: "MetricsRegistry", name: str) -> None:
+        self._registry = registry
+        self._name = name
+
+    def __enter__(self) -> "_Span":
+        self._start = self._registry._clock()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self._registry.observe(self._name, self._registry._clock() - self._start)
+
+
+class _NullSpan:
+    """The disabled-path span: one shared instance, allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+# -------------------------------------------------------------- registry
+
+
+class MetricsRegistry:
+    """Thread-safe counters + gauges + fixed-bucket latency histograms.
+
+    Stdlib-only by design (the telemetry spine must import before — and
+    independently of — jax). ``clock`` is injectable for deterministic span
+    tests; it must be monotonic (wall clocks jump under NTP).
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+        self._histograms: dict[str, _Histogram] = {}
+
+    # ------------------------------------------------------------- write
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = self._histograms[name] = _Histogram()
+            hist.observe(value)
+
+    def span(self, name: str) -> _Span:
+        """Time a ``with`` block into the ``phase.<name>`` histogram."""
+        return _Span(self, _PHASE_METRIC_PREFIX + name)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    # -------------------------------------------------------------- read
+
+    def counter_value(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        """One JSON-able dict of everything recorded so far. Bucket keys are
+        the stringified upper bounds (``"+Inf"`` for the tail), with raw
+        (non-cumulative) per-bucket counts."""
+        with self._lock:
+            histograms = {}
+            for name, hist in self._histograms.items():
+                buckets = {
+                    _format_bound(bound): hist.bucket_counts[i]
+                    for i, bound in enumerate(BUCKET_BOUNDS)
+                }
+                buckets["+Inf"] = hist.bucket_counts[-1]
+                histograms[name] = {
+                    "count": hist.count,
+                    "sum": hist.total,
+                    "buckets": buckets,
+                }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": histograms,
+            }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (v0.0.4): metric names are
+        sanitized (dots -> underscores) under the ``optuna_tpu_`` namespace;
+        histogram buckets are cumulative with the conventional ``le`` label."""
+        lines: list[str] = []
+        snap = self.snapshot()
+        for name, value in sorted(snap["counters"].items()):
+            metric = _prom_name(name) + "_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {value}")
+        for name, value in sorted(snap["gauges"].items()):
+            metric = _prom_name(name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {_format_value(value)}")
+        for name, hist in sorted(snap["histograms"].items()):
+            metric = _prom_name(name) + "_seconds"
+            lines.append(f"# TYPE {metric} histogram")
+            cumulative = 0
+            for bound_label, bucket_count in hist["buckets"].items():
+                cumulative += bucket_count
+                lines.append(f'{metric}_bucket{{le="{bound_label}"}} {cumulative}')
+            lines.append(f"{metric}_sum {_format_value(hist['sum'])}")
+            lines.append(f"{metric}_count {hist['count']}")
+        return "\n".join(lines) + "\n"
+
+
+def _format_bound(bound: float) -> str:
+    return f"{bound:.6g}"
+
+
+def _format_value(value: float) -> str:
+    return f"{value:.9g}"
+
+
+def _prom_name(name: str) -> str:
+    cleaned = "".join(c if c.isalnum() else "_" for c in name)
+    return "optuna_tpu_" + cleaned
+
+
+# ------------------------------------------------- module-level fast path
+
+_REGISTRY = MetricsRegistry()
+_enabled = bool(os.environ.get("OPTUNA_TPU_TELEMETRY"))
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(registry: MetricsRegistry | None = None) -> None:
+    """Turn recording on (optionally swapping in a fresh registry — tests
+    and the bench use an isolated one so counts can't bleed across runs)."""
+    global _enabled, _REGISTRY
+    if registry is not None:
+        _REGISTRY = registry
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def count(name: str, n: int = 1) -> None:
+    """Increment a containment counter; a no-op (one global check, zero
+    allocations) while telemetry is disabled. ``name`` is a
+    :data:`COUNTERS` family, optionally suffixed (``sampler.fallback.relative``)."""
+    if not _enabled:
+        return
+    _REGISTRY.inc(name, n)
+
+
+def observe(name: str, value: float) -> None:
+    """Record one value into a histogram; no-op while disabled."""
+    if not _enabled:
+        return
+    _REGISTRY.observe(name, value)
+
+
+def observe_phase(name: str, seconds: float) -> None:
+    """Record one already-measured duration into the ``phase.<name>``
+    histogram — for call sites that must stitch one *logical* phase across
+    non-contiguous code blocks (the batch executor's ask spans the batch
+    creation AND the in-heartbeat suggestion loop), where two ``span()``
+    blocks would double the phase's count and halve its per-op latency."""
+    if not _enabled:
+        return
+    _REGISTRY.observe(_PHASE_METRIC_PREFIX + name, seconds)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if not _enabled:
+        return
+    _REGISTRY.set_gauge(name, value)
+
+
+def span(name: str):
+    """Time a ``with`` block into the ``phase.<name>`` histogram. Returns a
+    shared do-nothing singleton while disabled — the hot path pays one
+    global check and allocates nothing."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _REGISTRY.span(name)
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    return _REGISTRY.render_prometheus()
+
+
+def reset() -> None:
+    _REGISTRY.reset()
+
+
+# --------------------------------------------------------------- exports
+
+
+def phase_totals(snap: Mapping | None = None) -> dict[str, dict[str, float]]:
+    """Condense a snapshot's phase histograms to ``{phase: {total_s, count}}``
+    — the per-phase breakdown ``bench.py`` embeds in its JSON line."""
+    snap = snapshot() if snap is None else snap
+    out: dict[str, dict[str, float]] = {}
+    for name, hist in snap.get("histograms", {}).items():
+        if not name.startswith(_PHASE_METRIC_PREFIX) or not hist["count"]:
+            continue
+        phase = name[len(_PHASE_METRIC_PREFIX):]
+        out[phase] = {"total_s": round(hist["sum"], 4), "count": hist["count"]}
+    return out
+
+
+def serve_metrics(port: int, host: str = "localhost"):
+    """Serve the registry over HTTP on a daemon thread and return the server
+    (call ``.shutdown()`` to stop it). Endpoints: ``/metrics`` (Prometheus
+    text) and ``/metrics.json`` (the :func:`snapshot` dict). Stdlib-only;
+    used by the gRPC proxy server's ``metrics_port=`` knob so a fleet
+    scraper can watch the storage hub without extra dependencies."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API name)
+            if self.path.split("?")[0] in ("/metrics", "/"):
+                body = render_prometheus().encode()
+                content_type = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.split("?")[0] == "/metrics.json":
+                body = json.dumps(snapshot()).encode()
+                content_type = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args: object) -> None:
+            return  # scrapes are high-frequency; stay out of the study's logs
+
+    server = ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="optuna-tpu-metrics", daemon=True
+    )
+    thread.start()
+    return server
+
+
+def iter_counter_families() -> Iterator[str]:
+    """The counter families (prefix-matched) — export helpers and the chaos
+    suite iterate these so a new family cannot be silently untested."""
+    return iter(COUNTERS)

@@ -1,0 +1,31 @@
+# graphlint fixture: OBS001 negatives — none of these may fire.
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu import telemetry
+from optuna_tpu.logging import get_logger, warn_once
+
+_logger = get_logger(__name__)
+
+
+@jax.jit
+def clean_kernel(x):
+    # Traced scope with no observability taps: nothing to flag.
+    return jnp.where(jnp.isfinite(x), x, 0.0)
+
+
+def host_dispatch(x):
+    # Instrumentation AROUND the dispatch is the sanctioned pattern.
+    telemetry.count("executor.quarantine")
+    with telemetry.span("dispatch"):
+        result = clean_kernel(x)
+    _logger.warning("host-side logging is fine")
+    warn_once(_logger, "key", "host-side warn_once is fine")
+    return result
+
+
+def host_loop(x):
+    # A plain Python loop is not a traced scope.
+    for _ in range(3):
+        telemetry.count("storage.retry")
+    return x

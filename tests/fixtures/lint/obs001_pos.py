@@ -1,0 +1,32 @@
+# graphlint fixture: OBS001 positives (parsed, never executed).
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu import telemetry
+from optuna_tpu.logging import get_logger, warn_once
+
+_logger = get_logger(__name__)
+
+
+@jax.jit
+def bad_counter_in_jit(x):
+    telemetry.count("executor.quarantine")  # EXPECT: OBS001
+    with telemetry.span("dispatch"):  # EXPECT: OBS001
+        y = x * 2
+    return y
+
+
+@jax.jit
+def bad_logging_in_jit(x):
+    _logger.warning("this runs at trace time, once per compile")  # EXPECT: OBS001
+    warn_once(_logger, "key", "also a trace-time tap")  # EXPECT: OBS001
+    return x + 1
+
+
+def host_wrapper(x):
+    # The loop body is traced even though host_wrapper is not jitted.
+    def body(carry):
+        telemetry.count("executor.bisection")  # EXPECT: OBS001
+        return carry - 1
+
+    return jax.lax.while_loop(lambda c: c > 0, body, x)

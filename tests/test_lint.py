@@ -174,6 +174,24 @@ def test_smp002_gate_fires_on_a_bare_cholesky_in_samplers():
     assert all("_resilience.py" in f.path for f in smp002_suppressed)
 
 
+def test_obs001_device_tree_is_clean():
+    """Live drift gate (the SMP002 pattern): scan the real device modules —
+    which now DO carry telemetry instrumentation (executor quarantine
+    counters, resilience fallback counters) — with only OBS001 enabled.
+    Zero findings proves every tap sits host-side, outside the traced
+    scopes; someone moving one into a jit body or lax loop later turns this
+    red."""
+    import dataclasses
+
+    result = run_lint(
+        [PKG],
+        dataclasses.replace(load_config(PYPROJECT), enable=("OBS001",)),
+    )
+    assert not result.findings, [f.format() for f in result.findings]
+    # The scan saw the instrumented device modules (not an empty walk).
+    assert result.files_scanned > 100
+
+
 def test_pyproject_device_paths_mirror_registry():
     """[tool.graphlint] device-paths (the operator-visible classification)
     must stay identical to the canonical DEVICE_MODULE_PATHS — the executor
@@ -193,6 +211,7 @@ def _device_config(name: str, **kwargs) -> Config:
 
 RULE_CASES = [
     ("tpu001", lambda name: _device_config(name)),
+    ("obs001", lambda name: _device_config(name)),
     ("tpu002", lambda name: Config(base_dir=REPO_ROOT)),
     (
         "tpu003",

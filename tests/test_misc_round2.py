@@ -38,6 +38,24 @@ def test_env_var_traces_optimize(tmp_path, monkeypatch):
     assert os.path.isdir(logdir)
 
 
+def test_env_var_traces_optimize_vectorized(tmp_path, monkeypatch):
+    """OPTUNA_TPU_TRACE covers the vectorized loop the same way it covers
+    Study.optimize (ISSUE 6 satellite): one env switch profiles either."""
+    import jax.numpy as jnp
+
+    from optuna_tpu.distributions import FloatDistribution
+    from optuna_tpu.parallel import VectorizedObjective, optimize_vectorized
+
+    logdir = str(tmp_path / "vecprof")
+    monkeypatch.setenv("OPTUNA_TPU_TRACE", logdir)
+    study = optuna_tpu.create_study()
+    obj = VectorizedObjective(
+        lambda p: jnp.square(p["x"]), {"x": FloatDistribution(0.0, 1.0)}
+    )
+    optimize_vectorized(study, obj, n_trials=4, batch_size=4)
+    assert os.path.isdir(logdir)
+
+
 def test_annotate_is_noop_without_trace():
     with _tracing.annotate("nothing"):
         pass  # must not require an active profiler
@@ -95,3 +113,32 @@ def test_cli_study_names(tmp_path):
     )
     names = {row["name"] for row in json.loads(out.stdout)}
     assert names == {"s-one", "s-two"}
+
+
+def test_cli_metrics_smoke(capsys):
+    """`optuna-tpu metrics --format=json` emits one well-formed snapshot
+    (ISSUE 6 satellite); one real subprocess proves the console path, the
+    prom flavor runs in-process (a second interpreter spawn buys nothing)."""
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu",
+               OPTUNA_TPU_TELEMETRY="1")
+    out = subprocess.run(
+        [sys.executable, "-m", "optuna_tpu.cli", "metrics", "--format=json"],
+        check=True, capture_output=True, text=True, env=env, timeout=120,
+    )
+    snap = json.loads(out.stdout)
+    assert set(snap) == {"counters", "gauges", "histograms"}
+
+    from optuna_tpu import cli, telemetry
+
+    saved, was_enabled = telemetry.get_registry(), telemetry.enabled()
+    saved_verbosity = optuna_tpu.logging.get_verbosity()  # cli.main lowers it
+    telemetry.enable(telemetry.MetricsRegistry())
+    try:
+        telemetry.count("storage.retry")
+        assert cli.main(["metrics", "--format=prom"]) == 0
+        assert "optuna_tpu_storage_retry_total 1" in capsys.readouterr().out
+    finally:
+        telemetry.enable(saved)
+        if not was_enabled:
+            telemetry.disable()
+        optuna_tpu.logging.set_verbosity(saved_verbosity)

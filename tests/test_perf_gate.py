@@ -1,0 +1,169 @@
+"""Committed perf gate (ISSUE 6 / ROADMAP item 5): trajectory appender unit
+tests (tier-1 fast) plus the ``slow``-marked live gate that runs the real
+bench, appends to a (copy of the) committed trajectory, and fails on a >10%
+ours-side trials/s regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED = os.path.join(REPO_ROOT, "BENCH_TRAJECTORY.json")
+
+sys.path.insert(0, REPO_ROOT)
+import bench_trajectory  # noqa: E402
+
+GP_METRIC = "gp_sampler_trials_per_sec_hartmann20d_n1000_end_to_end"
+
+
+def test_committed_trajectory_is_valid_and_carries_the_history():
+    trajectory = bench_trajectory.load_trajectory(COMMITTED)
+    rounds = {e["round"]: e for e in trajectory["entries"]}
+    assert rounds["r03"]["value"] == pytest.approx(10.911)
+    assert rounds["r04"]["value"] == pytest.approx(8.298)
+    # r05 is the tombstone: a partial with no value, excluded from gating.
+    assert rounds["r05"]["value"] is None and rounds["r05"]["partial"]
+    # r04 failed the gate, so it is flagged and excluded too — only r03
+    # gates, and the claw-back target stays 10.911 until recovered or the
+    # flag is removed under review.
+    assert rounds["r04"]["regressed"] is True
+    comparable = bench_trajectory.comparable_entries(
+        trajectory, GP_METRIC, "full", "tpu"
+    )
+    assert [e["round"] for e in comparable] == ["r03"]
+
+
+def test_gate_would_have_caught_the_r03_to_r04_regression():
+    """The motivating incident, replayed: gating r04's 8.298 against a
+    trajectory ending at r03's 10.911 is a 23.9% drop — past the 10%
+    tolerance, so the gate fails loudly."""
+    trajectory = bench_trajectory.load_trajectory(COMMITTED)
+    trajectory = {
+        **trajectory,
+        "entries": [e for e in trajectory["entries"] if e["round"] == "r03"],
+    }
+    verdict = bench_trajectory.check_regression(
+        trajectory, GP_METRIC, "full", "tpu", value=8.298
+    )
+    assert verdict is not None
+    assert "23.9%" in verdict and "10.911" in verdict
+
+
+def test_gate_passes_within_tolerance_and_without_baseline():
+    trajectory = bench_trajectory.load_trajectory(COMMITTED)
+    # The last comparable entry is r03 (r04 is flagged regressed): values
+    # within 10% of 10.911 pass, anything below the floor fails — a
+    # regressed round cannot launder itself into being the baseline.
+    assert (
+        bench_trajectory.check_regression(
+            trajectory, GP_METRIC, "full", "tpu", value=10.0
+        )
+        is None
+    )
+    assert (
+        bench_trajectory.check_regression(
+            trajectory, GP_METRIC, "full", "tpu", value=8.298
+        )
+        is not None
+    )
+    # Different mode/platform/metric: no comparable history, no verdict.
+    for key in (
+        (GP_METRIC, "quick", "tpu"),
+        (GP_METRIC, "full", "cpu"),
+        ("some_other_metric", "full", "tpu"),
+    ):
+        assert bench_trajectory.check_regression(trajectory, *key, value=0.001) is None
+
+
+def test_append_entry_roundtrip(tmp_path):
+    path = str(tmp_path / "traj.json")
+    result = {
+        "metric": "m",
+        "value": 5.0,
+        "platform": "cpu",
+        "vs_baseline": 2.0,
+        "phases": {"ask": {"total_s": 1.0, "count": 10}},
+    }
+    entry = bench_trajectory.append_entry(result, mode="quick", path=path, now=0.0)
+    assert entry["value"] == 5.0 and entry["phases"]
+    # A partial (watchdog) line is recorded as a tombstone but never gates.
+    bench_trajectory.append_entry(
+        {"metric": "m", "value": None, "platform": "cpu", "partial": True,
+         "partial_reason": "signal SIGTERM"},
+        mode="quick",
+        path=path,
+    )
+    trajectory = bench_trajectory.load_trajectory(path)
+    assert len(trajectory["entries"]) == 2
+    assert [e["value"] for e in trajectory["entries"]] == [5.0, None]
+    comparable = bench_trajectory.comparable_entries(trajectory, "m", "quick", "cpu")
+    assert len(comparable) == 1
+    # Second run 8% slower: within tolerance. 20% slower: gate fires.
+    assert bench_trajectory.check_regression(trajectory, "m", "quick", "cpu", 4.6) is None
+    assert bench_trajectory.check_regression(trajectory, "m", "quick", "cpu", 4.0)
+    # A value that failed the gate is appended flagged and never becomes
+    # the baseline: the gate keeps comparing against the last good entry.
+    bench_trajectory.append_entry(
+        {"metric": "m", "value": 4.0, "platform": "cpu"},
+        mode="quick",
+        path=path,
+        regressed=True,
+    )
+    trajectory = bench_trajectory.load_trajectory(path)
+    assert bench_trajectory.check_regression(trajectory, "m", "quick", "cpu", 4.0)
+
+
+@pytest.mark.slow
+def test_live_bench_appends_and_gates(tmp_path):
+    """The real thing, quick mode: run bench.py, confirm exactly one JSON
+    line with a per-phase breakdown, confirm the run appended to the
+    trajectory file, and enforce the gate against its own history."""
+    traj = str(tmp_path / "BENCH_TRAJECTORY.json")
+    shutil.copy(COMMITTED, traj)
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT,
+        JAX_PLATFORMS="cpu",
+        OPTUNA_TPU_BENCH_CPU_FALLBACK="1",  # skip the accelerator probe
+        OPTUNA_TPU_BENCH_TRAJECTORY_PATH=traj,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--config", "tpe",
+         "--quick"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1500,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["value"] > 0
+    # The per-phase breakdown rode the JSON line (ask/dispatch/tell present).
+    assert {"ask", "dispatch", "tell"} <= set(out["phases"])
+    trajectory = bench_trajectory.load_trajectory(traj)
+    appended = trajectory["entries"][-1]
+    assert appended["metric"] == out["metric"]
+    assert appended["value"] == out["value"]
+    assert appended["phases"] == out["phases"]
+    # THE gate: this run vs the history *before* it (first run of a
+    # metric/mode/platform key establishes the baseline and passes; on a
+    # repeat round a >10% drop fails here).
+    prior = {**trajectory, "entries": trajectory["entries"][:-1]}
+    verdict = bench_trajectory.check_regression(
+        prior,
+        out["metric"],
+        "quick",
+        out["platform"],
+        value=out["value"],
+    )
+    assert verdict is None, verdict

@@ -1,0 +1,280 @@
+"""Telemetry spine unit tests (ISSUE 6): registry semantics, the zero-cost
+disabled contract, the phase-name vocabulary sync, warn_once, and exports
+(snapshot / Prometheus text / HTTP endpoint / CLI surface).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import re
+import sys
+import urllib.request
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import logging as logging_module, telemetry
+from optuna_tpu._lint import registry as lint_registry
+from optuna_tpu.samplers import RandomSampler
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "optuna_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    """Each test gets a fresh registry and leaves telemetry disabled."""
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    logging_module.reset_warn_once()
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counters_and_gauges():
+    registry = telemetry.get_registry()
+    telemetry.count("storage.retry")
+    telemetry.count("storage.retry", 4)
+    telemetry.set_gauge("batch_size", 8)
+    snap = registry.snapshot()
+    assert snap["counters"] == {"storage.retry": 5}
+    assert snap["gauges"] == {"batch_size": 8.0}
+    assert registry.counter_value("storage.retry") == 5
+    assert registry.counter_value("never.touched") == 0
+
+
+def test_histogram_bucket_placement():
+    registry = telemetry.get_registry()
+    registry.observe("latency", 0.00005)  # below the first bound (1e-4)
+    registry.observe("latency", 0.02)  # within the ladder
+    registry.observe("latency", 1e6)  # beyond the last bound -> +Inf
+    hist = registry.snapshot()["histograms"]["latency"]
+    assert hist["count"] == 3
+    assert hist["sum"] == pytest.approx(0.02005 + 1e6)
+    assert hist["buckets"]["+Inf"] == 1
+    assert hist["buckets"][f"{telemetry.BUCKET_BOUNDS[0]:.6g}"] == 1
+    assert sum(hist["buckets"].values()) == 3
+
+
+def test_span_times_with_injected_clock():
+    ticks = iter([10.0, 10.25])
+    registry = telemetry.MetricsRegistry(clock=lambda: next(ticks))
+    telemetry.enable(registry)
+    with telemetry.span("ask"):
+        pass
+    hist = registry.snapshot()["histograms"]["phase.ask"]
+    assert hist["count"] == 1
+    assert hist["sum"] == pytest.approx(0.25)
+    assert telemetry.phase_totals() == {"ask": {"total_s": 0.25, "count": 1}}
+
+
+def test_reset_clears_everything():
+    telemetry.count("storage.retry")
+    with telemetry.span("ask"):
+        pass
+    telemetry.reset()
+    snap = telemetry.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+# ------------------------------------------------------- disabled-path cost
+
+
+def test_disabled_is_inert_and_span_is_a_shared_singleton():
+    telemetry.disable()
+    telemetry.count("storage.retry")
+    telemetry.observe("x", 1.0)
+    telemetry.set_gauge("g", 1.0)
+    assert telemetry.span("ask") is telemetry.span("tell")  # one shared object
+    with telemetry.span("ask"):
+        pass
+    telemetry.enable(telemetry.get_registry())
+    assert telemetry.snapshot() == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_disabled_hot_path_allocates_no_per_trial_objects():
+    """The overhead contract: with telemetry off, the per-trial span+count
+    sequence must not grow the heap — allocations stay a bounded constant,
+    not O(trials). (``_tracing.annotate``'s one-attribute-check promise,
+    extended to the telemetry spine.)"""
+    telemetry.disable()
+
+    def hot_trial():
+        with telemetry.span("ask"):
+            pass
+        with telemetry.span("dispatch"):
+            pass
+        with telemetry.span("tell"):
+            pass
+        telemetry.count("storage.retry")
+
+    for _ in range(200):  # warm free lists / caches
+        hot_trial()
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        hot_trial()
+    gc.collect()
+    after = sys.getallocatedblocks()
+    # Interpreter noise (GC internals, freelist growth) stays far below one
+    # block per trial; a per-trial allocation would add >= 10_000.
+    assert after - before < 500
+
+
+# ------------------------------------------------------------- vocabulary
+
+
+def test_phase_vocabulary_matches_canonical_registry():
+    assert telemetry.PHASES == lint_registry.TELEMETRY_PHASE_REGISTRY
+    assert telemetry.COUNTERS == lint_registry.TELEMETRY_COUNTER_REGISTRY
+
+
+def _package_sources():
+    for root, _, files in os.walk(PKG):
+        for name in files:
+            if name.endswith(".py"):
+                path = os.path.join(root, name)
+                with open(path, encoding="utf-8") as f:
+                    yield path, f.read()
+
+
+def test_every_instrumentation_call_site_uses_the_vocabulary():
+    """Grep the package for telemetry.span / telemetry.count /
+    telemetry.trace_name literals: every span must be a registered phase and
+    every counter must extend a registered family — one vocabulary, no
+    ad-hoc names drifting in at call sites."""
+    span_re = re.compile(r"telemetry\.(?:span|trace_name|observe_phase)\(\s*\"([^\"]+)\"")
+    count_re = re.compile(r"telemetry\.count\(\s*\"([^\"]+)\"")
+    spans_seen, counters_seen = set(), set()
+    for path, source in _package_sources():
+        if path.endswith(("telemetry.py",)) or os.sep + "_lint" + os.sep in path:
+            continue
+        spans_seen.update(span_re.findall(source))
+        counters_seen.update(count_re.findall(source))
+    assert spans_seen, "expected instrumented span call sites in the package"
+    assert counters_seen, "expected instrumented counter call sites in the package"
+    unknown_spans = spans_seen - set(telemetry.PHASES)
+    assert not unknown_spans, f"span names outside telemetry.PHASES: {unknown_spans}"
+    families = tuple(telemetry.COUNTERS)
+    orphans = {
+        name
+        for name in counters_seen
+        if not any(name == fam or name.startswith(fam + ".") for fam in families)
+    }
+    assert not orphans, f"counter names outside telemetry.COUNTERS: {orphans}"
+
+
+def test_trace_name_prefixes_the_phase():
+    assert telemetry.trace_name("ask") == "optuna_tpu.ask"
+
+
+# --------------------------------------------------------------- warn_once
+
+
+def test_warn_once_emits_once_per_key(caplog):
+    import logging
+
+    logger = logging_module.get_logger("optuna_tpu._warn_once_test")
+    optuna_tpu.logging.enable_propagation()
+    try:
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu._warn_once_test"):
+            assert logging_module.warn_once(logger, "k1", "first") is True
+            assert logging_module.warn_once(logger, "k1", "suppressed") is False
+            assert logging_module.warn_once(logger, "k2", "other key") is True
+        assert [r.message for r in caplog.records] == ["first", "other key"]
+        caplog.clear()
+        logging_module.reset_warn_once()
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu._warn_once_test"):
+            assert logging_module.warn_once(logger, "k1", "re-armed") is True
+        assert [r.message for r in caplog.records] == ["re-armed"]
+    finally:
+        optuna_tpu.logging.disable_propagation()
+
+
+def test_guarded_sampler_warns_once_per_study(caplog):
+    """The centralized warn_once preserves GuardedSampler's once-per-study
+    log contract while every event still lands in attrs + counters."""
+    import logging
+
+    from optuna_tpu.samplers._resilience import GuardedSampler
+    from optuna_tpu.testing.fault_injection import FaultySampler
+
+    sampler = GuardedSampler(
+        FaultySampler(RandomSampler(seed=0), raise_at={0, 1, 2}, force_relative=True)
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    optuna_tpu.logging.enable_propagation()
+    try:
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu.samplers._resilience"):
+            study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=4)
+    finally:
+        optuna_tpu.logging.disable_propagation()
+    fallback_warnings = [
+        r for r in caplog.records if "falling back to independent sampling" in r.message
+    ]
+    assert len(fallback_warnings) == 1
+    # ...but all three containment events were counted.
+    assert telemetry.snapshot()["counters"]["sampler.fallback.relative"] == 3
+
+
+# ----------------------------------------------------------------- exports
+
+
+def test_prometheus_rendering_shapes():
+    telemetry.count("grpc.redial", 2)
+    telemetry.set_gauge("g.x", 1.5)
+    with telemetry.span("ask"):
+        pass
+    text = telemetry.render_prometheus()
+    assert "# TYPE optuna_tpu_grpc_redial_total counter" in text
+    assert "optuna_tpu_grpc_redial_total 2" in text
+    assert "optuna_tpu_g_x 1.5" in text
+    assert "# TYPE optuna_tpu_phase_ask_seconds histogram" in text
+    assert 'optuna_tpu_phase_ask_seconds_bucket{le="+Inf"} 1' in text
+    assert "optuna_tpu_phase_ask_seconds_count 1" in text
+    # Buckets are cumulative: the +Inf bucket carries the full count.
+    lines = [l for l in text.splitlines() if l.startswith("optuna_tpu_phase_ask_seconds_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in lines]
+    assert counts == sorted(counts)
+
+
+def test_serve_metrics_http_endpoint():
+    telemetry.count("storage.retry", 7)
+    server = telemetry.serve_metrics(0)  # port 0: bind any free port
+    try:
+        port = server.server_address[1]
+        text = urllib.request.urlopen(
+            f"http://localhost:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "optuna_tpu_storage_retry_total 7" in text
+        snap = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/metrics.json", timeout=10
+            ).read().decode()
+        )
+        assert snap["counters"] == {"storage.retry": 7}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://localhost:{port}/nope", timeout=10)
+    finally:
+        server.shutdown()
+
+
+def test_study_telemetry_snapshot_phases_and_zero_containment():
+    """Fault-free serial study: phase histograms carry one entry per trial
+    and every containment counter stays exactly zero (the acceptance
+    criterion's fault-free half, serial flavor)."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=6)
+    snap = study.telemetry_snapshot()
+    phases = telemetry.phase_totals(snap)
+    for phase in ("ask", "dispatch", "tell"):
+        assert phases[phase]["count"] == 6
+    assert snap["counters"] == {}

@@ -1,0 +1,314 @@
+"""Containment-counter chaos suite (ISSUE 6 acceptance): every telemetry
+counter family increments exactly when its fault fires and stays zero
+fault-free.
+
+The centerpiece is the combined scenario the acceptance criterion names —
+storage faults + pathological history + batch faults in one study — whose
+snapshot must match the injected fault plan *exactly*; the per-family tests
+below it give each counter in ``telemetry.COUNTERS`` its own scenario
+(the chaos-matrix discipline the policy registries already follow).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import DispatchTimeoutError, optimize_vectorized
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.samplers._resilience import GuardedSampler
+from optuna_tpu.storages import RetryPolicy
+from optuna_tpu.storages._in_memory import InMemoryStorage
+from optuna_tpu.storages._retry import RetryingStorage
+from optuna_tpu.testing.fault_injection import (
+    PATHOLOGICAL_HISTORY_PLANS,
+    FaultInjectorStorage,
+    FaultPlan,
+    FaultySampler,
+    FaultyVectorizedObjective,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+#: Counter names asserted zero unless the scenario explicitly fires them —
+#: derived from the registered families so a new family is auto-covered.
+ALL_FAMILIES = tuple(telemetry.COUNTERS)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _quad(params):
+    return (params["x"] - 0.3) ** 2
+
+
+def _containment_counters(snap: dict) -> dict[str, int]:
+    """The snapshot's counters, bucketed by registered family."""
+    out: dict[str, int] = {}
+    for name, value in snap["counters"].items():
+        family = next(
+            (f for f in ALL_FAMILIES if name == f or name.startswith(f + ".")), name
+        )
+        out[family] = out.get(family, 0) + value
+    return out
+
+
+def _fast_retry(**kwargs) -> RetryPolicy:
+    return RetryPolicy(max_attempts=10, sleep=lambda _: None, **kwargs)
+
+
+# ----------------------------------------------------------- the acceptance
+
+
+def test_fault_injected_study_counters_match_the_plan_exactly():
+    """Storage faults + pathological history + batch faults in ONE study:
+    the snapshot's containment counters equal the injected plan, nothing
+    more, nothing less."""
+    plan = FaultPlan(
+        schedule={"get_all_trials": (0, 1), "set_trial_system_attr": (0,)}
+    )
+    injector = FaultInjectorStorage(InMemoryStorage(), plan)
+    storage = RetryingStorage(injector, _fast_retry(), retry_non_idempotent=True)
+    sampler = GuardedSampler(
+        FaultySampler(
+            RandomSampler(seed=0), raise_at={1}, nan_at={3}, force_relative=True
+        )
+    )
+    study = optuna_tpu.create_study(storage=storage, sampler=sampler)
+    # Pathological history: duplicated retry clones with lineage attrs — the
+    # degenerate rows the resilience rings absorb silently (no counter).
+    PATHOLOGICAL_HISTORY_PLANS[4].populate(study, SPACE, seed=0)
+
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (2,)})
+    optimize_vectorized(study, obj, n_trials=8, batch_size=4)
+
+    snap = study.telemetry_snapshot()
+    # Every scheduled storage fault fired and was retried exactly once.
+    assert injector.faults_injected == 3
+    assert snap["counters"]["storage.retry"] == injector.faults_injected
+    # Sampler faults: one raise (suggest #1) + one NaN proposal (suggest #3),
+    # both contained per-trial by GuardedSampler.
+    assert snap["counters"]["sampler.fallback.relative"] == 2
+    # Batch fault: exactly one poisoned slot quarantined.
+    assert snap["counters"]["executor.quarantine"] == 1
+    # ...and nothing else fired.
+    assert _containment_counters(snap) == {
+        "storage.retry": 3,
+        "sampler.fallback": 2,
+        "executor.quarantine": 1,
+    }
+    # The study itself survived the whole plan.
+    states = [t.state for t in study.trials]
+    assert states.count(TrialState.RUNNING) == 0
+    assert states.count(TrialState.FAIL) == 1  # the quarantined slot
+
+
+def test_fault_free_study_counters_all_zero():
+    """The fault-free twin of the combined scenario: identical layering
+    (retry wrapper, guard wrapper, vectorized executor, seeded history),
+    zero faults -> zero containment counters, exactly."""
+    injector = FaultInjectorStorage(InMemoryStorage(), FaultPlan())
+    storage = RetryingStorage(injector, _fast_retry(), retry_non_idempotent=True)
+    sampler = GuardedSampler(FaultySampler(RandomSampler(seed=0), force_relative=True))
+    study = optuna_tpu.create_study(storage=storage, sampler=sampler)
+    PATHOLOGICAL_HISTORY_PLANS[4].populate(study, SPACE, seed=0)
+
+    optimize_vectorized(
+        study,
+        FaultyVectorizedObjective(_quad, SPACE),
+        n_trials=8,
+        batch_size=4,
+    )
+    snap = study.telemetry_snapshot()
+    assert injector.faults_injected == 0
+    assert _containment_counters(snap) == {}
+    # The phase histograms still recorded (observability without faults),
+    # one observation per batch per phase — the split ask blocks (batch
+    # creation + in-heartbeat suggestion) stitch into ONE ask entry.
+    phases = telemetry.phase_totals(snap)
+    assert phases["ask"]["count"] == 2  # two batches
+    assert phases["dispatch"]["count"] == 2
+    assert phases["tell"]["count"] == 2
+
+
+# ------------------------------------------------------- per-family scenarios
+
+
+def test_storage_retry_counter_matches_faults():
+    plan = FaultPlan(schedule={"set_study_user_attr": (0, 1), "get_trial": (0,)})
+    injector = FaultInjectorStorage(InMemoryStorage(), plan)
+    storage = RetryingStorage(injector, _fast_retry())
+    study = optuna_tpu.create_study(storage=storage)
+    study.set_user_attr("a", 1)  # faulted twice (indices 0 and 1 back-to-back)
+    study.set_user_attr("b", 2)
+    trial = study.ask()
+    study._storage.get_trial(trial._trial_id)  # faulted once
+    study.tell(trial, 1.0)
+    assert injector.faults_injected == 3
+    assert telemetry.snapshot()["counters"]["storage.retry"] == 3
+
+
+def test_executor_bisection_counter():
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_at={0})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(study, obj, n_trials=4, batch_size=4)
+    counters = telemetry.snapshot()["counters"]
+    # One failing full-width dispatch -> one bisection (its halves complete).
+    assert counters["executor.bisection"] == 1
+    assert "executor.oom_halving" not in counters
+
+
+def test_executor_oom_halving_counter():
+    obj = FaultyVectorizedObjective(_quad, SPACE, oom_above=4)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(
+        study, obj, n_trials=8, batch_size=8, retry_policy=_fast_retry()
+    )
+    counters = telemetry.snapshot()["counters"]
+    # Width 8 OOMs once, halves to 4+4 which fit; later batches start at 4.
+    assert counters["executor.oom_halving"] == 1
+    assert _containment_counters(telemetry.snapshot()) == {"executor.oom_halving": 1}
+
+
+def test_executor_dispatch_timeout_counter():
+    obj = FaultyVectorizedObjective(_quad, SPACE, hang_at={0}, hang_s=5.0)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    with pytest.raises(DispatchTimeoutError):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=2,
+            batch_size=1,
+            bisect_on_error=False,
+            retry_policy=RetryPolicy(max_attempts=1, sleep=lambda _: None),
+            dispatch_deadline_s=0.2,
+        )
+    counters = telemetry.snapshot()["counters"]
+    assert counters["executor.dispatch_timeout"] == 1
+
+
+def test_heartbeat_reap_counter(tmp_path):
+    from optuna_tpu.storages._heartbeat import fail_stale_trials
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/reap.db", heartbeat_interval=60, grace_period=120
+    )
+    study = optuna_tpu.create_study(study_name="reap", storage=storage)
+    trial = study.ask()
+    trial.suggest_float("x", 0, 1)
+    # Age the worker's heartbeat past the grace period: a survivor reaps it.
+    con = storage._conn()
+    con.execute("UPDATE trial_heartbeats SET heartbeat = heartbeat - 100000")
+    con.commit()
+    survivor = optuna_tpu.load_study(study_name="reap", storage=storage)
+    fail_stale_trials(survivor)
+    assert telemetry.snapshot()["counters"]["heartbeat.reap"] == 1
+    assert survivor.trials[0].state == TrialState.FAIL
+
+
+def test_grpc_redial_and_op_token_dedup_counters():
+    grpc = pytest.importorskip("grpc")
+    from optuna_tpu.storages._grpc._service import (
+        OP_TOKEN_KEY,
+        SERVICE_NAME,
+        decode_response,
+        encode_request,
+    )
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import _make_handler
+    from optuna_tpu.study._study_direction import StudyDirection
+
+    # Redial: dropping the (never-connected) channel is the counted event.
+    proxy = GrpcStorageProxy(port=1)  # nothing listens; no RPC is made
+    proxy._reconnect()
+    proxy.remove_session()
+    assert telemetry.snapshot()["counters"]["grpc.redial"] == 1
+
+    # Dedup: replaying the same op token hits the server's token cache. The
+    # handler is exercised directly (no sockets): service() hands back the
+    # same callable gRPC would invoke.
+    handler = _make_handler(InMemoryStorage())
+
+    class _Details:
+        method = f"/{SERVICE_NAME}/create_new_study"
+
+    rpc = handler.service(_Details())
+    request = encode_request(
+        "create_new_study",
+        ([StudyDirection.MINIMIZE],),
+        {"study_name": "dedup", OP_TOKEN_KEY: "tok-1"},
+    )
+    ok1, study_id1 = decode_response(rpc.unary_unary(request, None))
+    ok2, study_id2 = decode_response(rpc.unary_unary(request, None))  # replay
+    assert ok1 and ok2 and study_id1 == study_id2
+    assert telemetry.snapshot()["counters"]["grpc.op_token_dedup"] == 1
+
+
+def test_journal_lock_contention_counter(tmp_path):
+    from optuna_tpu.storages.journal._file import JournalFileSymlinkLock
+
+    target = str(tmp_path / "journal.log")
+    open(target, "w").close()
+    holder = JournalFileSymlinkLock(target, grace_period=300.0)
+    assert holder.acquire()
+    assert telemetry.snapshot()["counters"].get("journal.lock_contention", 0) == 0
+
+    waiter = JournalFileSymlinkLock(target, grace_period=300.0)
+    release_timer = threading.Timer(0.05, holder.release)
+    release_timer.start()
+    try:
+        assert waiter.acquire()  # contends, backs off, then wins
+    finally:
+        release_timer.cancel()
+        waiter.release()
+    assert telemetry.snapshot()["counters"]["journal.lock_contention"] == 1
+
+
+def test_sampler_fallback_counter_families_are_phase_bucketed():
+    """Per-param independent-path failures collapse into one family bucket
+    (bounded cardinality), while distinct hooks stay distinguishable."""
+
+    class _BrokenIndependent(RandomSampler):
+        def sample_independent(self, study, trial, name, dist):
+            raise RuntimeError("independent path down")
+
+    sampler = GuardedSampler(_BrokenIndependent(seed=0))
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(
+        lambda t: t.suggest_float("x", 0, 1) + t.suggest_float("y", 0, 1),
+        n_trials=2,
+    )
+    counters = telemetry.snapshot()["counters"]
+    # 2 trials x 2 params, all bucketed under one 'independent' family key.
+    assert counters["sampler.fallback.independent"] == 4
+    assert all(
+        not k.startswith("sampler.fallback.independent:") for k in counters
+    )
+
+
+def test_disabled_chaos_records_nothing():
+    """Faults with telemetry disabled: containment still works, registry
+    stays empty — recording is opt-in, never load-bearing."""
+    telemetry.disable()
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (1,)})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(study, obj, n_trials=4, batch_size=4)
+    assert sum(t.state == TrialState.FAIL for t in study.trials) == 1
+    telemetry.enable(telemetry.get_registry())
+    assert telemetry.snapshot()["counters"] == {}
