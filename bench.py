@@ -57,15 +57,61 @@ def _silence() -> None:
     optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
 
 
+#: jit gauge values at the start of the timed window, so the emitted compile
+#: breakdown is a window delta, not a process-lifetime total (the
+#: instrument_jit proxies report cumulative figures per wrapper label).
+_JIT_GAUGE_BASE: dict = {}
+
+
 def _reset_phase_telemetry() -> None:
     """Arm the telemetry spine for a timed window: recording on, registry
     cleared, so the emitted per-phase breakdown covers exactly the timed
     trials (warm-up/compile work is excluded the same way the wall clock
-    excludes it)."""
+    excludes it). The jit compile gauges' pre-window values are captured so
+    :func:`_compile_breakdown` can report the in-window delta."""
     from optuna_tpu import telemetry
 
     telemetry.enable()
+    _JIT_GAUGE_BASE.clear()
+    _JIT_GAUGE_BASE.update(
+        {
+            k: v
+            for k, v in telemetry.snapshot()["gauges"].items()
+            if k.startswith("jit.")
+        }
+    )
     telemetry.reset()
+
+
+def _gauge_delta(gauges: dict, prefix: str) -> float:
+    """Sum of per-label in-window growth for one jit gauge family. A label
+    that compiled only before the window is absent from ``gauges`` and
+    contributes zero; one that compiled in both windows contributes its
+    cumulative value minus the captured base."""
+    total = 0.0
+    for key, value in gauges.items():
+        if key.startswith(prefix):
+            total += max(0.0, value - _JIT_GAUGE_BASE.get(key, 0.0))
+    return total
+
+
+def _compile_breakdown() -> dict:
+    """In-window jit compile gauges (see ``optuna_tpu.flight.instrument_jit``):
+    how many executables were built during the timed trials, the
+    compile-inclusive seconds they cost, and how many were retraces after a
+    wrapper's first compile (the runtime TPU002 signal). This is what lets
+    the JSON line split first-batch (compile-inclusive) throughput from
+    steady-state throughput instead of conflating the two."""
+    from optuna_tpu import telemetry
+
+    gauges = telemetry.snapshot()["gauges"]
+    return {
+        "count": int(_gauge_delta(gauges, "jit.compiles.")),
+        "seconds": round(_gauge_delta(gauges, "jit.compile_seconds."), 3),
+        "retraces_after_first": int(
+            _gauge_delta(gauges, "jit.retraces_after_first.")
+        ),
+    }
 
 
 def _phase_breakdown() -> dict:
@@ -806,6 +852,11 @@ def main() -> None:
     watchdog.update(quick=bool(args.quick))
     provenance = "live"  # how vs_baseline's denominator was obtained
     extra: dict = {}
+    # Timed-trial count of the measured window, where a config has one (the
+    # hv config measures selection rounds instead): the denominator the
+    # compile-cost split below needs to convert compile seconds back into a
+    # steady-state trials/s figure.
+    n_timed = None
 
     if args.config == "gp":
         # Headline = BASELINE.json's own form: the WHOLE n=1000 study
@@ -819,6 +870,7 @@ def main() -> None:
         _log(f"running ours (GPSampler / 20D Hartmann, n={n_total} end-to-end, chain=8)...")
         wall, ours_best = run_ours_gp_end_to_end(n_total)
         ours_rate = n_total / wall
+        n_timed = n_total
         _log(f"ours: {wall:.1f}s = {ours_rate:.3f} trials/s (best {ours_best:.4f})")
         watchdog.update(value=round(ours_rate, 3))
         watchdog.phase("baseline:gp")
@@ -933,6 +985,20 @@ def main() -> None:
     # the instrument that localizes a trials/s regression to the phase that
     # paid for it (ROADMAP item 5 — the r03->r04 drop had no such signal).
     extra["phases"] = _phase_breakdown()
+    # Compile-cost split (ISSUE 8): the in-window jit compile gauges divide
+    # the measured window into first-batch (compile-inclusive) and
+    # steady-state throughput. `value` stays the end-to-end figure — it is
+    # the committed-trajectory comparable — and `steady_state_trials_per_sec`
+    # rides beside it so a compile-time regression and a loop-time
+    # regression stop being indistinguishable.
+    compile_info = _compile_breakdown()
+    extra["compile"] = compile_info
+    if n_timed and ours_rate > 0 and compile_info["seconds"] > 0:
+        window_wall = n_timed / ours_rate
+        # Floor at 1% of the window: a gauge anomaly (compile seconds
+        # >= wall) must not emit a negative/infinite rate.
+        steady_wall = max(window_wall - compile_info["seconds"], window_wall * 0.01)
+        extra["steady_state_trials_per_sec"] = round(n_timed / steady_wall, 3)
     watchdog.update(metric=metric, value=round(ours_rate, 3))
     watchdog.phase("emit")
     if base is not None:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import time
 from typing import Any
 
@@ -96,6 +97,33 @@ def check_regression(
     return None
 
 
+def git_provenance(repo_dir: str | None = None) -> dict | None:
+    """``{"sha": <head commit>, "dirty": <uncommitted changes?>}`` for the
+    repo containing this file, or None when git (or the repo) is absent /
+    broken — a bench run on an exported tarball must still record cleanly.
+    The stamp is what lets a trajectory regression bisect to a commit
+    instead of a vague "sometime between r03 and r04"."""
+    repo_dir = repo_dir or os.path.dirname(os.path.abspath(__file__))
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo_dir, capture_output=True, text=True, timeout=10,
+        )
+        if head.returncode != 0 or not head.stdout.strip():
+            return None
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=repo_dir, capture_output=True, text=True, timeout=10,
+        )
+        prov: dict[str, Any] = {"sha": head.stdout.strip()}
+        # A failing status leaves dirtiness unknown rather than guessed.
+        if status.returncode == 0:
+            prov["dirty"] = bool(status.stdout.strip())
+        return prov
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def append_entry(
     result: dict[str, Any],
     mode: str,
@@ -135,6 +163,13 @@ def append_entry(
         entry["fallback"] = True
     if result.get("phases"):
         entry["phases"] = result["phases"]
+    if result.get("compile"):
+        entry["compile"] = result["compile"]
+    if result.get("steady_state_trials_per_sec") is not None:
+        entry["steady_state_trials_per_sec"] = result["steady_state_trials_per_sec"]
+    provenance = git_provenance()
+    if provenance is not None:
+        entry["git"] = provenance
     entries.append(entry)
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:

@@ -85,6 +85,10 @@ class Config:
     smp001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.FALLBACK_POLICY_REGISTRY
     )
+    obs002_targets: tuple[tuple[str, str, str], ...] = registry.OBS002_TARGETS
+    obs002_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.FLIGHT_EVENT_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

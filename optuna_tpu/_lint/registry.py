@@ -151,6 +151,42 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
 }
 
+#: The flight recorder's event-kind vocabulary: canonical mirror of
+#: ``flight.py::EVENT_KINDS`` (rule **OBS002**, the STO001 machinery pointed
+#: at observability). Span *names* within the ``phase`` kind come from
+#: :data:`TELEMETRY_PHASE_REGISTRY` and ``containment`` names from
+#: :data:`TELEMETRY_COUNTER_REGISTRY`, so the kinds are the only new
+#: vocabulary the recorder introduces. Every kind must have an acceptance
+#: scenario in ``testing/fault_injection.py::FLIGHT_EVENT_CHAOS_MATRIX``
+#: (cross-checked by the same rule).
+FLIGHT_EVENT_REGISTRY: dict[str, str] = {
+    "phase": "a timed study-loop phase span (names: the telemetry phase vocabulary)",
+    "trial": "a trial lifecycle instant (ask'd / told) carrying the trial number",
+    "containment": "a containment event (names: the telemetry counter families)",
+    "rpc.client": "a gRPC client op span carrying this worker's trace/span ids",
+    "rpc.server": "a gRPC server handler span tagged with the calling client's span",
+    "jit.compile": "a jit wrapper's executable cache grew: a compile, with call seconds",
+    "jit.retrace": "a jit wrapper's cache grew after its first entry (runtime TPU002)",
+    "gauge": "a sampled runtime device gauge (HBM high-water, cache sizes)",
+    "postmortem": "the recorder tail was flushed to a bounded JSON dump",
+}
+
+#: The hand-maintained copies OBS002 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+OBS002_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/flight.py",
+        "EVENT_KINDS",
+        "the recorder's accepted event kinds (validated on every record)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "FLIGHT_EVENT_CHAOS_MATRIX",
+        "chaos matrix: every event kind must have an acceptance scenario",
+    ),
+)
+
 #: The single blessed Cholesky call site for sampler code (rule **SMP002**):
 #: every kernel solve in ``optuna_tpu/samplers/`` must go through the
 #: jitter-ladder helper there, which escalates diagonal jitter in-graph until

@@ -1,6 +1,6 @@
 """Device-kernel rules: TPU001 host sync, TPU002 recompile hazard,
 TPU003 dtype drift, TPU004 stray debug output, OBS001 observability taps
-in traced scopes.
+in traced scopes, OBS002 flight-recorder event-vocabulary sync.
 
 The TPU rules encode the invariants ARCHITECTURE.md's design stance rests
 on: inside a jit trace nothing may force a host round-trip (TPU001), jit
@@ -21,6 +21,7 @@ import ast
 from typing import Iterable, Iterator
 
 from optuna_tpu._lint.engine import Finding, ModuleContext, Rule
+from optuna_tpu._lint.rules_storage import _RegistrySyncRule
 
 _LAX_CONTROL_FLOW = {"while_loop", "scan", "fori_loop", "cond", "switch", "map"}
 _CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
@@ -207,8 +208,9 @@ class OBS001TelemetryInTrace(Rule):
     title = "telemetry/logging call inside a jit trace"
 
     #: Module aliases whose calls are observability taps wherever they point
-    #: (``telemetry.count(...)``, ``logging_module.warn_once(...)``).
-    _TAP_ROOTS = {"telemetry", "logging", "logging_module"}
+    #: (``telemetry.count(...)``, ``flight.span(...)``,
+    #: ``logging_module.warn_once(...)``).
+    _TAP_ROOTS = {"telemetry", "flight", "_flight", "logging", "logging_module"}
     #: Logger method names — flagged when called on something logger-shaped.
     _LOG_METHODS = {
         "debug", "info", "warning", "warn", "error", "exception", "critical", "log",
@@ -263,6 +265,25 @@ class OBS001TelemetryInTrace(Rule):
         ):
             return ".".join(chain) + "()"
         return None
+
+
+class OBS002FlightEventSync(_RegistrySyncRule):
+    """The STO001/EXE001/SMP001 anti-drift machinery pointed at the flight
+    recorder's event-kind vocabulary: ``flight.py::EVENT_KINDS`` and the
+    chaos matrix ``fault_injection.py::FLIGHT_EVENT_CHAOS_MATRIX`` must both
+    equal the canonical ``registry.FLIGHT_EVENT_REGISTRY`` — an event kind
+    added to the recorder without an acceptance scenario is a lint failure,
+    not a review comment."""
+
+    id = "OBS002"
+    title = "flight-recorder event vocabularies out of sync"
+    noun = "flight event kinds"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.obs002_registry)
+
+    def _targets(self, config):
+        return config.obs002_targets
 
 
 class TPU002RecompileHazard(Rule):

@@ -3,7 +3,9 @@
 Parity target: ``optuna/cli.py:814-977`` — 11 subcommands including shell
 level ``ask``/``tell`` for driving distributed loops from scripts, with
 json/table/yaml output formats (``:156-273``); plus the ``metrics`` dump of
-the telemetry registry (``optuna_tpu/telemetry.py``, no reference analog).
+the telemetry registry (``optuna_tpu/telemetry.py``) and the ``trace`` dump
+of the flight recorder's Chrome-trace timeline (``optuna_tpu/flight.py``) —
+neither has a reference analog.
 
 Entry points: ``python -m optuna_tpu.cli ...`` or the ``optuna-tpu`` console
 script.
@@ -252,6 +254,46 @@ def _cmd_metrics(args: argparse.Namespace) -> None:
         print(telemetry.render_prometheus(), end="")
 
 
+def _cmd_trace(args: argparse.Namespace) -> None:
+    """Dump the flight recorder's timeline (see :mod:`optuna_tpu.flight`).
+
+    ``--format=chrome`` (default) emits Chrome trace-event JSON — open it in
+    Perfetto or ``chrome://tracing``; ``--format=events`` emits the raw
+    structured event list. Without ``--endpoint`` the dump is this process's
+    recorder — empty unless ``OPTUNA_TPU_FLIGHT`` was set; with
+    ``--endpoint`` it is fetched from a serving process's ``/trace.json``
+    (the gRPC proxy's ``metrics_port``), which is where a live fleet's
+    stitched timeline actually accumulates. ``--output`` writes to a file
+    instead of stdout (the natural hand-off to a Perfetto tab).
+    """
+    from optuna_tpu import flight
+
+    if args.endpoint:
+        import urllib.request
+
+        base = args.endpoint.rstrip("/")
+        url = base if base.endswith("/trace.json") else base + "/trace.json"
+        if args.format != "chrome":
+            raise CLIUsageError(
+                "--endpoint serves Chrome trace JSON only; drop --format or "
+                "pass --format=chrome."
+            )
+        with urllib.request.urlopen(url, timeout=10) as response:
+            payload = response.read().decode()
+    elif args.format == "chrome":
+        flight.sample_device_gauges()
+        payload = json.dumps(flight.chrome_trace())
+    else:
+        payload = json.dumps(flight.snapshot())
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(payload)
+            f.write("\n")
+        print(args.output)
+    else:
+        print(payload)
+
+
 def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="optuna-tpu")
     parser.add_argument("--storage", default=None, help="DB/journal/grpc URL")
@@ -315,6 +357,18 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="fetch from a serving process (e.g. http://host:9090) instead of "
         "this process's registry",
+    )
+
+    p = add("trace", _cmd_trace)
+    p.add_argument("-f", "--format", default="chrome", choices=["chrome", "events"])
+    p.add_argument(
+        "--endpoint",
+        default=None,
+        help="fetch /trace.json from a serving process (e.g. http://host:9090) "
+        "instead of this process's flight recorder",
+    )
+    p.add_argument(
+        "-o", "--output", default=None, help="write to this file instead of stdout"
     )
 
     p = add("tell", _cmd_tell)

@@ -1,0 +1,695 @@
+"""Flight recorder: a per-trial trace timeline from client to gRPC to device.
+
+The telemetry spine (:mod:`optuna_tpu.telemetry`) answers "how much / how
+often" — phase histograms and containment counters — but not "what happened,
+in what order, to *this* trial". Attributing a throughput regression to a
+dispatch-path suspect, or debugging an async fleet where one trial's life
+spans three processes, needs an *ordered, structured* record (asynchronous
+many-worker BO is exactly the architecture of Dorier et al.,
+arXiv:2210.00798; the reference Optuna, Akiba et al. arXiv:1907.10902, ships
+nothing comparable). This module is that record:
+
+* :class:`FlightRecorder` — a bounded ring buffer (``collections.deque``)
+  of structured :class:`FlightEvent` entries. Capacity-bounded by
+  construction: a week-long study can leave it on and the heap stays flat.
+* **One vocabulary** — span events use the telemetry phase names
+  (``telemetry.PHASES``, canonical in
+  ``_lint/registry.py::TELEMETRY_PHASE_REGISTRY``) so the flight timeline,
+  the metrics histograms and ``_tracing.annotate``'s device profiler spans
+  all line up name-for-name; containment events use the counter families
+  (``telemetry.COUNTERS``) and are fed automatically from every existing
+  ``telemetry.count`` call site via a sink hook — a containment event cannot
+  exist in the counters without appearing on the timeline, and vice versa.
+  Event *kinds* are the :data:`EVENT_KINDS` vocabulary (canonical mirror:
+  ``_lint/registry.py::FLIGHT_EVENT_REGISTRY``, graphlint rule **OBS002**).
+* **Runtime device gauges** — :func:`instrument_jit` wraps a ``jax.jit``
+  callable and watches its executable-cache size across calls: a cache
+  growth is a compile (counted, with compile-inclusive call seconds), and a
+  growth *after the first* is a live retrace — the runtime complement to
+  graphlint's static TPU002 rule. :func:`sample_device_gauges` records the
+  backend's HBM high-water mark where ``Device.memory_stats()`` exists.
+* **Three delivery surfaces** — (1) Chrome-trace/Perfetto JSON
+  (:func:`chrome_trace`, ``Study.trace_snapshot()``, the ``optuna-tpu
+  trace`` CLI, and ``/trace.json`` beside the gRPC proxy server's
+  ``/metrics``); (2) cross-process propagation: the gRPC client attaches
+  ``{trace id, span id}`` to every op (riding in kwargs beside the op
+  tokens) and the server records its handler span tagged with the client's,
+  so a multi-worker study stitches into ONE trace id; (3) postmortems:
+  :func:`postmortem` flushes the ring's tail as bounded JSON when a batch
+  fails terminally, a watchdog fires, or a ``GuardedSampler`` first
+  degrades — chaos failures stay diagnosable after the process is gone.
+
+Overhead contract (the telemetry spine's, verbatim): **off by default**; the
+disabled hot path is a module-global check — ``span`` returns one shared
+null singleton, ``event`` returns immediately — so a disabled study loop
+allocates nothing per trial on this module's account (asserted by
+``tests/test_flight.py``). Recording is strictly host-side: graphlint rule
+**OBS001** flags ``flight.*`` calls inside jit-decorated functions or
+``lax`` loop bodies of device modules.
+
+Enable with ``OPTUNA_TPU_FLIGHT=1`` (optionally ``=<capacity>``) in the
+environment, or :func:`enable` / :func:`disable` at runtime.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Iterable, Mapping
+
+from optuna_tpu import telemetry
+
+__all__ = [
+    "EVENT_KINDS",
+    "FlightEvent",
+    "FlightRecorder",
+    "chrome_trace",
+    "clear",
+    "disable",
+    "enable",
+    "enabled",
+    "event",
+    "events",
+    "get_recorder",
+    "instrument_jit",
+    "last_postmortem_path",
+    "new_span_id",
+    "postmortem",
+    "rpc_span",
+    "sample_device_gauges",
+    "snapshot",
+    "span",
+    "trace_id",
+    "trial_event",
+]
+
+
+# ------------------------------------------------------------- vocabulary
+
+#: The event-kind vocabulary: every recorded event carries exactly one of
+#: these kinds (validated on record). Span *names* within the ``phase`` kind
+#: come from ``telemetry.PHASES``; ``containment`` names from
+#: ``telemetry.COUNTERS`` families. Canonical mirror:
+#: ``_lint/registry.py::FLIGHT_EVENT_REGISTRY`` — graphlint rule **OBS002**
+#: and ``tests/test_flight.py`` fail if the two drift, and every kind must
+#: have an acceptance scenario in ``testing/fault_injection.py::
+#: FLIGHT_EVENT_CHAOS_MATRIX`` (the STO001/EXE001 discipline).
+EVENT_KINDS: dict[str, str] = {
+    "phase": "a timed study-loop phase span (names: the telemetry phase vocabulary)",
+    "trial": "a trial lifecycle instant (ask'd / told) carrying the trial number",
+    "containment": "a containment event (names: the telemetry counter families)",
+    "rpc.client": "a gRPC client op span carrying this worker's trace/span ids",
+    "rpc.server": "a gRPC server handler span tagged with the calling client's span",
+    "jit.compile": "a jit wrapper's executable cache grew: a compile, with call seconds",
+    "jit.retrace": "a jit wrapper's cache grew after its first entry (runtime TPU002)",
+    "gauge": "a sampled runtime device gauge (HBM high-water, cache sizes)",
+    "postmortem": "the recorder tail was flushed to a bounded JSON dump",
+}
+
+#: Ring capacity when the environment/enable() doesn't say otherwise: deep
+#: enough for thousands of trials' spans, shallow enough to stay megabytes.
+DEFAULT_CAPACITY = 8192
+
+#: Postmortem dumps flush at most this many trailing events — bounded JSON
+#: no matter how large a capacity the operator configured.
+POSTMORTEM_TAIL = 1024
+
+_DUMP_DIR_ENV = "OPTUNA_TPU_FLIGHT_DUMP_DIR"
+
+
+# ----------------------------------------------------------------- events
+
+
+class FlightEvent:
+    """One structured timeline entry. ``ts`` is wall-clock seconds (an epoch
+    anchor is added to the injectable monotonic clock, so timestamps are
+    orderable across processes on one host); ``dur`` is span seconds or
+    None for instants; ``trace``/``span``/``parent`` stitch cross-process
+    causality."""
+
+    __slots__ = ("ts", "kind", "name", "dur", "trial", "trace", "span", "parent", "tid", "meta")
+
+    def __init__(
+        self,
+        ts: float,
+        kind: str,
+        name: str,
+        dur: float | None = None,
+        trial: int | None = None,
+        trace: str | None = None,
+        span: str | None = None,
+        parent: str | None = None,
+        tid: int = 0,
+        meta: dict | None = None,
+    ) -> None:
+        self.ts = ts
+        self.kind = kind
+        self.name = name
+        self.dur = dur
+        self.trial = trial
+        self.trace = trace
+        self.span = span
+        self.parent = parent
+        self.tid = tid
+        self.meta = meta
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"ts": self.ts, "kind": self.kind, "name": self.name}
+        if self.dur is not None:
+            out["dur"] = self.dur
+        if self.trial is not None:
+            out["trial"] = self.trial
+        if self.trace is not None:
+            out["trace"] = self.trace
+        if self.span is not None:
+            out["span"] = self.span
+        if self.parent is not None:
+            out["parent"] = self.parent
+        out["tid"] = self.tid
+        if self.meta:
+            out["meta"] = self.meta
+        return out
+
+    def __repr__(self) -> str:  # compact test/debug rendering
+        return f"FlightEvent({self.kind}:{self.name} @{self.ts:.6f} trial={self.trial})"
+
+
+class _FlightSpan:
+    """Times one ``with`` block into the ring as a completed span event."""
+
+    __slots__ = ("_recorder", "_kind", "_name", "_trial", "_parent", "_trace", "_meta", "_t0", "span_id")
+
+    def __init__(
+        self,
+        recorder: "FlightRecorder",
+        kind: str,
+        name: str,
+        trial: int | None,
+        parent: str | None,
+        trace: str | None,
+        meta: dict | None,
+        span_id: str | None,
+    ) -> None:
+        self._recorder = recorder
+        self._kind = kind
+        self._name = name
+        self._trial = trial
+        self._parent = parent
+        self._trace = trace
+        self._meta = meta
+        self.span_id = span_id if span_id is not None else recorder.new_span_id()
+
+    def __enter__(self) -> "_FlightSpan":
+        self._t0 = self._recorder._clock()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        recorder = self._recorder
+        recorder.record(
+            self._kind,
+            self._name,
+            ts=self._t0 + recorder._epoch,
+            dur=recorder._clock() - self._t0,
+            trial=self._trial,
+            trace=self._trace,
+            span=self.span_id,
+            parent=self._parent,
+            meta=self._meta,
+        )
+
+
+class _NullSpan:
+    """The disabled-path span: one shared instance, allocates nothing."""
+
+    __slots__ = ()
+    span_id = None
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+# --------------------------------------------------------------- recorder
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of :class:`FlightEvent` entries.
+
+    ``clock`` is injectable (monotonic) for deterministic tests, like
+    :class:`~optuna_tpu.telemetry.MetricsRegistry`; ``epoch`` anchors it to
+    wall time so exported timestamps are comparable across the processes of
+    one study. One recorder = one ``trace id`` — the identity that
+    propagates over gRPC so a fleet's events stitch into one timeline.
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        clock: Callable[[], float] = time.monotonic,
+        epoch: float | None = None,
+        trace_id: str | None = None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}.")
+        self.capacity = capacity
+        self._clock = clock
+        self._epoch = (time.time() - clock()) if epoch is None else epoch
+        self.trace_id = trace_id if trace_id is not None else uuid.uuid4().hex[:16]
+        self._events: deque[FlightEvent] = deque(maxlen=capacity)
+        self._span_seq = itertools.count(1)
+        self._pid = os.getpid()
+
+    def now(self) -> float:
+        return self._clock() + self._epoch
+
+    def new_span_id(self) -> str:
+        return f"{self._pid:x}.{next(self._span_seq):x}"
+
+    def record(
+        self,
+        kind: str,
+        name: str,
+        *,
+        ts: float | None = None,
+        dur: float | None = None,
+        trial: int | None = None,
+        trace: str | None = None,
+        span: str | None = None,
+        parent: str | None = None,
+        meta: dict | None = None,
+    ) -> FlightEvent:
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown flight event kind {kind!r}; the vocabulary is "
+                f"{sorted(EVENT_KINDS)} (EVENT_KINDS / FLIGHT_EVENT_REGISTRY)."
+            )
+        ev = FlightEvent(
+            ts=self.now() if ts is None else ts,
+            kind=kind,
+            name=name,
+            dur=dur,
+            trial=trial,
+            trace=self.trace_id if trace is None else trace,
+            span=span,
+            parent=parent,
+            tid=threading.get_ident(),
+            meta=meta,
+        )
+        self._events.append(ev)  # deque.append is atomic; maxlen bounds it
+        return ev
+
+    def events(self) -> list[FlightEvent]:
+        return list(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+
+# ------------------------------------------------- module-level fast path
+
+_RECORDER = FlightRecorder()
+_enabled = False
+_postmortem_keys: set[str] = set()
+_postmortem_seq = itertools.count(1)
+_last_postmortem_path: str | None = None
+
+
+def _env_capacity() -> int | None:
+    """Parse ``OPTUNA_TPU_FLIGHT``: None = stay disabled (unset, empty, or an
+    explicit disable spelling — ``0``/``false``/``no``/``off`` must not arm
+    the recorder the operator just opted out of), an int >= 2 = that ring
+    capacity, anything else truthy (``1``/``true``/``yes``) = the default."""
+    raw = os.environ.get("OPTUNA_TPU_FLIGHT", "").strip()
+    if not raw or raw.lower() in ("false", "no", "off"):
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return DEFAULT_CAPACITY  # OPTUNA_TPU_FLIGHT=true/yes style
+    if n <= 0:
+        return None
+    return n if n > 1 else DEFAULT_CAPACITY
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def trace_id() -> str:
+    return _RECORDER.trace_id
+
+
+def new_span_id() -> str:
+    return _RECORDER.new_span_id()
+
+
+def enable(recorder: FlightRecorder | None = None, *, capacity: int | None = None) -> None:
+    """Turn recording on (optionally swapping in a fresh recorder — tests
+    and the CLI use an isolated one so timelines can't bleed across runs).
+    Also hooks the telemetry counter sink so every existing
+    ``telemetry.count`` call site lands a ``containment`` event here with
+    zero new instrumentation at those sites."""
+    global _enabled, _RECORDER
+    if recorder is not None:
+        _RECORDER = recorder
+        _postmortem_keys.clear()  # a fresh recorder is a fresh session
+    elif capacity is not None and capacity != _RECORDER.capacity:
+        _RECORDER = FlightRecorder(capacity=capacity)
+        _postmortem_keys.clear()
+    _enabled = True
+    telemetry._set_count_sink(_containment_sink)
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+    telemetry._set_count_sink(None)
+
+
+def clear() -> None:
+    _RECORDER.clear()
+    _postmortem_keys.clear()
+
+
+def _containment_sink(name: str, n: int) -> None:
+    """The ``telemetry.count`` hook: every containment counter increment is
+    also an ordered timeline event (kind ``containment``), so the chaos
+    postmortem can show *when* a quarantine/bisection/retry fired relative
+    to the trial lifecycle — the counters alone only say that it did."""
+    _RECORDER.record("containment", name, meta=None if n == 1 else {"n": n})
+
+
+# ----------------------------------------------------------- record entry
+
+
+def span(name: str, trial: int | None = None):
+    """Time a ``with`` block as a ``phase`` span (``name`` must be a
+    telemetry phase). Returns a shared do-nothing singleton while disabled —
+    one module-global check, zero allocations on the hot path."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _FlightSpan(_RECORDER, "phase", name, trial, None, None, None, None)
+
+
+def event(
+    kind: str,
+    name: str,
+    trial: int | None = None,
+    meta: dict | None = None,
+) -> None:
+    """Record one instant event; a no-op while disabled."""
+    if not _enabled:
+        return
+    _RECORDER.record(kind, name, trial=trial, meta=meta)
+
+
+def trial_event(name: str, number: int, state: str | None = None) -> None:
+    """A trial lifecycle instant (``name``: ``ask``/``tell``). Positional
+    args only — the disabled path must not build a kwargs dict per trial."""
+    if not _enabled:
+        return
+    _RECORDER.record(
+        "trial", name, trial=number, meta=None if state is None else {"state": state}
+    )
+
+
+def rpc_span(side: str, method: str, ctx: Mapping[str, str] | None):
+    """A gRPC op span. ``side`` is ``'client'`` or ``'server'``; ``ctx`` is
+    the propagated ``{'t': trace_id, 's': span_id}`` mapping (the client
+    mints it and rides it in kwargs beside the op token; the server pops it
+    and passes it here so its handler span carries the *client's* trace id
+    and parents onto the client's span — one timeline across processes)."""
+    if not _enabled:
+        return _NULL_SPAN
+    if side == "client":
+        return _FlightSpan(
+            _RECORDER, "rpc.client", "storage.op", None, None, None,
+            {"method": method}, ctx["s"] if ctx else None,
+        )
+    return _FlightSpan(
+        _RECORDER, "rpc.server", "storage.op", None,
+        ctx["s"] if ctx else None,
+        ctx["t"] if ctx else None,
+        {"method": method}, None,
+    )
+
+
+def rpc_context() -> dict[str, str]:
+    """Mint the per-op propagation context the gRPC client attaches to its
+    kwargs (wire key: ``_service.FLIGHT_CTX_KEY``)."""
+    return {"t": _RECORDER.trace_id, "s": _RECORDER.new_span_id()}
+
+
+# ------------------------------------------------------ runtime jit gauges
+
+
+def _jit_cache_size(fn: Any) -> int | None:
+    """The wrapper's executable-cache entry count, where jax exposes it
+    (``PjitFunction._cache_size``); None when it doesn't — the gauges then
+    stay silent rather than guessing."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # graphlint: ignore[PY001] -- jax-version boundary: a private introspection API changing shape must degrade to "no gauge", never break a dispatch
+        return None
+
+
+#: Per-label compile totals aggregated ACROSS proxies: several wrappers may
+#: legitimately share one label (every VectorizedObjective mints its own
+#: guarded wrapper under "vectorized.guarded"), and the gauges must report
+#: the label's total, not whichever proxy wrote last.
+_jit_totals: dict[str, list] = {}
+_jit_totals_lock = threading.Lock()
+
+
+def _note_jit_compile(label: str, seconds: float, retrace: bool) -> None:
+    with _jit_totals_lock:
+        totals = _jit_totals.setdefault(label, [0, 0.0, 0])
+        totals[0] += 1
+        totals[1] += seconds
+        if retrace:
+            totals[2] += 1
+        compiles, compile_seconds, retraces = totals
+    telemetry.set_gauge("jit.compiles." + label, compiles)
+    telemetry.set_gauge("jit.compile_seconds." + label, round(compile_seconds, 6))
+    if retraces:
+        telemetry.set_gauge("jit.retraces_after_first." + label, retraces)
+
+
+class _InstrumentedJit:
+    """Transparent proxy over a jit wrapper that turns executable-cache
+    growth into compile/retrace gauges and flight events.
+
+    The measured seconds are *compile-inclusive call* time (trace + compile
+    + that call's execution) — exactly the first-batch cost ``bench.py``
+    wants separated from steady-state throughput. A cache growth after the
+    first entry is recorded as a retrace: the runtime complement to
+    graphlint's static TPU002 (a wrapper that keeps retracing in production
+    is the bug TPU002 hunts in source). Attribute access (``.lower()``,
+    AOT plumbing) forwards to the wrapped wrapper untouched.
+    """
+
+    __slots__ = ("_fn", "_label")
+
+    def __init__(self, fn: Callable, label: str) -> None:
+        self._fn = fn
+        self._label = label
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if not _enabled and not telemetry.enabled():
+            return self._fn(*args, **kwargs)
+        size_before = _jit_cache_size(self._fn)
+        t0 = time.monotonic()
+        out = self._fn(*args, **kwargs)
+        seconds = time.monotonic() - t0
+        if size_before is None:
+            return out
+        size_after = _jit_cache_size(self._fn)
+        if size_after is not None and size_after > size_before:
+            retrace = size_before >= 1
+            _note_jit_compile(self._label, seconds, retrace)
+            event(
+                "jit.compile",
+                self._label,
+                meta={"seconds": round(seconds, 6), "cache_size": size_after},
+            )
+            if retrace:
+                event(
+                    "jit.retrace",
+                    self._label,
+                    meta={"seconds": round(seconds, 6), "cache_size": size_after},
+                )
+        return out
+
+
+def instrument_jit(fn: Callable, label: str) -> Callable:
+    """Wrap a jit callable so compiles/retraces surface as gauges + events.
+    Free when both flight and telemetry are disabled (one check, straight
+    call-through); idempotent (instrumenting twice returns the original)."""
+    if isinstance(fn, _InstrumentedJit):
+        return fn
+    return _InstrumentedJit(fn, label)
+
+
+def sample_device_gauges() -> None:
+    """Best-effort HBM gauge sample: where the backend exposes
+    ``Device.memory_stats()`` (TPU/GPU), record live and peak bytes as
+    telemetry gauges and one flight ``gauge`` event. CPU backends expose
+    nothing — this degrades to a silent no-op, never an error."""
+    if not _enabled and not telemetry.enabled():
+        return
+    try:
+        import jax
+
+        device = jax.devices()[0]
+        stats = device.memory_stats() if hasattr(device, "memory_stats") else None
+    except Exception:  # graphlint: ignore[PY001] -- backend boundary: an uninitialized/absent accelerator runtime must degrade to "no gauge", never break the study loop
+        return
+    if not stats:
+        return
+    live = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use", live)
+    if live is not None:
+        telemetry.set_gauge("hbm.live_bytes", float(live))
+    if peak is not None:
+        telemetry.set_gauge("hbm.peak_bytes", float(peak))
+        event("gauge", "hbm.peak_bytes", meta={"value": float(peak)})
+
+
+# ----------------------------------------------------------------- exports
+
+
+def events() -> list[FlightEvent]:
+    return _RECORDER.events()
+
+
+def snapshot() -> list[dict]:
+    """The ring's contents as JSON-able dicts, oldest first."""
+    return [ev.to_dict() for ev in _RECORDER.events()]
+
+
+def chrome_trace(event_list: Iterable[FlightEvent] | None = None) -> dict:
+    """Render events as Chrome trace-event JSON (the ``traceEvents`` array
+    format Perfetto and ``chrome://tracing`` load directly): spans become
+    complete ``"X"`` events, instants ``"i"``, gauges ``"C"`` counters.
+    Timestamps are wall-clock microseconds, so exports from the processes
+    of one study interleave correctly when concatenated."""
+    evs = _RECORDER.events() if event_list is None else list(event_list)
+    pid = os.getpid()
+    trace_events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"optuna-tpu[{_RECORDER.trace_id}]"},
+        }
+    ]
+    for ev in evs:
+        args: dict[str, Any] = {}
+        if ev.trace is not None:
+            args["trace_id"] = ev.trace
+        if ev.trial is not None:
+            args["trial"] = ev.trial
+        if ev.span is not None:
+            args["span_id"] = ev.span
+        if ev.parent is not None:
+            args["parent_span_id"] = ev.parent
+        if ev.meta:
+            args.update(ev.meta)
+        entry: dict[str, Any] = {
+            "name": ev.name,
+            "cat": ev.kind,
+            "pid": pid,
+            "tid": ev.tid,
+            "ts": round(ev.ts * 1e6, 3),
+        }
+        if ev.dur is not None:
+            entry["ph"] = "X"
+            entry["dur"] = round(ev.dur * 1e6, 3)
+            entry["args"] = args
+        elif ev.kind == "gauge":
+            entry["ph"] = "C"
+            entry["args"] = {"value": args.get("value", 0)}
+        else:
+            entry["ph"] = "i"
+            entry["s"] = "t"
+            entry["args"] = args
+        trace_events.append(entry)
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace_id": _RECORDER.trace_id, "pid": pid},
+    }
+
+
+# -------------------------------------------------------------- postmortem
+
+
+def last_postmortem_path() -> str | None:
+    return _last_postmortem_path
+
+
+def postmortem(reason: str, key: str | None = None) -> str | None:
+    """Flush the ring's tail (at most :data:`POSTMORTEM_TAIL` events) as one
+    bounded JSON file and return its path; None while disabled or when the
+    dedupe ``key`` already dumped. Best-effort by contract: a failing dump
+    must never mask the failure being dumped. Dumps land in
+    ``$OPTUNA_TPU_FLIGHT_DUMP_DIR`` (default: the system temp dir)."""
+    global _last_postmortem_path
+    if not _enabled:
+        return None
+    if key is not None:
+        if key in _postmortem_keys:
+            return None
+        _postmortem_keys.add(key)
+    try:
+        tail = _RECORDER.events()[-POSTMORTEM_TAIL:]
+        dump_dir = os.environ.get(_DUMP_DIR_ENV) or tempfile.gettempdir()
+        path = os.path.join(
+            dump_dir,
+            f"optuna-tpu-flight-{os.getpid()}-{next(_postmortem_seq)}.json",
+        )
+        payload = {
+            "reason": reason,
+            "captured_unix": time.time(),
+            "pid": os.getpid(),
+            "trace_id": _RECORDER.trace_id,
+            "n_events": len(tail),
+            "events": [ev.to_dict() for ev in tail],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        _RECORDER.record("postmortem", reason[:200], meta={"path": path})
+        _last_postmortem_path = path
+        return path
+    except Exception:  # graphlint: ignore[PY001] -- best-effort dump while unwinding a real failure: the original error must surface, a broken dump dir must not replace it
+        return None
+
+
+# The environment switch mirrors telemetry's: set before import, recording
+# is armed from trial zero.
+_env_cap = _env_capacity()
+if _env_cap is not None:
+    enable(capacity=_env_cap)
+del _env_cap

@@ -301,3 +301,17 @@ def gp_suggest_chain_fused(
 
     (_, _, _), (xs, vs) = jax.lax.scan(propose, (X, y, mask), jnp.arange(q))
     return xs, vs, raw
+
+
+# Compile/retrace gauges (optuna_tpu.flight): the fused programs are where
+# the GP path's XLA compile time lives, so their executable caches are the
+# ones worth watching — a cache growth after warmup is a retrace the static
+# TPU002 rule cannot see. The proxies forward .lower()/AOT plumbing to the
+# wrapped jit objects untouched and cost one check per dispatch when
+# recording is off.
+from optuna_tpu import flight as _flight  # noqa: E402 (gauge wiring below the kernels)
+
+gp_suggest_fused = _flight.instrument_jit(gp_suggest_fused, "gp.suggest_fused")
+gp_suggest_chain_fused = _flight.instrument_jit(
+    gp_suggest_chain_fused, "gp.suggest_chain_fused"
+)

@@ -41,7 +41,7 @@ from typing import TYPE_CHECKING, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, telemetry
+from optuna_tpu import _tracing, flight, telemetry
 from optuna_tpu.exceptions import OptunaTPUError, UpdateFinishedTrialError
 from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
@@ -306,7 +306,7 @@ class ResilientBatchExecutor:
         # batch — two span() blocks would double the count and halve the
         # apparent per-batch ask latency.
         ask_t0 = self._clock()
-        with _tracing.annotate(_TRACE_ASK):
+        with _tracing.annotate(_TRACE_ASK), flight.span("ask"):
             trials, proposals = self._ask_batch(b)
         ask_seconds = self._clock() - ask_t0
         try:
@@ -318,13 +318,23 @@ class ResilientBatchExecutor:
                 [t._trial_id for t in trials], study._storage
             ):
                 ask_t0 = self._clock()
-                with _tracing.annotate(_TRACE_ASK):
+                with _tracing.annotate(_TRACE_ASK), flight.span("ask"):
                     self._prepare_batch(trials, proposals)
                 telemetry.observe_phase(
                     "ask", ask_seconds + (self._clock() - ask_t0)
                 )
                 self._run_batch(trials)
         except Exception as err:  # graphlint: ignore[PY001] -- last-line containment sweep: whatever escaped between ask and tell must not leave trials RUNNING; the original error re-raises below. BaseException (worker death) punches through for heartbeat failover
+            # Terminal batch failure: everything survivable was already
+            # contained below this point, so an error landing here is about
+            # to surface to the caller — flush the flight recorder's tail
+            # first (one dump per run) so the chaos sequence that led here
+            # outlives the process. Watchdog DispatchTimeoutError and
+            # exhausted strike budgets funnel through this same spot.
+            flight.postmortem(
+                f"batch aborted: {type(err).__name__}: {err}"[:500],
+                key=f"executor:{self._run_token}",
+            )
             # Catch-all sweep over the batch: anything that escaped
             # the inner containment — the heartbeat's first beat, a
             # sampler raising mid-suggest, a user callback raising
@@ -344,6 +354,10 @@ class ResilientBatchExecutor:
                 )
             raise
         self._maybe_grow(len(trials), size_before)
+        # Batch-boundary HBM sample (no-op unless recording is on and the
+        # backend exposes memory stats): the high-water mark that tells an
+        # OOM postmortem how close to the cliff the healthy batches ran.
+        flight.sample_device_gauges()
         return len(trials)
 
     # ----------------------------------------------------------------- phases
@@ -493,6 +507,7 @@ class ResilientBatchExecutor:
                     EXECUTOR_ATTR_PREFIX + "dispatch",
                     {"batch": batch_tag, "slot": i},
                 )
+            flight.trial_event("ask", trial.number)
 
     def _needs_relative(self, trial: Trial) -> bool:
         """Would the lazy suggest path invoke ``sample_relative`` for this
@@ -539,7 +554,8 @@ class ResilientBatchExecutor:
         except Exception as err:  # graphlint: ignore[PY001] -- containment boundary: every dispatch error becomes FAIL tells (plus bisection/halving); BaseException (worker death, Ctrl-C) punches through for heartbeat failover
             self._contain(trials, err)
             return
-        with _tracing.annotate(_TRACE_TELL), telemetry.span("tell"):
+        with _tracing.annotate(_TRACE_TELL), telemetry.span("tell"), \
+                flight.span("tell"):
             self._tell_batch(trials, values, finite)
 
     def _eval(self, trials: list[Trial]) -> tuple[np.ndarray, np.ndarray]:
@@ -583,7 +599,8 @@ class ResilientBatchExecutor:
         return np.asarray(values), np.asarray(finite)
 
     def _dispatch(self, args: dict) -> tuple[np.ndarray, np.ndarray]:
-        with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"):
+        with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"), \
+                flight.span("dispatch"):
             if self._deadline_s is None:
                 return self._realize(args)
             return run_with_deadline(
@@ -815,5 +832,7 @@ class ResilientBatchExecutor:
         here, matching the serial loop's every-finished-trial contract. The
         caller passes the frozen trial its tell returned (already refetched
         post-commit), saving a storage round trip per notification."""
+        if flight.enabled():
+            flight.trial_event("tell", frozen.number, frozen.state.name)
         for callback in self._callbacks:
             callback(self._study, frozen)

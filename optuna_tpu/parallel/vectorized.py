@@ -84,6 +84,15 @@ class VectorizedObjective:
             )
         else:
             compiled = jax.jit(fn)  # graphlint: ignore[TPU002] -- memoized above: one wrapper per cache key for this objective's lifetime, not per call
+        # Compile/retrace gauges (optuna_tpu.flight): cache-size growth on
+        # this wrapper is a compile, growth after the first entry is a live
+        # retrace — the runtime witness for the memoization contract this
+        # method's docstring promises (and graphlint TPU002 checks
+        # statically). Free when flight+telemetry are both off.
+        from optuna_tpu import flight
+
+        label = "vectorized.guarded" if "guarded" in key else "vectorized.compiled"
+        compiled = flight.instrument_jit(compiled, label)
         self._compiled_cache[key] = compiled
         return compiled
 

@@ -16,7 +16,7 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, telemetry
+from optuna_tpu import _tracing, flight, telemetry
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.logging import get_logger
 from optuna_tpu.samplers._base import (
@@ -212,7 +212,7 @@ class GPSampler(BaseSampler):
     def infer_relative_search_space(
         self, study: "Study", trial: FrozenTrial
     ) -> dict[str, BaseDistribution]:
-        with _tracing.annotate(_TRACE_SPACE), telemetry.span("ask.search_space"):
+        with _tracing.annotate(_TRACE_SPACE), telemetry.span("ask.search_space"), flight.span("ask.search_space"):
             search_space = {}
             for name, distribution in self._intersection_search_space.calculate(
                 study
@@ -310,7 +310,7 @@ class GPSampler(BaseSampler):
             score = raw_vals if study.direction == StudyDirection.MAXIMIZE else -raw_vals
             y, _, _ = _standardize(score)
             Xc, yc, counts = collapse_duplicate_rows(X, y)
-            with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+            with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
                 state, raw_params = fit_gp(
                     Xc,
                     yc.astype(np.float32),
@@ -343,7 +343,7 @@ class GPSampler(BaseSampler):
             )
 
         extra = X[-min(len(X), 4):]  # warm-start local search at recent incumbents
-        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"):
+        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
             x_best, _ = optimize_acqf_mixed(
                 acqf_name,
                 data,
@@ -506,7 +506,7 @@ class GPSampler(BaseSampler):
         # packing (history collapse, starts, padding); the single device
         # program that fits AND proposes lands in "ask.propose" — the XLA
         # dispatch is indivisible by design, so the split is host/device.
-        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
                 study, space, X, trials, warm
             )
@@ -517,7 +517,7 @@ class GPSampler(BaseSampler):
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
         )
-        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"):
+        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
             out = self._aot_call(
                 self._exec_key(
                     dev, X.shape[1], Xp.shape[0], 0, starts.shape[0], fit_iters
@@ -551,7 +551,7 @@ class GPSampler(BaseSampler):
         from optuna_tpu.gp.optim_mixed import snap_steps
 
         dev = self._device_space(sig, space)
-        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             starts, Xp, yp, maskp, inc, n, fit_iters = self._fused_inputs(
                 study, space, X, trials, warm, pad_extra=q
             )
@@ -562,7 +562,7 @@ class GPSampler(BaseSampler):
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
         )
-        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"):
+        with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
             out = self._aot_call(
                 self._exec_key(
                     dev, X.shape[1], Xp.shape[0], q, starts.shape[0], fit_iters
@@ -703,7 +703,7 @@ class GPSampler(BaseSampler):
         states = []
         raws = []
         std_vals = np.empty_like(loss_vals, dtype=np.float32)
-        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             for k in range(M):
                 yk, _, _ = _standardize(loss_vals[:, k])
                 std_vals[:, k] = yk
@@ -748,7 +748,7 @@ class GPSampler(BaseSampler):
         cons = np.asarray(constraint_rows, dtype=np.float64)  # (n, C)
         states = []
         thresholds = []
-        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"):
+        with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             for k in range(cons.shape[1]):
                 yk, mu, sd = _standardize(cons[:, k])
                 st, _ = fit_gp(X, yk.astype(np.float32), is_cat, seed=seed + 101 + k)

@@ -48,7 +48,7 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import telemetry
+from optuna_tpu import flight, telemetry
 from optuna_tpu.distributions import BaseDistribution, CategoricalDistribution
 from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.samplers._base import BaseSampler
@@ -321,6 +321,14 @@ class GuardedSampler(BaseSampler):
                 f"recording sampler fallback attr {key!r} raised {attr_err!r}; "
                 "continuing with the fallback anyway."
             )
+        # First degrade per (wrapper, study) flushes the flight recorder's
+        # tail (no-op while flight is off): the events leading up to a
+        # broken fit — the history that poisoned it, the retries around it —
+        # are exactly what a post-hoc "why did the sampler degrade" asks.
+        flight.postmortem(
+            f"sampler degraded during {phase}: {reason}"[:500],
+            key=f"guarded_sampler:{self._warn_token}:{study._study_id}",
+        )
         if self._fallback == "raise":
             raise err
         warn_once(

@@ -38,6 +38,15 @@ WIRE_VERSION = 1
 # server exists, but a future wire change must bump WIRE_VERSION instead.
 OP_TOKEN_KEY = "__op_token"
 
+# Reserved kwarg carrying the flight recorder's trace-propagation context
+# (``{"t": trace_id, "s": span_id}``) on every RPC while the client has
+# flight recording enabled (off by default — the wire is unchanged for
+# recorders-off clients). The server strips it before invoking the storage
+# and tags its handler span with the client's ids, so a multi-worker study
+# renders as ONE timeline. Rides in kwargs beside the op token for the same
+# skew rationale documented above; a future wire change bumps WIRE_VERSION.
+FLIGHT_CTX_KEY = "__flight_ctx"
+
 
 class WireVersionError(RuntimeError):
     """Peer speaks an unknown wire version."""

@@ -9,10 +9,12 @@ from __future__ import annotations
 import uuid
 from typing import Any, Callable, Container, Sequence
 
-from optuna_tpu import telemetry
+from optuna_tpu import flight, telemetry
 from optuna_tpu.distributions import BaseDistribution
+from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
+    FLIGHT_CTX_KEY,
     OP_TOKEN_KEY,
     SERVICE_NAME,
     decode_response,
@@ -25,6 +27,8 @@ from optuna_tpu.study._study_direction import StudyDirection
 from optuna_tpu.trial._frozen import FrozenTrial
 from optuna_tpu.trial._state import TrialState
 
+
+_logger = get_logger(__name__)
 
 # Wire-protocol constant: the RPCs that carry a client-minted dedupe op
 # token. Deliberately a literal, NOT an import of
@@ -78,6 +82,11 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
         self._port = port
         self._channel = None
         self._retry_policy = retry_policy if retry_policy is not None else _default_retry_policy()
+        # Set when the server proves it predates FLIGHT_CTX_KEY (it forwarded
+        # the kwarg into the storage and got a TypeError): trace propagation
+        # is observability, so it degrades to client-side-only spans instead
+        # of failing every op against an older hub.
+        self._flight_ctx_unsupported = False
         self._setup()
 
     def _setup(self) -> None:
@@ -115,6 +124,14 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
             # every replay carries the same token and the server's dedupe
             # cache collapses them into one execution.
             kwargs = {**kwargs, OP_TOKEN_KEY: uuid.uuid4().hex}
+        flight_ctx = None
+        if flight.enabled() and not self._flight_ctx_unsupported:
+            # Trace propagation rides beside the op token: one span id per
+            # *logical* op (replays reuse it — they ARE the same op), so the
+            # server's handler span parents onto exactly this client span
+            # and a fleet of workers stitches into one trace id.
+            flight_ctx = flight.rpc_context()
+            kwargs = {**kwargs, FLIGHT_CTX_KEY: flight_ctx}
         request = encode_request(method, args, kwargs)
 
         def once() -> bytes:
@@ -141,7 +158,7 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
 
         # One logical RPC = one storage.op span (transport retries, re-dials
         # and backoff included): the latency the study loop actually waits.
-        with telemetry.span("storage.op"):
+        with telemetry.span("storage.op"), flight.rpc_span("client", method, flight_ctx):
             raw = self._retry_policy.call(
                 once,
                 describe=f"gRPC {method} to {self._host}:{self._port}",
@@ -149,6 +166,30 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
                 on_retry=lambda err, attempt, delay: self._reconnect(),
             )
         ok, payload = decode_response(raw)
+        if (
+            not ok
+            and flight_ctx is not None
+            and isinstance(payload, TypeError)
+            and FLIGHT_CTX_KEY in str(payload)
+        ):
+            # A pre-flight-recorder server forwarded the propagation kwarg
+            # into its storage call. The op itself never ran (the TypeError
+            # is raised binding the arguments), so replaying WITHOUT the
+            # kwarg is safe — including for op-token methods, whose token is
+            # preserved in the re-encoded kwargs. Downgrade this proxy to
+            # client-side-only spans for the rest of its life.
+            self._flight_ctx_unsupported = True
+            _logger.warning(
+                f"server at {self._host}:{self._port} predates flight-trace "
+                "propagation; continuing with client-side spans only."
+            )
+            # kwargs was rebound above: strip both injected wire kwargs so
+            # the replay re-mints a fresh op token (the failed attempt never
+            # bound its arguments, so nothing was executed or recorded).
+            clean = {
+                k: v for k, v in kwargs.items() if k not in (OP_TOKEN_KEY, FLIGHT_CTX_KEY)
+            }
+            return self._call(method, *args, **clean)
         if not ok:
             raise payload
         return payload

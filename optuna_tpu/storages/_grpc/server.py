@@ -12,10 +12,11 @@ from collections import OrderedDict
 from concurrent import futures
 from typing import TYPE_CHECKING
 
-from optuna_tpu import telemetry
+from optuna_tpu import flight, telemetry
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
+    FLIGHT_CTX_KEY,
     METHODS,
     OP_TOKEN_KEY,
     SERVICE_NAME,
@@ -64,6 +65,9 @@ def _make_handler(storage: BaseStorage):
             return encode_response(False, ValueError(f"Malformed request: {e}"))
         if method_name not in METHODS:
             return encode_response(False, ValueError(f"Unknown method {method_name!r}"))
+        # Always stripped (the storage must never see the wire-plumbing
+        # kwarg); only *used* when this server records flight events.
+        flight_ctx = kwargs.pop(FLIGHT_CTX_KEY, None) if isinstance(kwargs, dict) else None
         op_token = kwargs.pop(OP_TOKEN_KEY, None) if isinstance(kwargs, dict) else None
         if op_token is not None:
             while True:
@@ -93,7 +97,11 @@ def _make_handler(storage: BaseStorage):
             return encode_response(True, _HEARTBEAT_DEFAULTS[method_name])
         response = error_response = None
         try:
-            result = getattr(storage, method_name)(*args, **kwargs)
+            # The handler span carries the *client's* trace/span ids (when it
+            # sent them), so client timeline and server timeline stitch into
+            # one trace even across machines.
+            with flight.rpc_span("server", method_name, flight_ctx):
+                result = getattr(storage, method_name)(*args, **kwargs)
             response = encode_response(True, result)
         except Exception as e:  # graphlint: ignore[PY001] -- exceptions ride the wire: every storage error is encoded and re-raised client-side, not handled here
             # Failures are NOT recorded: a retry after an app-level error
@@ -154,9 +162,11 @@ def run_grpc_proxy_server(
 
     ``metrics_port`` additionally serves the process's telemetry registry
     over HTTP (``/metrics`` Prometheus text, ``/metrics.json`` snapshot —
-    :func:`optuna_tpu.telemetry.serve_metrics`) and turns recording on: the
-    storage hub is where op-token dedup hits and server-side storage
-    latencies live, and a fleet scraper watches it without touching workers.
+    :func:`optuna_tpu.telemetry.serve_metrics`) and turns recording on —
+    metrics AND the flight recorder, whose Chrome-trace export is served at
+    ``/trace.json`` beside them: the storage hub is where op-token dedup
+    hits, server-side storage latencies live, and every worker's trace ids
+    cross, so this one endpoint stitches a fleet's timeline.
     """
     import signal
 
@@ -164,8 +174,10 @@ def run_grpc_proxy_server(
     metrics_server = None
     if metrics_port is not None:
         telemetry.enable()
+        flight.enable()
         metrics_server = telemetry.serve_metrics(metrics_port, host=host)
         _logger.info(f"Telemetry endpoint at http://{host}:{metrics_port}/metrics")
+        _logger.info(f"Flight-trace endpoint at http://{host}:{metrics_port}/trace.json")
     server.start()
     _logger.info(f"Server started at {host}:{port}")
     _logger.info("Listening...")

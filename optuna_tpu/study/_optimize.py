@@ -21,7 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
-from optuna_tpu import exceptions, logging as logging_module, telemetry
+from optuna_tpu import exceptions, flight, logging as logging_module, telemetry
 from optuna_tpu.progress_bar import _ProgressBar
 from optuna_tpu.study._tell import _tell_with_warning
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -39,6 +39,12 @@ _logger = logging_module.get_logger(__name__)
 _TRACE_ASK = telemetry.trace_name("ask")
 _TRACE_DISPATCH = telemetry.trace_name("dispatch")
 _TRACE_TELL = telemetry.trace_name("tell")
+# Lazy per-trial annotation: the %-format + arg form of _tracing.annotate
+# formats ONLY when a trace is active, so the disabled path builds no
+# per-trial string (it used to f-string this name every trial regardless).
+# A plain literal, not trace_name(): the per-trial marker is a timeline
+# grouping aid, deliberately outside the phase vocabulary.
+_TRACE_TRIAL_FMT = "optuna_tpu.trial.%d"
 
 
 class _RunBudget:
@@ -143,17 +149,20 @@ def _execute_one(
     if is_heartbeat_enabled(study._storage):
         fail_stale_trials(study)
 
-    with _tracing.annotate(_TRACE_ASK), telemetry.span("ask"):
+    with _tracing.annotate(_TRACE_ASK), telemetry.span("ask"), flight.span("ask"):
         trial = study.ask()
+    flight.trial_event("ask", trial.number)
     with get_heartbeat_thread(trial._trial_id, study._storage):
-        with _tracing.annotate(f"optuna_tpu.trial.{trial.number}"):
-            with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"):
+        with _tracing.annotate(_TRACE_TRIAL_FMT, trial.number):
+            with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"), \
+                    flight.span("dispatch", trial.number):
                 outcome = _call_objective(func, trial)
 
     # Misbehaving objectives (wrong arity, NaNs, non-floats) downgrade to
     # warnings via _tell_with_warning rather than aborting the whole loop.
     try:
-        with _tracing.annotate(_TRACE_TELL), telemetry.span("tell"):
+        with _tracing.annotate(_TRACE_TELL), telemetry.span("tell"), \
+                flight.span("tell", trial.number):
             frozen = _tell_with_warning(
                 study=study,
                 trial=trial,
@@ -164,6 +173,8 @@ def _execute_one(
     except Exception:  # graphlint: ignore[PY001] -- announce-then-reraise: nothing is swallowed, the trial's terminal state is logged on every failure flavor
         _announce(study, study._storage.get_trial(trial._trial_id), outcome)
         raise
+    if flight.enabled():
+        flight.trial_event("tell", frozen.number, frozen.state.name)
     _announce(study, frozen, outcome)
 
     swallowed = outcome.error is not None and isinstance(outcome.error, catch)

@@ -374,6 +374,20 @@ class Study:
 
         return telemetry.snapshot()
 
+    def trace_snapshot(self) -> dict[str, Any]:
+        """The flight recorder's timeline as Chrome trace-event JSON (load
+        it in Perfetto / ``chrome://tracing``): per-trial ask/dispatch/tell
+        spans, containment events, compile/retrace gauges and gRPC
+        client/server spans, all on the telemetry phase vocabulary. Enable
+        recording with ``OPTUNA_TPU_FLIGHT=1`` or ``flight.enable()`` —
+        while disabled the export carries no events, not an error.
+        Process-wide like :meth:`telemetry_snapshot`, and samples the
+        device's HBM gauges once before exporting."""
+        from optuna_tpu import flight
+
+        flight.sample_device_gauges()
+        return flight.chrome_trace()
+
     def stop(self) -> None:
         """Request loop exit after the current trial (reference ``study.py:1033``)."""
         if not self._thread_local.in_optimize_loop:

@@ -26,8 +26,9 @@ metrics registry every layer reports into:
   server's ``metrics_port``), and the ``optuna-tpu metrics`` CLI dump.
 
 Overhead contract (mirrors ``_tracing.annotate``): telemetry is **off** by
-default, and the disabled hot path is one module-global check — ``count``
-returns immediately and ``span`` returns a shared singleton null context, so
+default, and the disabled hot path is module-global checks only — ``count``
+returns immediately (after offering the event to the flight recorder's sink
+when one is hooked) and ``span`` returns a shared singleton null context, so
 a disabled study loop allocates nothing per trial on this module's account
 (asserted by ``tests/test_telemetry.py``). Instrumentation lives strictly
 host-side: graphlint rule **OBS001** forbids telemetry/logging calls inside
@@ -291,6 +292,18 @@ def _prom_name(name: str) -> str:
 _REGISTRY = MetricsRegistry()
 _enabled = bool(os.environ.get("OPTUNA_TPU_TELEMETRY"))
 
+#: Optional event sink the flight recorder (:mod:`optuna_tpu.flight`) hooks
+#: into :func:`count`: every containment counter increment also lands as an
+#: ordered timeline event, with zero new instrumentation at the call sites
+#: and zero drift risk between the two surfaces. None (the default) keeps
+#: the disabled hot path at module-global checks with no allocations.
+_count_sink: Callable[[str, int], None] | None = None
+
+
+def _set_count_sink(sink: Callable[[str, int], None] | None) -> None:
+    global _count_sink
+    _count_sink = sink
+
 
 def get_registry() -> MetricsRegistry:
     return _REGISTRY
@@ -315,9 +328,14 @@ def disable() -> None:
 
 
 def count(name: str, n: int = 1) -> None:
-    """Increment a containment counter; a no-op (one global check, zero
-    allocations) while telemetry is disabled. ``name`` is a
-    :data:`COUNTERS` family, optionally suffixed (``sampler.fallback.relative``)."""
+    """Increment a containment counter; a no-op (module-global checks, zero
+    allocations) while both telemetry and the flight-recorder sink are
+    disabled. ``name`` is a :data:`COUNTERS` family, optionally suffixed
+    (``sampler.fallback.relative``). A hooked sink (the flight recorder)
+    receives every event even while the metrics registry itself is off —
+    the two surfaces are independently switchable, one vocabulary."""
+    if _count_sink is not None:
+        _count_sink(name, n)
     if not _enabled:
         return
     _REGISTRY.inc(name, n)
@@ -387,9 +405,11 @@ def phase_totals(snap: Mapping | None = None) -> dict[str, dict[str, float]]:
 def serve_metrics(port: int, host: str = "localhost"):
     """Serve the registry over HTTP on a daemon thread and return the server
     (call ``.shutdown()`` to stop it). Endpoints: ``/metrics`` (Prometheus
-    text) and ``/metrics.json`` (the :func:`snapshot` dict). Stdlib-only;
-    used by the gRPC proxy server's ``metrics_port=`` knob so a fleet
-    scraper can watch the storage hub without extra dependencies."""
+    text), ``/metrics.json`` (the :func:`snapshot` dict), and
+    ``/trace.json`` (the flight recorder's Chrome-trace export — empty
+    ``traceEvents`` while flight recording is off). Stdlib-only; used by
+    the gRPC proxy server's ``metrics_port=`` knob so a fleet scraper can
+    watch the storage hub without extra dependencies."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
@@ -399,6 +419,11 @@ def serve_metrics(port: int, host: str = "localhost"):
                 content_type = "text/plain; version=0.0.4; charset=utf-8"
             elif self.path.split("?")[0] == "/metrics.json":
                 body = json.dumps(snapshot()).encode()
+                content_type = "application/json"
+            elif self.path.split("?")[0] == "/trace.json":
+                from optuna_tpu import flight
+
+                body = json.dumps(flight.chrome_trace()).encode()
                 content_type = "application/json"
             else:
                 self.send_error(404)

@@ -179,6 +179,27 @@ class FaultInjectorStorage(_ForwardingStorage):
         return None
 
 
+# Acceptance matrix for the flight recorder's event kinds: every kind the
+# recorder accepts (``flight.py::EVENT_KINDS``) maps to the scenario
+# ``tests/test_flight.py`` / ``tests/test_flight_chaos.py`` must exercise
+# against it. Deliberately a hand-written literal (not an import of
+# ``flight.EVENT_KINDS``): graphlint rule OBS002 cross-checks both against
+# ``_lint/registry.py::FLIGHT_EVENT_REGISTRY`` — adding an event kind
+# without deciding how to prove it fires is a lint failure (the
+# STO001/EXE001/SMP001 pattern).
+FLIGHT_EVENT_CHAOS_MATRIX: dict[str, str] = {
+    "phase": "fault-free study; ask/dispatch/tell spans recorded per trial/batch",
+    "trial": "fault-free study; one ask + one tell instant per trial, numbered",
+    "containment": "NaN slot + crash + storage blip; events match the plan in order",
+    "rpc.client": "flight-enabled proxy client; every RPC records a client span",
+    "rpc.server": "two-process study; server handler spans carry the client trace id",
+    "jit.compile": "first vectorized dispatch grows the jit cache; compile event + gauge",
+    "jit.retrace": "a second batch shape grows the cache again; retrace event + gauge",
+    "gauge": "device-gauge sample records HBM stats where the backend exposes them",
+    "postmortem": "terminal batch failure / sampler degrade flushes a bounded dump",
+}
+
+
 # ----------------------------------------------------- device-dispatch chaos
 
 

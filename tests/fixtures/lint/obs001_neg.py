@@ -2,7 +2,7 @@
 import jax
 import jax.numpy as jnp
 
-from optuna_tpu import telemetry
+from optuna_tpu import flight, telemetry
 from optuna_tpu.logging import get_logger, warn_once
 
 _logger = get_logger(__name__)
@@ -17,11 +17,17 @@ def clean_kernel(x):
 def host_dispatch(x):
     # Instrumentation AROUND the dispatch is the sanctioned pattern.
     telemetry.count("executor.quarantine")
-    with telemetry.span("dispatch"):
+    with telemetry.span("dispatch"), flight.span("dispatch"):
         result = clean_kernel(x)
+    flight.trial_event("tell", 0)
     _logger.warning("host-side logging is fine")
     warn_once(_logger, "key", "host-side warn_once is fine")
     return result
+
+
+# Module-level gauge wiring (the gp/fused.py pattern) runs at import time on
+# the host — not a traced scope, nothing to flag.
+instrumented = flight.instrument_jit(clean_kernel, "fixture.clean")
 
 
 def host_loop(x):

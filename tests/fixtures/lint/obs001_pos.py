@@ -2,7 +2,7 @@
 import jax
 import jax.numpy as jnp
 
-from optuna_tpu import telemetry
+from optuna_tpu import flight, telemetry
 from optuna_tpu.logging import get_logger, warn_once
 
 _logger = get_logger(__name__)
@@ -12,6 +12,14 @@ _logger = get_logger(__name__)
 def bad_counter_in_jit(x):
     telemetry.count("executor.quarantine")  # EXPECT: OBS001
     with telemetry.span("dispatch"):  # EXPECT: OBS001
+        y = x * 2
+    return y
+
+
+@jax.jit
+def bad_flight_in_jit(x):
+    flight.trial_event("ask", 0)  # EXPECT: OBS001
+    with flight.span("dispatch"):  # EXPECT: OBS001
         y = x * 2
     return y
 

@@ -1,0 +1,496 @@
+"""Flight recorder unit tests (ISSUE 8): ring semantics, the zero-cost
+disabled contract, vocabulary sync (event kinds + phase names), the
+compile/retrace jit gauges, lazy profiler annotations, and the delivery
+surfaces (Chrome trace export / HTTP endpoint / CLI / Study.trace_snapshot).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import re
+import sys
+import urllib.request
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import _tracing, flight, telemetry
+from optuna_tpu._lint import registry as lint_registry
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.testing.fault_injection import FLIGHT_EVENT_CHAOS_MATRIX
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "optuna_tpu")
+
+#: Chrome trace-event phases the exporter may emit (trace-event format spec).
+_ALLOWED_PH = {"X", "i", "C", "M"}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_recorder():
+    """Each test gets a fresh recorder + registry and leaves both disabled."""
+    saved_recorder = flight.get_recorder()
+    saved_flight = flight.enabled()
+    saved_registry = telemetry.get_registry()
+    saved_telemetry = telemetry.enabled()
+    flight.enable(flight.FlightRecorder(capacity=512))
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_telemetry:
+        telemetry.disable()
+    flight.enable(saved_recorder)
+    if not saved_flight:
+        flight.disable()
+
+
+# ---------------------------------------------------------------- recorder
+
+
+def test_ring_is_bounded():
+    recorder = flight.FlightRecorder(capacity=16)
+    flight.enable(recorder)
+    for i in range(100):
+        flight.event("trial", "ask", trial=i)
+    evs = recorder.events()
+    assert len(evs) == 16
+    # Oldest evicted first: the tail survives.
+    assert [e.trial for e in evs] == list(range(84, 100))
+
+
+def test_record_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown flight event kind"):
+        flight.get_recorder().record("made-up-kind", "x")
+
+
+def test_span_records_duration_with_injected_clock():
+    ticks = iter([100.0, 100.5])  # enter + exit (epoch passed explicitly)
+    recorder = flight.FlightRecorder(clock=lambda: next(ticks), epoch=0.0)
+    flight.enable(recorder)
+    with flight.span("ask", 7):
+        pass
+    (ev,) = recorder.events()
+    assert ev.kind == "phase" and ev.name == "ask" and ev.trial == 7
+    assert ev.dur == pytest.approx(0.5)
+    assert ev.ts == pytest.approx(100.0)
+    assert ev.trace == recorder.trace_id and ev.span
+
+
+def test_containment_counters_land_as_events_via_the_sink():
+    """Every telemetry.count call site doubles as a timeline event with no
+    per-site instrumentation — the sink hook IS the anti-drift mechanism."""
+    telemetry.count("executor.quarantine")
+    telemetry.count("sampler.fallback.relative", 3)
+    events = [e for e in flight.events() if e.kind == "containment"]
+    assert [(e.name, e.meta) for e in events] == [
+        ("executor.quarantine", None),
+        ("sampler.fallback.relative", {"n": 3}),
+    ]
+    # ...and the counters themselves still incremented normally.
+    assert telemetry.snapshot()["counters"]["sampler.fallback.relative"] == 3
+
+
+def test_sink_records_even_while_telemetry_registry_is_off():
+    telemetry.disable()
+    telemetry.count("storage.retry")
+    assert [e.name for e in flight.events() if e.kind == "containment"] == [
+        "storage.retry"
+    ]
+    telemetry.enable(telemetry.get_registry())
+    assert telemetry.snapshot()["counters"] == {}
+
+
+# ------------------------------------------------------- disabled-path cost
+
+
+def test_disabled_is_inert_and_span_is_a_shared_singleton():
+    flight.disable()
+    assert flight.span("ask") is flight.span("tell")
+    with flight.span("ask", 1):
+        pass
+    flight.trial_event("ask", 1)
+    flight.event("gauge", "hbm.peak_bytes", meta={"value": 1})
+    telemetry.count("storage.retry")  # sink unhooked by disable()
+    assert flight.events() == []
+
+
+def test_disabled_hot_path_allocates_no_per_trial_objects():
+    """The overhead contract (the telemetry spine's, extended): with flight
+    off, the per-trial span + lifecycle-event + counter sequence must not
+    grow the heap over 10k trials — bounded constant, not O(trials)."""
+    flight.disable()
+    telemetry.disable()
+
+    def hot_trial(number):
+        with flight.span("ask"):
+            pass
+        flight.trial_event("ask", number)
+        with flight.span("dispatch", number):
+            pass
+        with flight.span("tell", number):
+            pass
+        telemetry.count("storage.retry")
+        with _tracing.annotate("optuna_tpu.trial.%d", number):
+            pass
+
+    for i in range(200):  # warm free lists / caches
+        hot_trial(i)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for i in range(10_000):
+        hot_trial(i)
+    gc.collect()
+    after = sys.getallocatedblocks()
+    assert after - before < 500
+
+
+# -------------------------------------------------------------- vocabulary
+
+
+def test_event_kind_vocabulary_matches_canonical_registry_and_chaos_matrix():
+    assert flight.EVENT_KINDS == lint_registry.FLIGHT_EVENT_REGISTRY
+    assert set(FLIGHT_EVENT_CHAOS_MATRIX) == set(flight.EVENT_KINDS)
+
+
+def _package_sources():
+    for root, _, files in os.walk(PKG):
+        for name in files:
+            if name.endswith(".py"):
+                path = os.path.join(root, name)
+                with open(path, encoding="utf-8") as f:
+                    yield path, f.read()
+
+
+def test_flight_span_call_sites_use_the_phase_vocabulary():
+    """Every flight.span literal in the package must be a registered
+    telemetry phase — the recorder's spans, the metrics histograms and the
+    profiler annotations are one vocabulary by contract."""
+    span_re = re.compile(r"flight\.span\(\s*\"([^\"]+)\"")
+    seen = set()
+    for path, source in _package_sources():
+        if path.endswith("flight.py") or os.sep + "_lint" + os.sep in path:
+            continue
+        seen.update(span_re.findall(source))
+    assert seen, "expected flight.span call sites in the package"
+    unknown = seen - set(telemetry.PHASES)
+    assert not unknown, f"flight.span names outside telemetry.PHASES: {unknown}"
+
+
+# ------------------------------------------------------------- jit gauges
+
+
+def test_instrument_jit_counts_compiles_and_retraces():
+    import jax
+    import jax.numpy as jnp
+
+    wrapped = flight.instrument_jit(jax.jit(lambda x: x * 2), "test.double")
+    assert flight.instrument_jit(wrapped, "again") is wrapped  # idempotent
+    wrapped(jnp.zeros(4))  # first shape: compile
+    wrapped(jnp.zeros(4))  # cache hit
+    wrapped(jnp.zeros(8))  # second shape: retrace-after-first
+    compiles = [e for e in flight.events() if e.kind == "jit.compile"]
+    retraces = [e for e in flight.events() if e.kind == "jit.retrace"]
+    assert len(compiles) == 2
+    assert len(retraces) == 1
+    assert all(e.name == "test.double" for e in compiles + retraces)
+    assert all(e.meta["seconds"] >= 0 for e in compiles)
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["jit.compiles.test.double"] == 2
+    assert gauges["jit.retraces_after_first.test.double"] == 1
+    assert gauges["jit.compile_seconds.test.double"] > 0
+
+
+def test_instrument_jit_is_a_transparent_proxy_when_disabled():
+    import jax
+    import jax.numpy as jnp
+
+    flight.disable()
+    telemetry.disable()
+    inner = jax.jit(lambda x: x + 1)
+    wrapped = flight.instrument_jit(inner, "test.inc")
+    assert float(wrapped(jnp.asarray(1.0))) == 2.0
+    # Attribute access forwards (the AOT path calls .lower on the wrapper).
+    assert wrapped.lower(jnp.zeros(2)) is not None
+    telemetry.enable(telemetry.get_registry())
+    assert telemetry.snapshot()["gauges"] == {}
+
+
+def test_sample_device_gauges_never_raises():
+    # CPU backends expose no memory stats: a silent no-op, not an error.
+    flight.sample_device_gauges()
+
+
+# ------------------------------------------------------- lazy annotations
+
+
+def test_annotate_lazy_forms_do_not_format_when_inactive():
+    class Explosive:
+        def __mod__(self, other):
+            raise AssertionError("formatted while tracing is inactive")
+
+    assert not _tracing.is_tracing()
+    with _tracing.annotate(Explosive(), 3):
+        pass
+    with _tracing.annotate((Explosive(), (3,))):
+        pass
+    with _tracing.annotate(lambda: 1 / 0):
+        pass
+    # The inactive path hands back one shared null context.
+    assert _tracing.annotate("a") is _tracing.annotate("b")
+
+
+def test_annotate_lazy_forms_format_when_active(monkeypatch):
+    names = []
+
+    class _FakeAnnotation:
+        def __init__(self, name):
+            names.append(name)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return None
+
+    class _FakeProfiler:
+        TraceAnnotation = _FakeAnnotation
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    monkeypatch.setattr(_tracing, "_active", True)
+    with _tracing.annotate("plain"):
+        pass
+    with _tracing.annotate("optuna_tpu.trial.%d", 5):
+        pass
+    with _tracing.annotate(("optuna_tpu.trial.%d", 7)):
+        pass
+    with _tracing.annotate(lambda: "lazy-callable"):
+        pass
+    assert names == [
+        "plain", "optuna_tpu.trial.5", "optuna_tpu.trial.7", "lazy-callable"
+    ]
+
+
+# ---------------------------------------------------------------- exports
+
+
+def _validate_chrome_trace(data: dict) -> None:
+    """Structural validation against the Chrome trace-event format: the
+    required per-event keys, legal ph codes, numeric microsecond
+    timestamps, durations on complete events."""
+    assert isinstance(data["traceEvents"], list)
+    for entry in data["traceEvents"]:
+        assert set(entry) >= {"name", "ph", "pid", "tid"}, entry
+        assert entry["ph"] in _ALLOWED_PH, entry
+        assert isinstance(entry["pid"], int) and isinstance(entry["tid"], int)
+        if entry["ph"] != "M":
+            assert isinstance(entry["ts"], (int, float)), entry
+        if entry["ph"] == "X":
+            assert entry["dur"] >= 0
+        if entry["ph"] == "i":
+            assert entry.get("s") in ("t", "p", "g")
+        if entry["ph"] == "C":
+            assert all(
+                isinstance(v, (int, float)) for v in entry["args"].values()
+            ), entry
+
+
+def test_chrome_trace_export_is_schema_valid_and_ordered():
+    with flight.span("ask", 0):
+        pass
+    flight.trial_event("ask", 0)
+    flight.event("gauge", "hbm.peak_bytes", meta={"value": 123.0})
+    telemetry.count("executor.quarantine")
+    data = flight.chrome_trace()
+    json.dumps(data)  # JSON-serializable end to end
+    _validate_chrome_trace(data)
+    phs = [e["ph"] for e in data["traceEvents"]]
+    assert phs.count("X") == 1 and phs.count("C") == 1 and phs.count("i") == 2
+    assert data["otherData"]["trace_id"] == flight.trace_id()
+
+
+def test_study_trace_snapshot_round_trips():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=4)
+    data = study.trace_snapshot()
+    _validate_chrome_trace(data)
+    by_name = {}
+    for entry in data["traceEvents"]:
+        by_name.setdefault(entry["name"], []).append(entry)
+    for phase in ("ask", "dispatch", "tell"):
+        spans = [e for e in by_name[phase] if e["ph"] == "X"]
+        assert len(spans) == 4, phase
+    # dispatch/tell spans carry their trial number for per-trial filtering.
+    dispatch_trials = sorted(
+        e["args"]["trial"] for e in by_name["dispatch"] if e["ph"] == "X"
+    )
+    assert dispatch_trials == [0, 1, 2, 3]
+
+
+def test_trace_json_endpoint_beside_metrics():
+    with flight.span("ask", 0):
+        pass
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        data = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/trace.json", timeout=10
+            ).read().decode()
+        )
+        _validate_chrome_trace(data)
+        assert any(e.get("name") == "ask" for e in data["traceEvents"])
+    finally:
+        server.shutdown()
+
+
+def test_cli_trace_smoke_emits_valid_chrome_json(capsys, tmp_path):
+    from optuna_tpu.cli import main as cli_main
+
+    with flight.span("ask", 0):
+        pass
+    assert cli_main(["trace", "--format=chrome"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    _validate_chrome_trace(data)
+    assert any(e.get("name") == "ask" for e in data["traceEvents"])
+    # --output writes the file and prints its path.
+    out_file = tmp_path / "trace.json"
+    assert cli_main(["trace", "--format=chrome", "-o", str(out_file)]) == 0
+    assert capsys.readouterr().out.strip() == str(out_file)
+    _validate_chrome_trace(json.loads(out_file.read_text()))
+    # Raw events format.
+    assert cli_main(["trace", "--format=events"]) == 0
+    events = json.loads(capsys.readouterr().out)
+    assert isinstance(events, list) and events[0]["kind"] == "phase"
+    # --endpoint with a non-chrome format is a loud usage error.
+    assert cli_main(["trace", "--format=events", "--endpoint", "http://x"]) == 2
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("", None), ("0", None), ("false", None), ("FALSE", None),
+        ("no", None), ("off", None), ("-3", None),
+        ("1", flight.DEFAULT_CAPACITY), ("true", flight.DEFAULT_CAPACITY),
+        ("yes", flight.DEFAULT_CAPACITY), ("64", 64),
+    ],
+)
+def test_env_capacity_parse(raw, expected, monkeypatch):
+    """Explicit disable spellings must NOT arm the recorder the operator
+    just opted out of; ints size the ring; other truthy values default."""
+    monkeypatch.setenv("OPTUNA_TPU_FLIGHT", raw)
+    assert flight._env_capacity() == expected
+
+
+def test_jit_gauges_aggregate_across_proxies_sharing_a_label():
+    """Two wrappers under one label (every VectorizedObjective mints its own
+    guarded wrapper as 'vectorized.guarded') must SUM into the label's
+    gauges, not clobber each other last-writer-wins."""
+    import jax
+    import jax.numpy as jnp
+
+    a = flight.instrument_jit(jax.jit(lambda x: x * 2), "test.shared")
+    b = flight.instrument_jit(jax.jit(lambda x: x * 3), "test.shared")
+    a(jnp.zeros(4))  # compile #1
+    b(jnp.zeros(4))  # compile #2, different proxy, same label
+    gauges = telemetry.snapshot()["gauges"]
+    base = gauges["jit.compiles.test.shared"]
+    assert base >= 2  # totals are process-lifetime; both compiles counted
+    a(jnp.zeros(8))  # retrace on proxy a
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["jit.compiles.test.shared"] == base + 1
+
+
+def test_env_switch_arms_recording_from_import(tmp_path):
+    """OPTUNA_TPU_FLIGHT=<capacity> arms the recorder before any study code
+    runs — the quickstart's zero-code-change enablement."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["OPTUNA_TPU_FLIGHT"] = "64"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from optuna_tpu import flight; "
+         "print(flight.enabled(), flight.get_recorder().capacity)"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.split() == ["True", "64"]
+
+
+# -------------------------------------------------------------- postmortem
+
+
+def test_postmortem_dump_is_bounded_json_with_dedupe(tmp_path, monkeypatch):
+    monkeypatch.setenv("OPTUNA_TPU_FLIGHT_DUMP_DIR", str(tmp_path))
+    for i in range(600):
+        flight.trial_event("ask", i)
+    path = flight.postmortem("test failure", key="k1")
+    assert path is not None and os.path.dirname(path) == str(tmp_path)
+    assert flight.last_postmortem_path() == path
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "test failure"
+    assert payload["trace_id"] == flight.trace_id()
+    assert payload["n_events"] == len(payload["events"]) <= flight.POSTMORTEM_TAIL
+    # Same key: no second dump. New key: dumps again.
+    assert flight.postmortem("again", key="k1") is None
+    assert flight.postmortem("again", key="k2") is not None
+    # The dump itself landed on the timeline.
+    assert [e.name for e in flight.events() if e.kind == "postmortem"] == [
+        "test failure", "again"
+    ]
+
+
+def test_postmortem_disabled_returns_none(tmp_path, monkeypatch):
+    monkeypatch.setenv("OPTUNA_TPU_FLIGHT_DUMP_DIR", str(tmp_path))
+    flight.disable()
+    assert flight.postmortem("nope") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------- trajectory provenance
+
+
+def test_bench_trajectory_stamps_git_provenance(tmp_path):
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench_trajectory
+    finally:
+        sys.path.pop(0)
+    prov = bench_trajectory.git_provenance()
+    if prov is None:
+        pytest.skip("no git repo / git binary in this environment")
+    assert re.fullmatch(r"[0-9a-f]{40}", prov["sha"])
+    assert isinstance(prov.get("dirty"), bool) or "dirty" not in prov
+    entry = bench_trajectory.append_entry(
+        {"metric": "m", "platform": "cpu", "value": 1.0, "vs_baseline": None,
+         "compile": {"count": 1, "seconds": 0.5, "retraces_after_first": 0},
+         "steady_state_trials_per_sec": 2.0},
+        mode="quick",
+        path=str(tmp_path / "traj.json"),
+    )
+    assert entry["git"]["sha"] == prov["sha"]
+    assert entry["compile"]["seconds"] == 0.5
+    assert entry["steady_state_trials_per_sec"] == 2.0
+
+
+def test_bench_trajectory_tolerates_absent_git(tmp_path, monkeypatch):
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench_trajectory
+    finally:
+        sys.path.pop(0)
+    assert bench_trajectory.git_provenance(str(tmp_path)) is None
+    monkeypatch.setattr(bench_trajectory, "git_provenance", lambda *a: None)
+    entry = bench_trajectory.append_entry(
+        {"metric": "m", "platform": "cpu", "value": 1.0, "vs_baseline": None},
+        mode="quick",
+        path=str(tmp_path / "traj.json"),
+    )
+    assert "git" not in entry

@@ -1,0 +1,315 @@
+"""Flight-recorder chaos acceptance (ISSUE 8): a faulted study's timeline
+matches the injected FaultPlan event for event, the fault-free twin records
+a containment-free timeline, terminal failures flush bounded postmortem
+dumps, and a two-process gRPC study stitches into one trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import flight, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import DispatchTimeoutError, optimize_vectorized
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.samplers._resilience import GuardedSampler
+from optuna_tpu.storages import RetryPolicy
+from optuna_tpu.storages._in_memory import InMemoryStorage
+from optuna_tpu.storages._retry import RetryingStorage
+from optuna_tpu.testing.fault_injection import (
+    FaultInjectorStorage,
+    FaultPlan,
+    FaultySampler,
+    FaultyVectorizedObjective,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_recorder(tmp_path, monkeypatch):
+    """Fresh recorder + registry per test; postmortems land in tmp_path."""
+    monkeypatch.setenv("OPTUNA_TPU_FLIGHT_DUMP_DIR", str(tmp_path))
+    saved_recorder = flight.get_recorder()
+    saved_flight = flight.enabled()
+    saved_registry = telemetry.get_registry()
+    saved_telemetry = telemetry.enabled()
+    flight.enable(flight.FlightRecorder(capacity=4096))
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_telemetry:
+        telemetry.disable()
+    flight.enable(saved_recorder)
+    if not saved_flight:
+        flight.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _quad(params):
+    return (params["x"] - 0.3) ** 2
+
+
+def _fast_retry(**kwargs) -> RetryPolicy:
+    return RetryPolicy(max_attempts=10, sleep=lambda _: None, **kwargs)
+
+
+def _chaos_layers(plan: FaultPlan):
+    injector = FaultInjectorStorage(InMemoryStorage(), plan)
+    storage = RetryingStorage(injector, _fast_retry(), retry_non_idempotent=True)
+    study = optuna_tpu.create_study(storage=storage, sampler=RandomSampler(seed=0))
+    return injector, study
+
+
+# ----------------------------------------------------------- the acceptance
+
+
+def test_chaos_timeline_matches_the_fault_plan_exactly(tmp_path):
+    """NaN slot + mid-batch crash + storage blip in ONE study: the flight
+    record's containment-event sequence equals the injected plan — same
+    events, same order, nothing else."""
+    # The blip strikes the batch's trial-create (retried exactly once,
+    # pre-commit-safe under the injector's contract), the NaN poisons slot 2
+    # of the first dispatch, the crash kills the second batch's dispatch.
+    plan = FaultPlan(schedule={"create_new_trials": (0,)})
+    injector, study = _chaos_layers(plan)
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (2,)}, raise_at={1})
+
+    optimize_vectorized(study, obj, n_trials=8, batch_size=4)
+
+    # The injected plan, in injection order — the flight record is the
+    # *ordered* complement of the counters' tallies.
+    containment = [e.name for e in flight.events() if e.kind == "containment"]
+    assert containment == [
+        "storage.retry",        # create_new_trials blip, batch 1 ask
+        "executor.quarantine",  # NaN slot, batch 1 tell
+        "executor.bisection",   # crash, batch 2 dispatch
+    ]
+    assert injector.faults_injected == 1
+    # Lifecycle completeness: every trial asked and told exactly once, and
+    # the quarantined slot is the one FAIL.
+    asks = [e.trial for e in flight.events() if e.kind == "trial" and e.name == "ask"]
+    tells = {
+        e.trial: e.meta["state"]
+        for e in flight.events()
+        if e.kind == "trial" and e.name == "tell"
+    }
+    assert sorted(asks) == list(range(8))
+    assert sorted(tells) == list(range(8))
+    assert sorted(s for s in tells.values()) == ["COMPLETE"] * 7 + ["FAIL"]
+    states = [t.state for t in study.trials]
+    assert states.count(TrialState.RUNNING) == 0
+    assert states.count(TrialState.FAIL) == 1
+    # Everything was contained: no terminal failure, so nothing was dumped.
+    assert list(tmp_path.glob("optuna-tpu-flight-*.json")) == []
+
+
+def test_fault_free_twin_records_a_containment_free_timeline(tmp_path):
+    """The fault-free twin of the chaos scenario (identical layering): only
+    lifecycle recording — phase spans, trial instants, device/compile
+    gauges — with zero containment events and zero postmortems."""
+    _, study = _chaos_layers(FaultPlan())
+    optimize_vectorized(
+        study, FaultyVectorizedObjective(_quad, SPACE), n_trials=8, batch_size=4
+    )
+    kinds = {e.kind for e in flight.events()}
+    assert "containment" not in kinds
+    assert "postmortem" not in kinds
+    assert kinds <= {"phase", "trial", "jit.compile", "jit.retrace", "gauge"}
+    assert list(tmp_path.glob("optuna-tpu-flight-*.json")) == []
+    tells = [e for e in flight.events() if e.kind == "trial" and e.name == "tell"]
+    assert sorted(e.trial for e in tells) == list(range(8))
+    assert all(e.meta["state"] == "COMPLETE" for e in tells)
+    # Phase spans per batch: two batches of ask(x2 blocks)/dispatch/tell.
+    dispatch_spans = [
+        e for e in flight.events() if e.kind == "phase" and e.name == "dispatch"
+    ]
+    assert len(dispatch_spans) == 2
+
+
+# ------------------------------------------------------------- postmortems
+
+
+def test_watchdog_timeout_flushes_a_bounded_postmortem(tmp_path):
+    """A hung dispatch (the watchdog firing, then the batch failing
+    terminally) flushes the recorder tail as bounded JSON with the timeout
+    containment event inside — the after-the-fact chaos diagnosis the
+    counters alone cannot give."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, hang_at={0}, hang_s=5.0)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    with pytest.raises(DispatchTimeoutError):
+        optimize_vectorized(
+            study,
+            obj,
+            n_trials=2,
+            batch_size=1,
+            bisect_on_error=False,
+            retry_policy=RetryPolicy(max_attempts=1, sleep=lambda _: None),
+            dispatch_deadline_s=0.2,
+        )
+    path = flight.last_postmortem_path()
+    assert path is not None and os.path.dirname(path) == str(tmp_path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert "DispatchTimeoutError" in payload["reason"]
+    assert payload["n_events"] <= flight.POSTMORTEM_TAIL
+    dumped_kinds = {(e["kind"], e["name"]) for e in payload["events"]}
+    assert ("containment", "executor.dispatch_timeout") in dumped_kinds
+    assert payload["trace_id"] == flight.trace_id()
+
+
+def test_guarded_sampler_degrade_flushes_one_postmortem(tmp_path):
+    """The first GuardedSampler degrade per study dumps the recorder tail
+    (what led up to the broken fit); further degrades in the same study
+    only count/attr — no dump spam."""
+    sampler = GuardedSampler(
+        FaultySampler(RandomSampler(seed=0), raise_at={0, 1}, force_relative=True)
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=4)
+    dumps = sorted(tmp_path.glob("optuna-tpu-flight-*.json"))
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    assert payload["reason"].startswith("sampler degraded during relative")
+    # Both degrades were still recorded as events.
+    fallbacks = [
+        e for e in flight.events()
+        if e.kind == "containment" and e.name.startswith("sampler.fallback")
+    ]
+    assert len(fallbacks) == 2
+
+
+def test_disabled_chaos_records_and_dumps_nothing(tmp_path):
+    """Faults with flight disabled: containment still works, the ring stays
+    empty and no postmortem is written — recording is opt-in, never
+    load-bearing."""
+    flight.disable()
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at={0: (1,)})
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    optimize_vectorized(study, obj, n_trials=4, batch_size=4)
+    assert sum(t.state == TrialState.FAIL for t in study.trials) == 1
+    assert flight.events() == []
+    assert list(tmp_path.glob("optuna-tpu-flight-*.json")) == []
+
+
+# ---------------------------------------------------------- cross-process
+
+
+_CLIENT_WORKER = """
+import json, sys
+from optuna_tpu import flight
+flight.enable()
+import optuna_tpu
+from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+
+port = int(sys.argv[1])
+storage = GrpcStorageProxy(host="localhost", port=port)
+study = optuna_tpu.load_study(study_name="flight2p", storage=storage)
+study.optimize(lambda t: (t.suggest_float("x", -1, 1)) ** 2, n_trials=3)
+client_spans = [e for e in flight.events() if e.kind == "rpc.client"]
+print("CLIENT-JSON " + json.dumps({
+    "trace_id": flight.trace_id(),
+    "n_client_spans": len(client_spans),
+    "span_ids": [e.span for e in client_spans],
+}))
+"""
+
+
+def test_client_degrades_gracefully_against_a_pre_flight_server():
+    """A hub that predates FLIGHT_CTX_KEY forwards the kwarg into its
+    storage call and answers TypeError: the client must downgrade to
+    client-side-only spans and replay the op — observability must never
+    kill a mixed-version fleet's storage path."""
+    pytest.importorskip("grpc")
+    from optuna_tpu.storages._grpc import server as server_mod
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.testing.storages import _find_free_port
+
+    port = _find_free_port()
+    server = make_grpc_server(InMemoryStorage(), "localhost", port)
+    server.start()
+    # Simulate the old server: its handler no longer strips __flight_ctx,
+    # so the kwarg reaches the storage method exactly as a pre-flight
+    # release's would.
+    saved = server_mod.FLIGHT_CTX_KEY
+    server_mod.FLIGHT_CTX_KEY = "__not_the_flight_key"
+    try:
+        proxy = GrpcStorageProxy(host="localhost", port=port)
+        study = optuna_tpu.create_study(storage=proxy)  # first op degrades
+        assert proxy._flight_ctx_unsupported is True
+        trial = study.ask()
+        trial.suggest_float("x", 0, 1)
+        study.tell(trial, 1.0)  # whole loop keeps working, ctx-free
+        assert study.trials[0].state == TrialState.COMPLETE
+        # Client-side spans still recorded; nothing server-tagged.
+        assert any(e.kind == "rpc.client" for e in flight.events())
+        proxy.remove_session()
+    finally:
+        server_mod.FLIGHT_CTX_KEY = saved
+        server.stop(grace=None)
+
+
+def test_two_process_grpc_study_shares_one_trace_id(tmp_path):
+    """A worker process's flight context rides every RPC: the server's
+    handler spans carry the *client's* trace id and parent onto the
+    client's span ids, so the two processes' exports stitch into one
+    timeline."""
+    pytest.importorskip("grpc")
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.storages._rdb.storage import RDBStorage
+    from optuna_tpu.testing.storages import _find_free_port
+
+    with tempfile.NamedTemporaryFile(suffix=".db") as tmp:
+        rdb = RDBStorage(f"sqlite:///{tmp.name}")
+        optuna_tpu.create_study(study_name="flight2p", storage=rdb)
+        port = _find_free_port()
+        server = make_grpc_server(rdb, "localhost", port)
+        server.start()
+        try:
+            worker_py = tmp_path / "worker.py"
+            worker_py.write_text(_CLIENT_WORKER)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            proc = subprocess.run(
+                [sys.executable, str(worker_py), str(port)],
+                capture_output=True, text=True, env=env, timeout=300,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            line = next(
+                l for l in proc.stdout.splitlines() if l.startswith("CLIENT-JSON ")
+            )
+            client = json.loads(line[len("CLIENT-JSON "):])
+        finally:
+            server.stop(grace=None)
+
+    assert client["n_client_spans"] > 0
+    server_spans = [e for e in flight.events() if e.kind == "rpc.server"]
+    assert server_spans, "server recorded no handler spans"
+    # ONE trace id across both processes: every handler span carries the
+    # client's, not this (server) process's own.
+    assert {e.trace for e in server_spans} == {client["trace_id"]}
+    assert client["trace_id"] != flight.trace_id()
+    # Causality: handler spans parent onto the client's per-op span ids.
+    client_ids = set(client["span_ids"])
+    assert all(e.parent for e in server_spans)
+    assert {e.parent for e in server_spans} <= client_ids
+    # The merged Chrome export is schema-valid and carries both pids' worth
+    # of events under the shared trace id.
+    merged = flight.chrome_trace()
+    assert any(
+        e.get("args", {}).get("trace_id") == client["trace_id"]
+        for e in merged["traceEvents"]
+    )

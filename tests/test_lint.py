@@ -155,6 +155,34 @@ def test_smp001_gate_rejects_drift():
     assert all("shrug" in f.message for f in drifted)
 
 
+def test_obs002_registry_matches_runtime_sets():
+    """The canonical flight event-kind registry equals the *runtime* values
+    of both hand-written copies (the lint compares them statically)."""
+    from optuna_tpu import flight
+    from optuna_tpu.testing.fault_injection import FLIGHT_EVENT_CHAOS_MATRIX
+
+    canonical = set(lint_registry.FLIGHT_EVENT_REGISTRY)
+    assert set(flight.EVENT_KINDS) == canonical
+    assert set(FLIGHT_EVENT_CHAOS_MATRIX) == canonical
+
+
+def test_obs002_gate_rejects_drift():
+    """Point OBS002 at the real files with a registry containing an event
+    kind the code does not know: both copies must be reported as drifted —
+    adding a flight event kind without an acceptance scenario is a lint
+    failure (the STO001/EXE001/SMP001 discipline)."""
+    fat_registry = dict(lint_registry.FLIGHT_EVENT_REGISTRY)
+    fat_registry["wormhole"] = "made-up kind to prove the check is live"
+    config = Config(obs002_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.obs002_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "OBS002"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("wormhole" in f.message for f in drifted)
+
+
 def test_smp002_gate_fires_on_a_bare_cholesky_in_samplers():
     """Prove SMP002 is live against the real tree: a scan of the samplers
     subtree with the resilience module's pragmas ignored must flag exactly
@@ -176,11 +204,12 @@ def test_smp002_gate_fires_on_a_bare_cholesky_in_samplers():
 
 def test_obs001_device_tree_is_clean():
     """Live drift gate (the SMP002 pattern): scan the real device modules —
-    which now DO carry telemetry instrumentation (executor quarantine
-    counters, resilience fallback counters) — with only OBS001 enabled.
-    Zero findings proves every tap sits host-side, outside the traced
-    scopes; someone moving one into a jit body or lax loop later turns this
-    red."""
+    which now DO carry telemetry AND flight-recorder instrumentation
+    (executor quarantine counters + flight spans/postmortems, resilience
+    fallback counters + degrade dumps, the fused GP compile gauges) — with
+    only OBS001 enabled. Zero findings proves every tap sits host-side,
+    outside the traced scopes; someone moving one into a jit body or lax
+    loop later turns this red."""
     import dataclasses
 
     result = run_lint(
