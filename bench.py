@@ -114,6 +114,24 @@ def _compile_breakdown() -> dict:
     }
 
 
+def _device_stats_breakdown() -> dict:
+    """The on-device half of the phase breakdown (ISSUE 9): the ``device.*``
+    gauges harvested from in-graph stats structs over the timed window —
+    max jitter-ladder rung (a window silently paying refactorizations per
+    fit shows it), total fused fit-loop iterations, and the quarantined
+    count from the executor's isfinite mask. The gauges reset with the
+    registry in :func:`_reset_phase_telemetry`, so no base capture is
+    needed (unlike the cumulative jit gauges)."""
+    from optuna_tpu import device_stats, telemetry
+
+    gauges = device_stats.stat_gauges(telemetry.snapshot())
+    return {
+        "max_ladder_rung": int(gauges.get("device.gp.ladder_rung.max", 0)),
+        "fit_iterations": int(gauges.get("device.gp.fit_iterations.total", 0)),
+        "quarantined": int(gauges.get("device.executor.quarantined.total", 0)),
+    }
+
+
 def _phase_breakdown() -> dict:
     """{phase: {total_s, count}} from the spans recorded since the last
     reset — the breakdown that localizes which of ask/fit/propose/dispatch/
@@ -985,6 +1003,10 @@ def main() -> None:
     # the instrument that localizes a trials/s regression to the phase that
     # paid for it (ROADMAP item 5 — the r03->r04 drop had no such signal).
     extra["phases"] = _phase_breakdown()
+    # Device-stat block (ISSUE 9): what the dispatches did *inside* the
+    # graph over the timed window — the on-device half the r03->r04
+    # claw-back needs beside the host-side phase breakdown.
+    extra["device_stats"] = _device_stats_breakdown()
     # Compile-cost split (ISSUE 8): the in-window jit compile gauges divide
     # the measured window into first-batch (compile-inclusive) and
     # steady-state throughput. `value` stays the end-to-end figure — it is

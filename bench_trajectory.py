@@ -165,6 +165,8 @@ def append_entry(
         entry["phases"] = result["phases"]
     if result.get("compile"):
         entry["compile"] = result["compile"]
+    if result.get("device_stats"):
+        entry["device_stats"] = result["device_stats"]
     if result.get("steady_state_trials_per_sec") is not None:
         entry["steady_state_trials_per_sec"] = result["steady_state_trials_per_sec"]
     provenance = git_provenance()

@@ -12,8 +12,9 @@ TPU001   host sync (float()/.item()/np.asarray) inside a jit trace
 TPU002   jit built per-call / static args with unhashable defaults
 TPU003   float64 in an f32-hardened device module
 TPU004   stray print / jax.debug.print in package code
-OBS001   telemetry/flight/logging call inside a jit trace of a device module
+OBS001   telemetry/flight/device-stats/logging call inside a jit trace of a device module
 OBS002   flight-recorder event vocabularies drifted from the canonical one
+OBS003   device-stat vocabularies drifted from the canonical one
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 EXE001   non-finite quarantine policy sets drifted from the canonical one
@@ -46,6 +47,7 @@ def all_rules() -> list[Rule]:
     from optuna_tpu._lint.rules_device import (
         OBS001TelemetryInTrace,
         OBS002FlightEventSync,
+        OBS003DeviceStatSync,
         TPU001HostSyncInJit,
         TPU002RecompileHazard,
         TPU003DtypeDrift,
@@ -69,6 +71,7 @@ def all_rules() -> list[Rule]:
         TPU004StrayDebugOutput(),
         OBS001TelemetryInTrace(),
         OBS002FlightEventSync(),
+        OBS003DeviceStatSync(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
         EXE001NonFinitePolicySync(),

@@ -89,6 +89,10 @@ class Config:
     obs002_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.FLIGHT_EVENT_REGISTRY
     )
+    obs003_targets: tuple[tuple[str, str, str], ...] = registry.OBS003_TARGETS
+    obs003_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.DEVICE_STAT_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

@@ -187,6 +187,38 @@ OBS002_TARGETS: tuple[tuple[str, str, str], ...] = (
     ),
 )
 
+#: The device-stat vocabulary: the in-graph counters jitted programs return
+#: as a fixed-shape auxiliary stats struct (i32/f32 scalars — no shape
+#: polymorphism, no extra dispatches) and ``device_stats.harvest()``
+#: publishes at the host boundary. Canonical mirror of
+#: ``device_stats.py::DEVICE_STATS`` (rule **OBS003**, the STO001 machinery
+#: pointed at on-device observability). Values say what each stat reports;
+#: every stat must have an injection scenario in ``testing/
+#: fault_injection.py::DEVICE_STAT_CHAOS_MATRIX`` (same rule).
+DEVICE_STAT_REGISTRY: dict[str, str] = {
+    "gp.ladder_rung": "jitter-ladder escalations the Cholesky needed (0 = bare factor was finite)",
+    "gp.fit_iterations": "L-BFGS iterations the fused kernel-param fit actually ran",
+    "gp.proposal_fallback_coords": "proposal coordinates that took the per-coordinate isfinite fallback",
+    "gp.best_acq": "best acquisition value the fused proposal search found",
+    "executor.quarantined": "trials quarantined as FAIL in one batch dispatch, from the in-graph isfinite mask (0 under non_finite='clip': nothing is quarantined)",
+}
+
+#: The hand-maintained copies OBS003 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+OBS003_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/device_stats.py",
+        "DEVICE_STATS",
+        "the harvest harness's accepted stat names (validated on every harvest)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "DEVICE_STAT_CHAOS_MATRIX",
+        "chaos matrix: every device stat must have an injection scenario",
+    ),
+)
+
 #: The single blessed Cholesky call site for sampler code (rule **SMP002**):
 #: every kernel solve in ``optuna_tpu/samplers/`` must go through the
 #: jitter-ladder helper there, which escalates diagonal jitter in-graph until

@@ -1,6 +1,7 @@
 """Device-kernel rules: TPU001 host sync, TPU002 recompile hazard,
 TPU003 dtype drift, TPU004 stray debug output, OBS001 observability taps
-in traced scopes, OBS002 flight-recorder event-vocabulary sync.
+in traced scopes, OBS002 flight-recorder event-vocabulary sync, OBS003
+device-stat vocabulary sync.
 
 The TPU rules encode the invariants ARCHITECTURE.md's design stance rests
 on: inside a jit trace nothing may force a host round-trip (TPU001), jit
@@ -209,8 +210,11 @@ class OBS001TelemetryInTrace(Rule):
 
     #: Module aliases whose calls are observability taps wherever they point
     #: (``telemetry.count(...)``, ``flight.span(...)``,
-    #: ``logging_module.warn_once(...)``).
-    _TAP_ROOTS = {"telemetry", "flight", "_flight", "logging", "logging_module"}
+    #: ``device_stats.harvest(...)``, ``logging_module.warn_once(...)``).
+    _TAP_ROOTS = {
+        "telemetry", "flight", "_flight", "device_stats", "_device_stats",
+        "logging", "logging_module",
+    }
     #: Logger method names — flagged when called on something logger-shaped.
     _LOG_METHODS = {
         "debug", "info", "warning", "warn", "error", "exception", "critical", "log",
@@ -218,7 +222,11 @@ class OBS001TelemetryInTrace(Rule):
     #: Receiver names that identify a logger object by convention.
     _LOGGER_NAMES = {"logger", "_logger", "log"}
     #: Bare-name calls that are observability taps regardless of receiver.
-    _TAP_FUNCS = {"warn_once", "get_logger"}
+    #: ``harvest`` is the device-stats host boundary: inside a trace it would
+    #: force a device->host sync per stat (np.asarray on traced scalars) —
+    #: the stats struct must be *returned* from the program and harvested
+    #: outside it.
+    _TAP_FUNCS = {"warn_once", "get_logger", "harvest"}
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         if not ctx.is_device:
@@ -284,6 +292,27 @@ class OBS002FlightEventSync(_RegistrySyncRule):
 
     def _targets(self, config):
         return config.obs002_targets
+
+
+class OBS003DeviceStatSync(_RegistrySyncRule):
+    """The STO001/EXE001/SMP001/OBS002 anti-drift machinery pointed at the
+    device-stat vocabulary: ``device_stats.py::DEVICE_STATS`` and the chaos
+    matrix ``fault_injection.py::DEVICE_STAT_CHAOS_MATRIX`` must both equal
+    the canonical ``registry.DEVICE_STAT_REGISTRY`` — a stat added to the
+    in-graph structs without an injection scenario proving it reports is a
+    lint failure, not a review comment. (The companion check — ``harvest()``
+    never called inside a traced scope of a device module — is OBS001's:
+    ``device_stats`` is a tap root and ``harvest`` a tap function there.)"""
+
+    id = "OBS003"
+    title = "device-stat vocabularies out of sync"
+    noun = "device stats"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.obs003_registry)
+
+    def _targets(self, config):
+        return config.obs003_targets
 
 
 class TPU002RecompileHazard(Rule):

@@ -249,7 +249,10 @@ def _cmd_metrics(args: argparse.Namespace) -> None:
             print(response.read().decode(), end="")
         return
     if args.format == "json":
-        print(json.dumps(telemetry.snapshot(), sort_keys=True))
+        # export_snapshot: the registry plus the flight recorder's per-label
+        # jit compile/retrace totals — host phases, device.* stat gauges and
+        # compile counts on one surface (mirrors /metrics.json).
+        print(json.dumps(telemetry.export_snapshot(), sort_keys=True))
     else:
         print(telemetry.render_prometheus(), end="")
 
@@ -259,8 +262,10 @@ def _cmd_trace(args: argparse.Namespace) -> None:
 
     ``--format=chrome`` (default) emits Chrome trace-event JSON — open it in
     Perfetto or ``chrome://tracing``; ``--format=events`` emits the raw
-    structured event list. Without ``--endpoint`` the dump is this process's
-    recorder — empty unless ``OPTUNA_TPU_FLIGHT`` was set; with
+    structured event list. ``--trial N`` filters the dump to one trial's
+    events plus their parent spans — the single-trial postmortem slice,
+    instead of the whole ring. Without ``--endpoint`` the dump is this
+    process's recorder — empty unless ``OPTUNA_TPU_FLIGHT`` was set; with
     ``--endpoint`` it is fetched from a serving process's ``/trace.json``
     (the gRPC proxy's ``metrics_port``), which is where a live fleet's
     stitched timeline actually accumulates. ``--output`` writes to a file
@@ -280,11 +285,20 @@ def _cmd_trace(args: argparse.Namespace) -> None:
             )
         with urllib.request.urlopen(url, timeout=10) as response:
             payload = response.read().decode()
-    elif args.format == "chrome":
-        flight.sample_device_gauges()
-        payload = json.dumps(flight.chrome_trace())
+        if args.trial is not None:
+            payload = json.dumps(
+                flight.filter_chrome_trace(json.loads(payload), args.trial)
+            )
     else:
-        payload = json.dumps(flight.snapshot())
+        if args.format == "chrome":
+            flight.sample_device_gauges()  # before the read, so it exports
+        events = flight.events()
+        if args.trial is not None:
+            events = flight.filter_trial(events, args.trial)
+        if args.format == "chrome":
+            payload = json.dumps(flight.chrome_trace(events))
+        else:
+            payload = json.dumps([ev.to_dict() for ev in events])
     if args.output:
         with open(args.output, "w", encoding="utf-8") as f:
             f.write(payload)
@@ -361,6 +375,13 @@ def _build_parser() -> argparse.ArgumentParser:
 
     p = add("trace", _cmd_trace)
     p.add_argument("-f", "--format", default="chrome", choices=["chrome", "events"])
+    p.add_argument(
+        "--trial",
+        type=int,
+        default=None,
+        help="filter to one trial's events (plus their parent spans) for a "
+        "single-trial postmortem instead of the whole ring",
+    )
     p.add_argument(
         "--endpoint",
         default=None,

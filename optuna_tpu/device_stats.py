@@ -1,0 +1,166 @@
+"""Device-stats taps: in-graph observability for jitted programs.
+
+The telemetry spine and flight recorder see everything *around* a device
+dispatch but nothing *inside* it — OBS001 rightly bans host-side telemetry
+in traced scopes, so the jitter ladder's escalation count, the fused GP
+program's fit iterations, and the executor's in-graph quarantine verdicts
+were invisible, and ``ask.fit``/``ask.propose`` attribution on the fused
+path was "indivisible by design". This module is the channel that makes
+on-device work observable without breaking the device contract:
+
+* **The convention** — a jitted program that has something to report
+  returns a small fixed-shape stats struct as an auxiliary output: a plain
+  dict of i32/f32 *scalars* whose keys come from the :data:`DEVICE_STATS`
+  vocabulary. Fixed shape means no shape polymorphism (the stats never fork
+  the jit cache) and no extra dispatches (they ride the program that was
+  running anyway); scalars mean the added transfer is bytes.
+* **The harness** — :func:`harvest` is the host-boundary publisher: it
+  converts the already-realized stat scalars into telemetry gauges (and a
+  histogram for the accumulating stats) plus flight ``gauge`` events.
+  Harvesting rides the result transfer that already happens at the host
+  boundary — the caller realizes the program's primary outputs first, so
+  ``np.asarray`` on the stat scalars adds **zero** new ``block_until_ready``
+  and zero host syncs in-graph (graphlint rule **OBS001** flags a
+  ``harvest`` call inside a traced scope of a device module).
+* **The vocabulary contract** — :data:`DEVICE_STATS` is mirrored by the
+  canonical ``_lint/registry.py::DEVICE_STAT_REGISTRY`` and the chaos
+  matrix ``testing/fault_injection.py::DEVICE_STAT_CHAOS_MATRIX``
+  (graphlint rule **OBS003**, the STO001 machinery): a stat added to an
+  in-graph struct without an injection scenario proving it reports is a
+  lint failure.
+
+Current taps (the three in-graph blind spots):
+
+1. ``gp.ladder_rung`` — :func:`~optuna_tpu.samplers._resilience.
+   ladder_cholesky_with_rung` threads the jitter ladder's ``while_loop``
+   carry out through ``gp/gp.py::_finalize_state`` and
+   ``gp/fused.py::_state_for``, so a study silently paying escalated
+   refactorizations per fit finally shows it.
+2. ``gp.fit_iterations`` / ``gp.proposal_fallback_coords`` / ``gp.best_acq``
+   — the fused GP programs (``gp/fused.py``) report what the indivisible
+   fit+propose dispatch actually did, giving it *work-based* fit-vs-propose
+   attribution where wall-clock attribution is impossible by design.
+3. ``executor.quarantined`` — the vectorized executor reports per-batch
+   quarantine counts from the device-side ``isfinite`` mask it already
+   computes (the count is taken from the transferred mask at the boundary,
+   so bisection/halving re-dispatches and SPMD padding never double-count).
+
+Exports: gauges ``device.<stat>.<agg>`` (``max`` for high-water stats,
+``total`` for accumulating ones, ``last`` for point values) in the
+telemetry registry — visible in ``Study.telemetry_snapshot()``,
+``/metrics.json``, ``optuna-tpu metrics`` and ``bench.py``'s
+``device_stats`` block — plus one flight ``gauge`` event per harvested
+stat so the timeline shows *when* the device did the work.
+
+Overhead contract (telemetry's, verbatim): publishing is gated by the
+existing telemetry/flight enable checks; while both are off,
+:func:`harvest` returns after module-global checks and allocates nothing
+per trial (asserted over 10k trials by ``tests/test_device_stats.py``).
+The in-graph side costs a few scalar ops per dispatch whether or not
+anything is recording — deliberately unconditional, so toggling recording
+never retraces a compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from optuna_tpu import flight, telemetry
+
+__all__ = [
+    "DEVICE_STATS",
+    "STAT_AGGREGATIONS",
+    "enabled",
+    "gauge_name",
+    "harvest",
+    "stat_gauges",
+]
+
+
+#: The device-stat vocabulary: every key a harvested stats struct may carry,
+#: with what each stat reports. Canonical mirror:
+#: ``_lint/registry.py::DEVICE_STAT_REGISTRY`` — graphlint rule **OBS003**
+#: fails if this copy (or the chaos matrix in ``testing/fault_injection.py``)
+#: drifts, and :func:`harvest` rejects unknown names at runtime.
+DEVICE_STATS: dict[str, str] = {
+    "gp.ladder_rung": "jitter-ladder escalations the Cholesky needed (0 = bare factor was finite)",
+    "gp.fit_iterations": "L-BFGS iterations the fused kernel-param fit actually ran",
+    "gp.proposal_fallback_coords": "proposal coordinates that took the per-coordinate isfinite fallback",
+    "gp.best_acq": "best acquisition value the fused proposal search found",
+    "executor.quarantined": "trials quarantined as FAIL in one batch dispatch, from the in-graph isfinite mask (0 under non_finite='clip': nothing is quarantined)",
+}
+
+#: How each stat aggregates across harvests within one recording window:
+#: ``max`` — high-water mark (the worst fit's rung is the story);
+#: ``total`` — running sum (work done; also observed into a histogram so the
+#: per-dispatch distribution survives); ``last`` — most recent point value.
+STAT_AGGREGATIONS: dict[str, str] = {
+    "gp.ladder_rung": "max",
+    "gp.fit_iterations": "total",
+    "gp.proposal_fallback_coords": "total",
+    "gp.best_acq": "last",
+    "executor.quarantined": "total",
+}
+
+_GAUGE_PREFIX = "device."
+
+
+def enabled() -> bool:
+    """Whether a harvest would publish anywhere — the call sites' cheap
+    pre-check before building a stats mapping that only exists for
+    harvesting (the fused programs return theirs unconditionally, so their
+    harvest calls skip this and rely on :func:`harvest`'s own gate)."""
+    return telemetry.enabled() or flight.enabled()
+
+
+def gauge_name(stat: str) -> str:
+    """The telemetry gauge a stat publishes to (``device.<stat>.<agg>``)."""
+    return f"{_GAUGE_PREFIX}{stat}.{STAT_AGGREGATIONS[stat]}"
+
+
+def harvest(stats: Mapping[str, object], trial: int | None = None) -> None:
+    """Publish one dispatch's device-stat struct at the host boundary.
+
+    ``stats`` maps :data:`DEVICE_STATS` names to scalars — jax arrays
+    (already computed by the dispatch whose primary outputs the caller just
+    realized; converting them here adds no new device sync) or plain Python
+    numbers (the executor's mask-derived count). Publishes, per stat: the
+    aggregated ``device.<stat>.<agg>`` telemetry gauge, a
+    ``device.<stat>`` histogram observation for ``total``-aggregated stats
+    (per-dispatch distribution), and one flight ``gauge`` event (timeline
+    placement, optionally trial-tagged). A no-op after module-global checks
+    while both telemetry and flight are disabled.
+    """
+    if not telemetry.enabled() and not flight.enabled():
+        return
+    for name, value in stats.items():
+        agg = STAT_AGGREGATIONS.get(name)
+        if agg is None:
+            raise ValueError(
+                f"unknown device stat {name!r}; the vocabulary is "
+                f"{sorted(DEVICE_STATS)} (DEVICE_STATS / DEVICE_STAT_REGISTRY)."
+            )
+        v = float(np.asarray(value))
+        gauge = f"{_GAUGE_PREFIX}{name}.{agg}"
+        if agg == "max":
+            telemetry.max_gauge(gauge, v)
+        elif agg == "total":
+            telemetry.add_gauge(gauge, v)
+            telemetry.observe(_GAUGE_PREFIX + name, v)
+        else:  # "last"
+            telemetry.set_gauge(gauge, v)
+        flight.event("gauge", _GAUGE_PREFIX + name, trial=trial, meta={"value": v})
+
+
+def stat_gauges(snapshot: Mapping | None = None) -> dict[str, float]:
+    """The ``device.*`` gauges from a telemetry snapshot — the condensed
+    block ``bench.py`` embeds in its JSON line. Only stats that actually
+    harvested appear (a window with no GP fits has no ``gp.*`` entries)."""
+    snap = telemetry.snapshot() if snapshot is None else snapshot
+    return {
+        name: value
+        for name, value in snap.get("gauges", {}).items()
+        if name.startswith(_GAUGE_PREFIX)
+    }

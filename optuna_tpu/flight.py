@@ -76,8 +76,11 @@ __all__ = [
     "enabled",
     "event",
     "events",
+    "filter_chrome_trace",
+    "filter_trial",
     "get_recorder",
     "instrument_jit",
+    "jit_totals",
     "last_postmortem_path",
     "new_span_id",
     "postmortem",
@@ -542,6 +545,24 @@ class _InstrumentedJit:
         return out
 
 
+def jit_totals() -> dict[str, dict[str, float]]:
+    """Per-label jit compile/retrace totals aggregated across every
+    :func:`instrument_jit` proxy (the authoritative aggregates behind the
+    ``jit.*`` telemetry gauges — kept here so they survive a
+    ``telemetry.reset()`` and accumulate even while only flight records).
+    Exported by ``telemetry.export_snapshot()`` so one surface carries host
+    phases, device stats and compile counts together."""
+    with _jit_totals_lock:
+        return {
+            label: {
+                "compiles": totals[0],
+                "compile_seconds": round(totals[1], 6),
+                "retraces_after_first": totals[2],
+            }
+            for label, totals in _jit_totals.items()
+        }
+
+
 def instrument_jit(fn: Callable, label: str) -> Callable:
     """Wrap a jit callable so compiles/retraces surface as gauges + events.
     Free when both flight and telemetry are disabled (one check, straight
@@ -586,6 +607,84 @@ def events() -> list[FlightEvent]:
 def snapshot() -> list[dict]:
     """The ring's contents as JSON-able dicts, oldest first."""
     return [ev.to_dict() for ev in _RECORDER.events()]
+
+
+def _trial_slice_ids(
+    items: list, trial: int, get_trial, get_span, get_parent
+) -> tuple[set[int], set[str]]:
+    """The one keep-trial-plus-ancestors traversal both slice flavors share
+    (accessor-parameterized so the FlightEvent and rendered-Chrome-dict
+    forms cannot drift): ids of items carrying ``trial`` directly, plus the
+    transitive closure of parent span ids their chains reference."""
+    by_span = {get_span(item): item for item in items if get_span(item) is not None}
+    kept_ids = {id(item) for item in items if get_trial(item) == trial}
+    ancestor_spans: set[str] = set()
+    for item in items:
+        if id(item) not in kept_ids:
+            continue
+        parent = get_parent(item)
+        while parent is not None and parent not in ancestor_spans:
+            ancestor_spans.add(parent)
+            parent_item = by_span.get(parent)
+            parent = get_parent(parent_item) if parent_item is not None else None
+    return kept_ids, ancestor_spans
+
+
+def filter_trial(
+    event_list: Iterable[FlightEvent], trial: int
+) -> list[FlightEvent]:
+    """Events attributed to one trial, plus their parent spans (transitive):
+    the single-trial postmortem slice behind ``optuna-tpu trace --trial N``.
+    An event is kept when it carries ``trial == N`` directly (lifecycle
+    instants, per-trial phase spans, trial-tagged device-stat gauges) or
+    when a kept event's parent chain references its span id (the batch
+    dispatch / RPC span a trial's events hang under). Ring order is
+    preserved."""
+    evs = list(event_list)
+    kept_ids, ancestor_spans = _trial_slice_ids(
+        evs,
+        trial,
+        lambda ev: ev.trial,
+        lambda ev: ev.span,
+        lambda ev: ev.parent,
+    )
+    return [
+        ev
+        for ev in evs
+        if id(ev) in kept_ids or (ev.span is not None and ev.span in ancestor_spans)
+    ]
+
+
+def filter_chrome_trace(payload: Mapping, trial: int) -> dict:
+    """One-trial slice of an already-rendered Chrome trace dict — the
+    ``--endpoint`` flavor of :func:`filter_trial`, for ``optuna-tpu trace
+    --trial N --endpoint`` where only ``/trace.json`` output is available.
+    Same traversal (:func:`_trial_slice_ids` over ``args.trial`` /
+    ``args.span_id`` / ``args.parent_span_id``), plus: metadata records
+    (``ph == "M"``) and counter tracks (``ph == "C"`` — gauge events, whose
+    rendered form deliberately carries only ``value``, so their trial tag
+    is gone by now) are kept as context rather than silently dropped."""
+    events = list(payload.get("traceEvents", []))
+
+    def _arg(entry: Mapping, key: str):
+        args = entry.get("args")
+        return args.get(key) if isinstance(args, Mapping) else None
+
+    kept_ids, ancestors = _trial_slice_ids(
+        events,
+        trial,
+        lambda entry: _arg(entry, "trial"),
+        lambda entry: _arg(entry, "span_id"),
+        lambda entry: _arg(entry, "parent_span_id"),
+    )
+    filtered = [
+        entry
+        for entry in events
+        if entry.get("ph") in ("M", "C")
+        or id(entry) in kept_ids
+        or _arg(entry, "span_id") in ancestors
+    ]
+    return {**payload, "traceEvents": filtered}
 
 
 def chrome_trace(event_list: Iterable[FlightEvent] | None = None) -> dict:

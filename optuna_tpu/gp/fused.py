@@ -46,7 +46,8 @@ from optuna_tpu.ops.lbfgsb import lbfgsb
 
 def _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters):
     """Multi-start MAP fit of raw log kernel params; returns the winning raw
-    vector and the decoded GPParams."""
+    vector, the decoded GPParams, and the L-BFGS iteration count (i32 — the
+    ``gp.fit_iterations`` device stat)."""
     loss_one = lambda r: _loss(r, X, y, cat_mask, mask, minimum_noise)
 
     def value_and_grad(batch_raw):
@@ -58,9 +59,9 @@ def _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters):
     D = starts.shape[1]
     lower = jnp.full((D,), -15.0, starts.dtype)
     upper = jnp.full((D,), 15.0, starts.dtype)
-    xs, fs = lbfgsb(
+    xs, fs, n_iter = lbfgsb(
         value_and_grad, starts, lower, upper, max_iters=fit_iters, max_ls=12,
-        value_fn=value_only,
+        value_fn=value_only, return_n_iter=True,
     )
     raw = xs[jnp.argmin(fs)]
 
@@ -70,19 +71,20 @@ def _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters):
         scale=jnp.exp(raw[d]),
         noise=jnp.exp(raw[d + 1]) + minimum_noise,
     )
-    return raw, params
+    return raw, params, n_iter
 
 
 def _state_for(params, X, y, cat_mask, mask):
-    from optuna_tpu.samplers._resilience import ladder_cholesky
+    from optuna_tpu.samplers._resilience import ladder_cholesky_with_rung
 
     K = _kernel_with_noise(X, params, cat_mask, mask)
     # Jitter-ladder factorization: duplicate design rows (routine once retry
     # clones re-run identical params) make K rank-deficient, and on TPU a
-    # bare cholesky returns NaN silently instead of raising.
-    L = ladder_cholesky(K)
+    # bare cholesky returns NaN silently instead of raising. The rung rides
+    # out with the state — the gp.ladder_rung device stat.
+    L, rung = ladder_cholesky_with_rung(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
-    return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
+    return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha), rung
 
 
 def device_candidates(sobol_base, key, cat_mask, n_choices, steps):
@@ -177,10 +179,14 @@ def _maximize_logei(
     # Final in-graph isfinite mask over the proposal (ring 1 of the sampler
     # resilience contract): should the L-BFGS ascent ever walk a coordinate
     # to NaN/Inf, fall back per-coordinate to the best preliminary candidate
-    # — finite by construction (Sobol decode + observed incumbents).
+    # — finite by construction (Sobol decode + observed incumbents). The
+    # fallback count rides out as the gp.proposal_fallback_coords device
+    # stat: the silent rescue finally shows up in telemetry.
+    finite = jnp.isfinite(x_win)
+    n_fallback = jnp.sum(~finite).astype(jnp.int32)
     prelim_best = candidates[jnp.argmax(vals)]
-    x_win = jnp.where(jnp.isfinite(x_win), x_win, prelim_best)
-    return x_win, cur[winner]
+    x_win = jnp.where(finite, x_win, prelim_best)
+    return x_win, cur[winner], n_fallback
 
 
 @partial(
@@ -212,8 +218,10 @@ def gp_suggest_fused(
     fit_iters: int = 60,
     has_sweep: bool = False,
 ):
-    raw, params = _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters)
-    state = _state_for(params, X, y, cat_mask, mask)
+    raw, params, fit_iters_used = _fit_params(
+        starts, X, y, cat_mask, mask, minimum_noise, fit_iters
+    )
+    state, rung = _state_for(params, X, y, cat_mask, mask)
     best = jnp.max(jnp.where(mask > 0, y, -jnp.inf))
     data = LogEIData(
         state=state,
@@ -224,13 +232,22 @@ def gp_suggest_fused(
     k_cand, k_start = jax.random.split(key)
     cand = device_candidates(sobol_base, k_cand, cat_mask, n_choices, steps)
     cand = jnp.concatenate([incumbents, cand], axis=0)
-    x_best, v_best = _maximize_logei(
+    x_best, v_best, n_fallback = _maximize_logei(
         data, cand, k_start, cont_mask, lower, upper,
         dim_onehot, choice_grid, choice_valid,
         n_local_search=n_local_search, n_cycles=n_cycles,
         lbfgs_iters=lbfgs_iters, has_sweep=has_sweep,
     )
-    return x_best, v_best, raw
+    # Fixed-shape auxiliary stats struct (optuna_tpu.device_stats): scalar
+    # counters riding the dispatch that was running anyway, giving the
+    # indivisible fused program work-based fit-vs-propose attribution.
+    stats = {
+        "gp.ladder_rung": rung,
+        "gp.fit_iterations": fit_iters_used,
+        "gp.proposal_fallback_coords": n_fallback,
+        "gp.best_acq": v_best,
+    }
+    return x_best, v_best, raw, stats
 
 
 @partial(
@@ -274,19 +291,21 @@ def gp_suggest_chain_fused(
     reference's qLogEI intent (``optuna/_gp/acqf.py:154``) but sequential-
     greedy, which keeps every step a plain LogEI maximization.
     """
-    raw, params = _fit_params(starts, X, y, cat_mask, mask, minimum_noise, fit_iters)
+    raw, params, fit_iters_used = _fit_params(
+        starts, X, y, cat_mask, mask, minimum_noise, fit_iters
+    )
     noise_c = jnp.asarray(stabilizing_noise, dtype=X.dtype)
 
     def propose(carry, i):
         Xc, yc, mc = carry
-        state = _state_for(params, Xc, yc, cat_mask, mc)
+        state, rung_i = _state_for(params, Xc, yc, cat_mask, mc)
         best = jnp.max(jnp.where(mc > 0, yc, -jnp.inf))
         data = LogEIData(state=state, cat_mask=cat_mask, best=best, stabilizing_noise=noise_c)
         k_i = jax.random.fold_in(key, i)
         k_cand, k_start = jax.random.split(k_i)
         cand = device_candidates(sobol_base, k_cand, cat_mask, n_choices, steps)
         cand = jnp.concatenate([incumbents, cand], axis=0)
-        x_i, v_i = _maximize_logei(
+        x_i, v_i, nf_i = _maximize_logei(
             data, cand, k_start, cont_mask, lower, upper,
             dim_onehot, choice_grid, choice_valid,
             n_local_search=n_local_search, n_cycles=n_cycles,
@@ -297,10 +316,19 @@ def gp_suggest_chain_fused(
         Xc = Xc.at[slot].set(x_i)
         yc = yc.at[slot].set(mean_i[0])
         mc = mc.at[slot].set(1.0)
-        return (Xc, yc, mc), (x_i, v_i)
+        return (Xc, yc, mc), (x_i, v_i, rung_i, nf_i)
 
-    (_, _, _), (xs, vs) = jax.lax.scan(propose, (X, y, mask), jnp.arange(q))
-    return xs, vs, raw
+    (_, _, _), (xs, vs, rungs, nfs) = jax.lax.scan(propose, (X, y, mask), jnp.arange(q))
+    # Chain-level stats aggregate in-graph (max rung across the q
+    # refactorizations, summed fallback coords) so the struct stays
+    # fixed-shape scalars regardless of q.
+    stats = {
+        "gp.ladder_rung": jnp.max(rungs),
+        "gp.fit_iterations": fit_iters_used,
+        "gp.proposal_fallback_coords": jnp.sum(nfs).astype(jnp.int32),
+        "gp.best_acq": jnp.max(vs),
+    }
+    return xs, vs, raw, stats
 
 
 # Compile/retrace gauges (optuna_tpu.flight): the fused programs are where

@@ -180,22 +180,24 @@ def _finalize_state(
     cat_mask: jnp.ndarray,
     mask: jnp.ndarray,
     minimum_noise: float,
-) -> GPState:
+) -> tuple[GPState, jnp.ndarray]:
     d = X.shape[-1]
     params = GPParams(
         inv_sq_lengthscales=jnp.exp(raw[:d]),
         scale=jnp.exp(raw[d]),
         noise=jnp.exp(raw[d + 1]) + minimum_noise,
     )
-    from optuna_tpu.samplers._resilience import ladder_cholesky
+    from optuna_tpu.samplers._resilience import ladder_cholesky_with_rung
 
     K = _kernel_with_noise(X, params, cat_mask, mask)
     # Posterior factorization rides the jitter ladder: the fit's own loss
     # guards against a failed Cholesky (non-finite -> 1e10), but the final
     # state must deliver a usable factor even for a rank-deficient Gram.
-    L = ladder_cholesky(K)
+    # The rung rides out as an auxiliary output — the gp.ladder_rung device
+    # stat (no extra dispatch, no host sync; optuna_tpu.device_stats).
+    L, rung = ladder_cholesky_with_rung(K)
     alpha = jax.scipy.linalg.cho_solve((L, True), y)
-    return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha)
+    return GPState(params=params, X=X, y=y, mask=mask, L=L, alpha=alpha), rung
 
 
 def _bucket(n: int) -> int:
@@ -211,11 +213,15 @@ def fit_gp(
     n_restarts: int = 4,
     seed: int = 0,
     counts: np.ndarray | None = None,
-) -> tuple[GPState, np.ndarray]:
+) -> tuple[GPState, np.ndarray, dict]:
     """Fit kernel params by MAP (MLL + priors) with batched multi-start
-    L-BFGS; returns the fitted state and the raw log-params for warm starts
+    L-BFGS; returns the fitted state, the raw log-params for warm starts
     (reference ``fit_kernel_params:452`` retries with defaults on failure —
-    here the default start is *always* in the batch, so the retry is free).
+    here the default start is *always* in the batch, so the retry is free),
+    and a device-stat struct (``{"gp.ladder_rung": <unrealized i32>}``,
+    the :mod:`optuna_tpu.device_stats` convention) the caller harvests at
+    its own host boundary — deliberately NOT realized here, so the host can
+    keep pipelining acqf work while the fit program still runs.
     ``counts`` (optional, per-row) marks rows that stand for that many
     exact-duplicate observations (see ``samplers/_resilience.py::
     collapse_duplicate_rows``); the mask carries them so each such row's
@@ -247,10 +253,10 @@ def fit_gp(
     raw, _ = _fit_kernel_params_jit(
         starts_arr, jnp.asarray(Xp), jnp.asarray(yp), cat_mask, jnp.asarray(maskp), float(minimum_noise)
     )
-    state = _finalize_state(
+    state, rung = _finalize_state(
         raw, jnp.asarray(Xp), jnp.asarray(yp), cat_mask, jnp.asarray(maskp), float(minimum_noise)
     )
-    return state, np.asarray(raw)
+    return state, np.asarray(raw), {"gp.ladder_rung": rung}
 
 
 def posterior(

@@ -74,7 +74,10 @@ def _two_loop(state: LbfgsbState) -> jnp.ndarray:
 
 @partial(
     jax.jit,
-    static_argnames=("value_and_grad_fn", "max_iters", "history", "max_ls", "value_fn"),
+    static_argnames=(
+        "value_and_grad_fn", "max_iters", "history", "max_ls", "value_fn",
+        "return_n_iter",
+    ),
 )
 def lbfgsb(
     value_and_grad_fn: Callable[[jnp.ndarray], tuple[jnp.ndarray, jnp.ndarray]],
@@ -86,7 +89,8 @@ def lbfgsb(
     tol: float = 1e-8,
     max_ls: int = 16,
     value_fn: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    return_n_iter: bool = False,
+) -> tuple[jnp.ndarray, ...]:
     """Minimize ``B`` independent instances of a box-constrained problem.
 
     ``value_and_grad_fn`` maps (B, D) -> ((B,), (B, D)) and must be traceable;
@@ -94,7 +98,10 @@ def lbfgsb(
     ``max_ls`` step sizes in ONE batched call (``value_fn`` if given, else the
     value part of ``value_and_grad_fn``) — sequential depth per iteration is
     2 evaluations, not ``max_ls``, which is what latency-bound accelerators
-    care about.
+    care about. With ``return_n_iter`` the while-loop's iteration counter
+    joins the outputs as an i32 scalar — the ``gp.fit_iterations`` device
+    stat (:mod:`optuna_tpu.device_stats`): early convergence and
+    budget-exhausted fits become distinguishable from the host.
     """
     B, D = x0.shape
     x0 = jnp.clip(x0, lower, upper)
@@ -185,6 +192,8 @@ def lbfgsb(
         )
 
     final = jax.lax.while_loop(cond, body, init)
+    if return_n_iter:
+        return final.x, final.f, final.n_iter.astype(jnp.int32)
     return final.x, final.f
 
 

@@ -41,7 +41,7 @@ from typing import TYPE_CHECKING, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, flight, telemetry
+from optuna_tpu import _tracing, device_stats, flight, telemetry
 from optuna_tpu.exceptions import OptunaTPUError, UpdateFinishedTrialError
 from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
@@ -576,6 +576,20 @@ class ResilientBatchExecutor:
                 for k, v in packed.items()
             }
         values, finite = self._dispatch({k: jnp.asarray(v) for k, v in packed.items()})
+        # Device-stat tap: the per-batch quarantine count, straight from the
+        # in-graph isfinite mask the guarded wrapper already computed and
+        # _realize already transferred — zero extra dispatches, zero new
+        # host syncs. Sliced to the real width so SPMD padding (which
+        # repeats the last row, NaN included) never double-counts, and
+        # taken per completed dispatch so bisection/halving re-dispatches
+        # sum to exactly one count per quarantined trial. Under 'clip'
+        # nothing is quarantined (trials COMPLETE with nan_to_num values),
+        # so the stat stays 0 — it must agree with the executor.quarantine
+        # counter and the trials' terminal states, not the raw mask.
+        if device_stats.enabled() and self._non_finite != "clip":
+            device_stats.harvest(
+                {"executor.quarantined": int(b - np.count_nonzero(finite[:b]))}
+            )
         # A dispatch completed: the device is alive and the width fits.
         self._oom_attempts = 0
         self._leaf_strikes = 0

@@ -16,7 +16,7 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, flight, telemetry
+from optuna_tpu import _tracing, device_stats, flight, telemetry
 from optuna_tpu.distributions import BaseDistribution
 from optuna_tpu.logging import get_logger
 from optuna_tpu.samplers._base import (
@@ -311,7 +311,7 @@ class GPSampler(BaseSampler):
             y, _, _ = _standardize(score)
             Xc, yc, counts = collapse_duplicate_rows(X, y)
             with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
-                state, raw_params = fit_gp(
+                state, raw_params, fit_stats = fit_gp(
                     Xc,
                     yc.astype(np.float32),
                     is_cat,
@@ -320,6 +320,7 @@ class GPSampler(BaseSampler):
                     minimum_noise=1e-7 if self._deterministic else 1e-5,
                     counts=counts,
                 )
+            ladder_rungs = [fit_stats["gp.ladder_rung"]]
             self._kernel_params_cache[sig] = [raw_params]
             best = float(np.max(yc))
 
@@ -334,13 +335,16 @@ class GPSampler(BaseSampler):
                     stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
                 )
         else:
-            acqf_name, data, raws = self._build_logehvi(study, trials, X, is_cat, cat_mask, warm, seed)
+            acqf_name, data, raws, ladder_rungs = self._build_logehvi(
+                study, trials, X, is_cat, cat_mask, warm, seed
+            )
             self._kernel_params_cache[sig] = raws
 
         if self._constraints_func is not None:
-            acqf_name, data = self._wrap_constraints(
+            acqf_name, data, cons_rungs = self._wrap_constraints(
                 acqf_name, data, trials, X, is_cat, cat_mask, seed
             )
+            ladder_rungs = ladder_rungs + cons_rungs
 
         extra = X[-min(len(X), 4):]  # warm-start local search at recent incumbents
         with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
@@ -352,6 +356,13 @@ class GPSampler(BaseSampler):
                 extra_candidates=extra,
                 n_preliminary=self._n_preliminary_samples,
                 n_local_search=self._n_local_search,
+            )
+        # Host boundary: x_best just realized above, so the fit programs are
+        # long done — converting their rung scalars adds no new device sync.
+        if device_stats.enabled():
+            device_stats.harvest(
+                {"gp.ladder_rung": max(int(np.asarray(r)) for r in ladder_rungs)},
+                trial=trial.number,
             )
         return space.unnormalize_one(x_best)
 
@@ -505,7 +516,11 @@ class GPSampler(BaseSampler):
         # Phase split in the fused path: "ask.fit" is the host-side fit-input
         # packing (history collapse, starts, padding); the single device
         # program that fits AND proposes lands in "ask.propose" — the XLA
-        # dispatch is indivisible by design, so the split is host/device.
+        # dispatch is indivisible by design, so the *wall-clock* split is
+        # host/device. Inside-the-dispatch attribution is work-based instead:
+        # the program returns a device-stat struct (fit iterations, ladder
+        # rung, fallback coords, best acq — optuna_tpu.device_stats) that
+        # says what the indivisible dispatch actually spent its time on.
         with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
                 study, space, X, trials, warm
@@ -531,11 +546,14 @@ class GPSampler(BaseSampler):
                     fit_iters=fit_iters,
                     has_sweep=dev.has_sweep,
                 )
-        x_best, _, raw = out
+        x_best, _, raw, dev_stats = out
         self._kernel_params_cache[sig] = [np.asarray(raw)]
         self._precompile_after_dispatch(
             dev, X.shape[1], Xp.shape[0], 0, was_cold=warm is None or not len(warm)
         )
+        # Host boundary: raw realized above (same program), so harvesting the
+        # stats struct rides the transfer that already happened.
+        device_stats.harvest(dev_stats)
         # Snap stepped dims (the fused kernel treats them as continuous).
         x_np = snap_steps(space, np.asarray(x_best, dtype=np.float64))
         return space.unnormalize_one(x_np)
@@ -577,8 +595,9 @@ class GPSampler(BaseSampler):
                     fit_iters=fit_iters,
                     has_sweep=dev.has_sweep,
                 )
-        xs, _, raw = out
+        xs, _, raw, dev_stats = out
         self._kernel_params_cache[sig] = [np.asarray(raw)]
+        device_stats.harvest(dev_stats)
         self._precompile_after_dispatch(
             dev, X.shape[1], Xp.shape[0], q, was_cold=warm is None or not len(warm)
         )
@@ -702,12 +721,13 @@ class GPSampler(BaseSampler):
         M = loss_vals.shape[1]
         states = []
         raws = []
+        rungs = []
         std_vals = np.empty_like(loss_vals, dtype=np.float32)
         with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             for k in range(M):
                 yk, _, _ = _standardize(loss_vals[:, k])
                 std_vals[:, k] = yk
-                st, raw = fit_gp(
+                st, raw, fit_stats = fit_gp(
                     X,
                     yk.astype(np.float32),
                     is_cat,
@@ -716,6 +736,7 @@ class GPSampler(BaseSampler):
                 )
                 states.append(st)
                 raws.append(raw)
+                rungs.append(fit_stats["gp.ladder_rung"])
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
         worst = np.max(std_vals, axis=0)
@@ -731,7 +752,7 @@ class GPSampler(BaseSampler):
             qmc_z=jnp.asarray(qmc_z, dtype=jnp.float32),
             stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
         )
-        return "logehvi", data, raws
+        return "logehvi", data, raws, rungs
 
     def _wrap_constraints(self, acqf_name, data, trials, X, is_cat, cat_mask, seed):
         import jax
@@ -744,16 +765,18 @@ class GPSampler(BaseSampler):
 
         constraint_rows = [_constraints_list(t.system_attrs) for t in trials]
         if any(c is None for c in constraint_rows):
-            return acqf_name, data
+            return acqf_name, data, []
         cons = np.asarray(constraint_rows, dtype=np.float64)  # (n, C)
         states = []
         thresholds = []
+        rungs = []
         with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
             for k in range(cons.shape[1]):
                 yk, mu, sd = _standardize(cons[:, k])
-                st, _ = fit_gp(X, yk.astype(np.float32), is_cat, seed=seed + 101 + k)
+                st, _, fit_stats = fit_gp(X, yk.astype(np.float32), is_cat, seed=seed + 101 + k)
                 states.append(st)
                 thresholds.append((0.0 - mu) / sd)
+                rungs.append(fit_stats["gp.ladder_rung"])
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
         return f"constrained_{acqf_name}", ConstrainedData(
             base=data,
@@ -761,7 +784,7 @@ class GPSampler(BaseSampler):
             constraint_cat_mask=cat_mask,
             constraint_thresholds=jnp.asarray(np.asarray(thresholds), dtype=jnp.float32),
             stabilizing_noise=jnp.asarray(_STABILIZING_NOISE, dtype=jnp.float32),
-        )
+        ), rungs
 
     # ----------------------------------------------------------------- helpers
 

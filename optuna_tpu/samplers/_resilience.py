@@ -93,11 +93,15 @@ _guard_instance_seq = itertools.count()
 
 # ------------------------------------------------------- ring 1: in-graph
 
-def ladder_cholesky(K, *, initial_jitter: float = _LADDER_INITIAL_JITTER):
+def ladder_cholesky_with_rung(K, *, initial_jitter: float = _LADDER_INITIAL_JITTER):
     """Cholesky with an in-graph jitter ladder: factor ``K`` as-is, and while
     the factor is non-finite escalate additive diagonal jitter
     (``initial_jitter · 100^rung`` of the diagonal scale, up to
-    ``100^{max_rungs-1}``) and refactor.
+    ``100^{max_rungs-1}``) and refactor. Returns ``(L, rung)`` where
+    ``rung`` (i32 scalar, on device) is the number of escalation
+    refactorizations the ladder needed — 0 on the happy path, so it doubles
+    as the ``gp.ladder_rung`` device stat (:mod:`optuna_tpu.device_stats`):
+    a study silently paying three refactorizations per fit finally shows it.
 
     Everything — the ``isfinite`` verdict included — runs on device inside
     the surrounding trace (``lax.while_loop``), so there is no host sync and
@@ -128,9 +132,17 @@ def ladder_cholesky(K, *, initial_jitter: float = _LADDER_INITIAL_JITTER):
         return rung + 1, jnp.linalg.cholesky(K + eye * jitter)  # graphlint: ignore[SMP002] -- the ladder's own escalation rung: this call IS the guarded retry the rule points everyone at
 
     first = jnp.linalg.cholesky(K)  # graphlint: ignore[SMP002] -- this IS the ladder helper: the one blessed bare call, guarded by the escalation loop below
-    _, L = jax.lax.while_loop(
+    rung, L = jax.lax.while_loop(
         _unfinished, _next_rung, (jnp.asarray(0, jnp.int32), first)
     )
+    return L, rung
+
+
+def ladder_cholesky(K, *, initial_jitter: float = _LADDER_INITIAL_JITTER):
+    """:func:`ladder_cholesky_with_rung` for call sites that do not thread
+    the rung stat out (fantasy covariances, extended Grams): the factor
+    alone. Same graph — the rung is a dead output XLA drops."""
+    L, _ = ladder_cholesky_with_rung(K, initial_jitter=initial_jitter)
     return L
 
 

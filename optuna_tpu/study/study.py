@@ -363,16 +363,23 @@ class Study:
 
     def telemetry_snapshot(self) -> dict[str, Any]:
         """The process-wide telemetry snapshot (see :mod:`optuna_tpu.telemetry`):
-        study-loop phase histograms plus every containment counter the
-        resilience layers fired (retries, fallbacks, quarantines, reaps).
-        Enable recording with ``OPTUNA_TPU_TELEMETRY=1`` or
-        ``telemetry.enable()`` — with telemetry disabled the snapshot is
-        empty, not an error. Process-wide by design: workers are
+        study-loop phase histograms, every containment counter the
+        resilience layers fired (retries, fallbacks, quarantines, reaps),
+        the ``device.*`` gauges harvested from in-graph stats structs
+        (:mod:`optuna_tpu.device_stats`), and — under a ``"jit"`` key — the
+        flight recorder's per-label jit compile/retrace totals, so one
+        export surface carries host phases, device stats and compile counts
+        together. Enable recording with ``OPTUNA_TPU_TELEMETRY=1`` or
+        ``telemetry.enable()`` — with telemetry disabled the
+        counters/gauges/histograms are empty, not an error (the ``"jit"``
+        totals aggregate whenever flight *or* telemetry records, so they can
+        be non-empty with the registry off). Process-wide by design: workers
+        are
         single-study processes in the distributed layout, and the registry
         deliberately has no per-study sharding on the hot path."""
         from optuna_tpu import telemetry
 
-        return telemetry.snapshot()
+        return telemetry.export_snapshot()
 
     def trace_snapshot(self) -> dict[str, Any]:
         """The flight recorder's timeline as Chrome trace-event JSON (load

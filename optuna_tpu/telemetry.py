@@ -51,11 +51,14 @@ __all__ = [
     "COUNTERS",
     "PHASES",
     "MetricsRegistry",
+    "add_gauge",
     "count",
     "disable",
     "enable",
     "enabled",
+    "export_snapshot",
     "get_registry",
+    "max_gauge",
     "observe",
     "observe_phase",
     "render_prometheus",
@@ -201,6 +204,22 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
+
+    def add_gauge(self, name: str, delta: float) -> None:
+        """Accumulate into a gauge atomically (read-modify-write under the
+        registry lock): the device-stats harvest publishes per-dispatch
+        totals from concurrent threads, where a caller-side ``set_gauge(read
+        + delta)`` would lose updates."""
+        with self._lock:
+            self._gauges[name] = self._gauges.get(name, 0.0) + float(delta)
+
+    def max_gauge(self, name: str, value: float) -> None:
+        """Raise a gauge to ``value`` if larger, atomically — high-water
+        marks (max ladder rung, HBM peak) under concurrent harvesters."""
+        with self._lock:
+            current = self._gauges.get(name)
+            if current is None or value > current:
+                self._gauges[name] = float(value)
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
@@ -365,6 +384,20 @@ def set_gauge(name: str, value: float) -> None:
     _REGISTRY.set_gauge(name, value)
 
 
+def add_gauge(name: str, delta: float) -> None:
+    """Accumulate into a gauge (atomic); no-op while disabled."""
+    if not _enabled:
+        return
+    _REGISTRY.add_gauge(name, delta)
+
+
+def max_gauge(name: str, value: float) -> None:
+    """Raise a gauge to ``value`` if larger (atomic); no-op while disabled."""
+    if not _enabled:
+        return
+    _REGISTRY.max_gauge(name, value)
+
+
 def span(name: str):
     """Time a ``with`` block into the ``phase.<name>`` histogram. Returns a
     shared do-nothing singleton while disabled — the hot path pays one
@@ -376,6 +409,22 @@ def span(name: str):
 
 def snapshot() -> dict:
     return _REGISTRY.snapshot()
+
+
+def export_snapshot() -> dict:
+    """:func:`snapshot` plus the flight recorder's per-label jit
+    compile/retrace totals under a ``"jit"`` key — the one export surface
+    (``Study.telemetry_snapshot()``, ``/metrics.json``, ``optuna-tpu
+    metrics``) that carries host phases, device stats (``device.*`` gauges),
+    and compile counts together. The jit totals come from
+    :func:`optuna_tpu.flight.jit_totals`, which aggregates even when only
+    flight (not the metrics registry) was recording, so a compile that
+    happened before ``telemetry.enable()`` still shows up here."""
+    snap = snapshot()
+    from optuna_tpu import flight
+
+    snap["jit"] = flight.jit_totals()
+    return snap
 
 
 def render_prometheus() -> str:
@@ -418,7 +467,7 @@ def serve_metrics(port: int, host: str = "localhost"):
                 body = render_prometheus().encode()
                 content_type = "text/plain; version=0.0.4; charset=utf-8"
             elif self.path.split("?")[0] == "/metrics.json":
-                body = json.dumps(snapshot()).encode()
+                body = json.dumps(export_snapshot()).encode()
                 content_type = "application/json"
             elif self.path.split("?")[0] == "/trace.json":
                 from optuna_tpu import flight

@@ -69,7 +69,7 @@ class RegretBoundEvaluator(BaseImprovementEvaluator):
         sd = sd if sd > 1e-12 else 1.0
         y = ((score - mu) / sd).astype(np.float32)
 
-        state, _ = fit_gp(X, y, np.asarray(space.is_categorical), seed=0)
+        state, _, _ = fit_gp(X, y, np.asarray(space.is_categorical), seed=0)
         # beta from the GP-UCB analysis (reference uses beta = 2 log(d n^2 ...)).
         n, d = X.shape
         beta = 2.0 * math.log(max(d * n * n, 2))
@@ -139,8 +139,8 @@ class EMMREvaluator(BaseImprovementEvaluator):
         y = ((score - mu) / sd).astype(np.float32)
 
         cat = np.asarray(space.is_categorical)
-        state_now, _ = fit_gp(X, y, cat, seed=self._seed)
-        state_prev, _ = fit_gp(X[:-1], y[:-1], cat, seed=self._seed)
+        state_now, _, _ = fit_gp(X, y, cat, seed=self._seed)
+        state_prev, _, _ = fit_gp(X[:-1], y[:-1], cat, seed=self._seed)
 
         mean_n, var_n = posterior(state_now, jnp.asarray(X), jnp.asarray(cat))
         mean_p, var_p = posterior(state_prev, jnp.asarray(X), jnp.asarray(cat))

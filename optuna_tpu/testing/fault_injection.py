@@ -26,6 +26,11 @@ the faults they claim to absorb. This module provides:
   single-trial history — :data:`PATHOLOGICAL_HISTORY_PLANS` is the matrix),
   and :class:`FaultySampler` raises / hangs / proposes NaN at the n-th
   relative suggestion.
+* Device-stat chaos (:mod:`optuna_tpu.device_stats` is the layer under
+  test): :class:`DeviceStatChaosPlan` / :func:`device_stat_chaos_plan`
+  pins a rank-deficient Gram, scheduled NaN batch slots, and the exact
+  stats the in-graph channel must report (:data:`DEVICE_STAT_CHAOS_MATRIX`
+  is the matrix, synced by graphlint rule OBS003).
 
 Typical chaos test::
 
@@ -198,6 +203,75 @@ FLIGHT_EVENT_CHAOS_MATRIX: dict[str, str] = {
     "gauge": "device-gauge sample records HBM stats where the backend exposes them",
     "postmortem": "terminal batch failure / sampler degrade flushes a bounded dump",
 }
+
+
+# Chaos matrix for the device-stat channel: every stat name the harvest
+# harness accepts (``device_stats.py::DEVICE_STATS``) maps to the injection
+# scenario ``tests/test_device_stats_chaos.py`` must exercise against it.
+# Deliberately a hand-written literal (not an import of
+# ``device_stats.DEVICE_STATS``): graphlint rule OBS003 cross-checks both
+# against ``_lint/registry.py::DEVICE_STAT_REGISTRY`` — adding an in-graph
+# stat without deciding how to prove it reports is a lint failure (the
+# STO001/EXE001/SMP001/OBS002 pattern).
+DEVICE_STAT_CHAOS_MATRIX: dict[str, str] = {
+    "gp.ladder_rung": "inject a rank-deficient Gram; the in-graph ladder reports rung >= 1, "
+    "the well-conditioned twin reports 0",
+    "gp.fit_iterations": "run a fused GP ask; the stats struct reports >= 1 fit iterations",
+    "gp.proposal_fallback_coords": "fault-free fused ask; the count matches the plan exactly (0 — "
+    "no coordinate walked non-finite)",
+    "gp.best_acq": "run a fused GP ask; the reported best acquisition value is finite",
+    "executor.quarantined": "inject NaN at scheduled batch slots; the harvested total equals the "
+    "plan's slot count exactly, the fault-free twin reports 0",
+}
+
+
+@dataclass(frozen=True)
+class DeviceStatChaosPlan:
+    """One deterministic device-stat chaos scenario: which batch slots to
+    NaN-poison, how to build the rank-deficient Gram the jitter ladder must
+    resolve, and the exact stats the device channel must report
+    (``tests/test_device_stats_chaos.py`` asserts against these, the
+    executable form of :data:`DEVICE_STAT_CHAOS_MATRIX`).
+
+    The Gram injection targets the in-graph tap directly
+    (:func:`~optuna_tpu.samplers._resilience.ladder_cholesky_with_rung`
+    under jit) rather than riding a GP fit: the resilience rings upstream —
+    duplicate-row collapse, the MAP fit's non-finite loss guard — exist
+    precisely to keep real fits away from singular factorizations, so a
+    deterministic rung >= 1 needs the raw rank-deficient matrix the PR-5
+    ladder test established (an outer product: exactly singular, and a bare
+    TPU/f32 Cholesky hands back NaN for it without raising).
+    """
+
+    nan_slots: tuple[int, ...] = (1, 2)
+    batch_size: int = 4
+    n_trials: int = 4
+    gram_size: int = 8
+    expected_fallback_coords: int = 0
+    min_ladder_rung: int = 1
+
+    @property
+    def expected_quarantined(self) -> int:
+        return len(self.nan_slots)
+
+    def rank_deficient_gram(self) -> "np.ndarray":
+        """Exactly singular PSD matrix (rank one, no diagonal noise): the
+        Gram a bare Cholesky silently NaNs on."""
+        v = np.linspace(1.0, 2.0, self.gram_size, dtype=np.float32)
+        return np.outer(v, v)
+
+    def healthy_gram(self) -> "np.ndarray":
+        """The well-conditioned twin: the ladder's happy path, rung 0."""
+        return (
+            self.rank_deficient_gram()
+            + np.eye(self.gram_size, dtype=np.float32)
+        )
+
+
+def device_stat_chaos_plan() -> DeviceStatChaosPlan:
+    """The default :class:`DeviceStatChaosPlan` the chaos suite runs —
+    two NaN slots in a four-wide batch, an 8x8 rank-one Gram."""
+    return DeviceStatChaosPlan()
 
 
 # ----------------------------------------------------- device-dispatch chaos

@@ -2,7 +2,8 @@
 import jax
 import jax.numpy as jnp
 
-from optuna_tpu import flight, telemetry
+from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu.device_stats import harvest
 from optuna_tpu.logging import get_logger, warn_once
 
 _logger = get_logger(__name__)
@@ -38,3 +39,12 @@ def host_wrapper(x):
         return carry - 1
 
     return jax.lax.while_loop(lambda c: c > 0, body, x)
+
+
+@jax.jit
+def bad_harvest_in_jit(x):
+    # harvest() inside a trace would force a device->host sync per stat;
+    # the stats struct must be RETURNED and harvested at the boundary.
+    device_stats.harvest({"gp.ladder_rung": x})  # EXPECT: OBS001
+    harvest({"gp.ladder_rung": x})  # EXPECT: OBS001
+    return x * 2

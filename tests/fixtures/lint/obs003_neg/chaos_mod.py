@@ -1,0 +1,5 @@
+# graphlint fixture: OBS003 negative — both copies agree with the registry.
+DEVICE_STAT_CHAOS_MATRIX = {
+    "gp.rung": "inject a singular Gram; rung >= 1",
+    "exec.quarantined": "inject NaN slots; count matches exactly",
+}

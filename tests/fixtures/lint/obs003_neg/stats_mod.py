@@ -1,0 +1,5 @@
+# graphlint fixture: OBS003 negative — both copies agree with the registry.
+DEVICE_STATS = {
+    "gp.rung": "what the stat reports",
+    "exec.quarantined": "what the stat reports",
+}

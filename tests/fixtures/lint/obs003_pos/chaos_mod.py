@@ -1,0 +1,2 @@
+# graphlint fixture: OBS003 — this copy DRIFTED: 'exec.quarantined' is missing.
+DEVICE_STAT_CHAOS_MATRIX = {"gp.rung": "scenario"}  # EXPECT: OBS003

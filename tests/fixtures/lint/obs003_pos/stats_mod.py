@@ -1,0 +1,6 @@
+# graphlint fixture: OBS003 — this copy DRIFTED: 'gp.secret_stat' is extra.
+DEVICE_STATS = {  # EXPECT: OBS003
+    "gp.rung": "scenario",
+    "exec.quarantined": "scenario",
+    "gp.secret_stat": "scenario",
+}

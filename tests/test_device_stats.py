@@ -1,0 +1,316 @@
+"""Device-stats taps (ISSUE 9): the in-graph observability channel.
+
+Covers the harness contract (vocabulary sync, aggregation semantics, the
+telemetry/flight gating and the zero-per-trial-allocation disabled mode),
+the in-graph taps themselves (jitter-ladder rung, fused-program stats
+struct), and the export surfaces (``Study.telemetry_snapshot()``'s combined
+jit/device view, the ``optuna-tpu metrics`` dump, ``bench.py``'s
+``device_stats`` block). The end-to-end chaos acceptance lives in
+``tests/test_device_stats_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import sys
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu._lint import registry as lint_registry
+from optuna_tpu.samplers._random import RandomSampler
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability():
+    """Fresh registry + recorder per test; both disabled on exit so the
+    process-global switches never leak across the suite."""
+    telemetry.enable(telemetry.MetricsRegistry())
+    flight.enable(flight.FlightRecorder())
+    yield
+    telemetry.disable()
+    flight.disable()
+    flight.clear()
+
+
+# ------------------------------------------------------------- vocabulary
+
+
+def test_vocabulary_matches_canonical_registry_and_chaos_matrix():
+    from optuna_tpu.testing.fault_injection import DEVICE_STAT_CHAOS_MATRIX
+
+    canonical = set(lint_registry.DEVICE_STAT_REGISTRY)
+    assert set(device_stats.DEVICE_STATS) == canonical
+    assert set(device_stats.STAT_AGGREGATIONS) == canonical
+    assert set(DEVICE_STAT_CHAOS_MATRIX) == canonical
+    assert set(device_stats.STAT_AGGREGATIONS.values()) <= {"max", "total", "last"}
+
+
+def test_harvest_rejects_unknown_stat_names():
+    with pytest.raises(ValueError, match="unknown device stat"):
+        device_stats.harvest({"gp.made_up": 1})
+
+
+# ------------------------------------------------------------ aggregation
+
+
+def test_harvest_aggregation_semantics():
+    """max-stats keep the high-water mark, total-stats accumulate (and feed
+    a per-dispatch histogram), last-stats keep the most recent value."""
+    device_stats.harvest(
+        {
+            "gp.ladder_rung": 2,
+            "gp.fit_iterations": 10,
+            "gp.best_acq": -1.5,
+            "executor.quarantined": 3,
+        }
+    )
+    device_stats.harvest(
+        {
+            "gp.ladder_rung": 1,  # lower: must not regress the max
+            "gp.fit_iterations": 7,
+            "gp.best_acq": -0.5,
+            "executor.quarantined": 0,
+        }
+    )
+    gauges = device_stats.stat_gauges()
+    assert gauges["device.gp.ladder_rung.max"] == 2.0
+    assert gauges["device.gp.fit_iterations.total"] == 17.0
+    assert gauges["device.gp.best_acq.last"] == -0.5
+    assert gauges["device.executor.quarantined.total"] == 3.0
+    # total-aggregated stats also record a per-dispatch histogram.
+    hists = telemetry.snapshot()["histograms"]
+    assert hists["device.gp.fit_iterations"]["count"] == 2
+    assert hists["device.executor.quarantined"]["count"] == 2
+    assert "device.gp.ladder_rung" not in hists  # max-stats: gauge only
+
+
+def test_harvest_accepts_device_scalars():
+    import jax.numpy as jnp
+
+    device_stats.harvest({"gp.ladder_rung": jnp.asarray(3, jnp.int32)})
+    assert device_stats.stat_gauges()["device.gp.ladder_rung.max"] == 3.0
+
+
+def test_harvest_emits_flight_gauge_events_with_trial_tag():
+    device_stats.harvest({"gp.ladder_rung": 1}, trial=7)
+    evs = [ev for ev in flight.events() if ev.kind == "gauge"]
+    assert [(ev.name, ev.trial, ev.meta) for ev in evs] == [
+        ("device.gp.ladder_rung", 7, {"value": 1.0})
+    ]
+
+
+def test_gauge_name_spells_the_aggregation():
+    assert device_stats.gauge_name("gp.ladder_rung") == "device.gp.ladder_rung.max"
+    assert (
+        device_stats.gauge_name("executor.quarantined")
+        == "device.executor.quarantined.total"
+    )
+
+
+# ----------------------------------------------------- independent gating
+
+
+def test_flight_only_records_events_but_no_gauges():
+    telemetry.disable()
+    assert device_stats.enabled()
+    device_stats.harvest({"executor.quarantined": 2})
+    assert device_stats.stat_gauges(telemetry.snapshot()) == {}
+    assert [ev.name for ev in flight.events() if ev.kind == "gauge"] == [
+        "device.executor.quarantined"
+    ]
+
+
+def test_telemetry_only_records_gauges_but_no_events():
+    flight.disable()
+    assert device_stats.enabled()
+    device_stats.harvest({"executor.quarantined": 2})
+    assert device_stats.stat_gauges()["device.executor.quarantined.total"] == 2.0
+    assert flight.events() == []
+
+
+# ------------------------------------------------------- disabled-path cost
+
+
+def test_disabled_is_inert():
+    telemetry.disable()
+    flight.disable()
+    assert not device_stats.enabled()
+    device_stats.harvest({"gp.ladder_rung": 4})
+    telemetry.enable(telemetry.get_registry())
+    assert device_stats.stat_gauges() == {}
+
+
+def test_disabled_hot_path_allocates_no_per_trial_objects():
+    """The overhead contract (the telemetry spine's, verbatim): with both
+    telemetry and flight off, harvesting a prebuilt stats struct 10k times
+    must not grow the heap — bounded constant, not O(trials)."""
+    telemetry.disable()
+    flight.disable()
+    stats = {"gp.ladder_rung": 0, "executor.quarantined": 0}
+
+    def hot_trial():
+        if device_stats.enabled():  # the call sites' pre-check
+            device_stats.harvest({"executor.quarantined": 0})
+        device_stats.harvest(stats)  # the fused path: struct already exists
+
+    for _ in range(200):  # warm free lists / caches
+        hot_trial()
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        hot_trial()
+    gc.collect()
+    after = sys.getallocatedblocks()
+    assert after - before < 500
+
+
+# ----------------------------------------------------------- in-graph taps
+
+
+def test_ladder_rung_reports_in_graph():
+    """The rung threads out of the while_loop carry: >= 1 for an exactly
+    singular Gram, 0 on the happy path — fully inside jit, no host sync."""
+    import jax
+    import jax.numpy as jnp
+
+    from optuna_tpu.samplers._resilience import (
+        ladder_cholesky,
+        ladder_cholesky_with_rung,
+    )
+    from optuna_tpu.testing.fault_injection import device_stat_chaos_plan
+
+    plan = device_stat_chaos_plan()
+    laddered = jax.jit(ladder_cholesky_with_rung)
+    L, rung = laddered(jnp.asarray(plan.rank_deficient_gram()))
+    assert int(rung) >= plan.min_ladder_rung
+    assert bool(np.isfinite(np.asarray(L)).all())
+    L2, rung2 = laddered(jnp.asarray(plan.healthy_gram()))
+    assert int(rung2) == 0
+    # The rung-less wrapper returns the identical factor (same graph).
+    np.testing.assert_array_equal(
+        np.asarray(ladder_cholesky(jnp.asarray(plan.healthy_gram()))),
+        np.asarray(L2),
+    )
+
+
+def test_fit_gp_returns_ladder_rung_stat():
+    from optuna_tpu.gp.gp import fit_gp
+
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (8, 2)).astype(np.float32)
+    y = rng.normal(size=8).astype(np.float32)
+    state, raw, stats = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0)
+    assert set(stats) == {"gp.ladder_rung"}
+    assert int(np.asarray(stats["gp.ladder_rung"])) >= 0
+    device_stats.harvest(stats)
+    assert "device.gp.ladder_rung.max" in device_stats.stat_gauges()
+
+
+def test_serial_gp_ask_harvests_fused_stats():
+    """One fused GP ask publishes the whole struct: rung, fit iterations,
+    fallback coords (0 on a healthy run — the plan's exact expectation),
+    and a finite best-acquisition value, each also a flight gauge event."""
+    from optuna_tpu.samplers import GPSampler
+
+    study = optuna_tpu.create_study(
+        sampler=GPSampler(seed=0, n_startup_trials=4, precompile_ahead=False)
+    )
+    study.optimize(lambda t: (t.suggest_float("x", 0, 1) - 0.3) ** 2, n_trials=6)
+    gauges = device_stats.stat_gauges()
+    assert gauges["device.gp.fit_iterations.total"] >= 1
+    assert gauges["device.gp.ladder_rung.max"] >= 0
+    assert gauges["device.gp.proposal_fallback_coords.total"] == 0
+    assert np.isfinite(gauges["device.gp.best_acq.last"])
+    gauge_events = {ev.name for ev in flight.events() if ev.kind == "gauge"}
+    assert "device.gp.fit_iterations" in gauge_events
+
+
+# --------------------------------------------------------- export surfaces
+
+
+def test_telemetry_snapshot_carries_jit_totals_and_device_gauges():
+    """Satellite: one export surface — Study.telemetry_snapshot() (and the
+    /metrics.json it mirrors) carries host phases, device stats AND the jit
+    compile/retrace totals that previously lived only in flight's
+    per-label aggregates."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=2)
+    device_stats.harvest({"executor.quarantined": 1})
+    snap = study.telemetry_snapshot()
+    assert snap["gauges"]["device.executor.quarantined.total"] == 1.0
+    assert isinstance(snap["jit"], dict)
+    for totals in snap["jit"].values():
+        assert set(totals) == {"compiles", "compile_seconds", "retraces_after_first"}
+
+
+def test_metrics_json_endpoint_carries_jit_totals():
+    import urllib.request
+
+    telemetry.count("storage.retry")
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        snap = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/metrics.json", timeout=10
+            ).read().decode()
+        )
+        assert "jit" in snap
+        assert snap["counters"]["storage.retry"] == 1
+    finally:
+        server.shutdown()
+
+
+def test_cli_metrics_surfaces_device_stat_gauges(capsys):
+    from optuna_tpu import cli
+
+    device_stats.harvest(
+        {"gp.ladder_rung": 2, "gp.fit_iterations": 9, "executor.quarantined": 1}
+    )
+    assert cli.main(["metrics", "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["gauges"]["device.gp.ladder_rung.max"] == 2.0
+    assert out["gauges"]["device.gp.fit_iterations.total"] == 9.0
+    assert out["gauges"]["device.executor.quarantined.total"] == 1.0
+    assert "jit" in out
+
+
+def test_stat_gauges_filters_to_device_namespace():
+    telemetry.set_gauge("hbm.live_bytes", 123.0)
+    device_stats.harvest({"gp.ladder_rung": 1})
+    gauges = device_stats.stat_gauges()
+    assert set(gauges) == {"device.gp.ladder_rung.max"}
+
+
+def test_bench_device_stats_block_shape():
+    """bench.py's JSON-line block condenses the window's device gauges to
+    the three claw-back figures. Subprocess like every bench test: importing
+    bench in-process would block signals for the whole suite."""
+    import os
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import bench\n"
+        "from optuna_tpu import device_stats, telemetry\n"
+        "telemetry.enable(telemetry.MetricsRegistry())\n"
+        "device_stats.harvest({'gp.ladder_rung': 2, 'gp.fit_iterations': 33,"
+        " 'executor.quarantined': 4})\n"
+        "import json\n"
+        "print(json.dumps(bench._device_stats_breakdown()))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=repo_root,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    block = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert block == {"max_ladder_rung": 2, "fit_iterations": 33, "quarantined": 4}

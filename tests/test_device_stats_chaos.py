@@ -1,0 +1,186 @@
+"""Device-stat chaos acceptance (ISSUE 9): the in-graph channel reports an
+injected plan EXACTLY, and a fault-free twin reports all zeros.
+
+Mirrors the counter (``test_telemetry_chaos``) and flight
+(``test_flight_chaos``) chaos suites: one study with an injected
+rank-deficient Gram and scheduled NaN objective slots
+(``testing/fault_injection.py::device_stat_chaos_plan``) must report —
+through the device channel, not host-side bookkeeping — ladder rung >= 1,
+a fallback-coordinate count matching the plan, and the exact quarantine
+count; its fault-free twin must report zeros for every fault-indicating
+stat. The Gram injection targets the in-graph tap directly (see the plan's
+docstring: the resilience rings upstream exist precisely to keep real fits
+away from singular factorizations).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import optimize_vectorized
+from optuna_tpu.samplers import GPSampler
+from optuna_tpu.testing.fault_injection import (
+    DeviceStatChaosPlan,
+    FaultyVectorizedObjective,
+    device_stat_chaos_plan,
+)
+from optuna_tpu.trial._frozen import create_trial
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0, 1)}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability():
+    telemetry.enable(telemetry.MetricsRegistry())
+    flight.enable(flight.FlightRecorder())
+    yield
+    telemetry.disable()
+    flight.disable()
+    flight.clear()
+
+
+def _objective(params):
+    return (params["x"] - 0.5) ** 2
+
+
+def _seeded_study() -> "optuna_tpu.Study":
+    """A GP study with 8 distinct COMPLETE trials, so the batch ask runs the
+    fused chain program (the real producer of gp.* stats)."""
+    study = optuna_tpu.create_study(
+        sampler=GPSampler(seed=0, n_startup_trials=4, precompile_ahead=False)
+    )
+    rng = np.random.RandomState(0)
+    for _ in range(8):
+        x = float(rng.uniform(0, 1))
+        study.add_trial(
+            create_trial(
+                state=TrialState.COMPLETE,
+                params={"x": x},
+                distributions=dict(SPACE),
+                values=[(x - 0.5) ** 2],
+            )
+        )
+    return study
+
+
+def _inject_gram(plan: DeviceStatChaosPlan, *, faulty: bool) -> None:
+    """Run the rank-deficient (or healthy) Gram through the in-graph ladder
+    tap under jit and harvest the rung it reports — the device channel's
+    rung evidence for this window."""
+    import jax
+    import jax.numpy as jnp
+
+    from optuna_tpu.samplers._resilience import ladder_cholesky_with_rung
+
+    K = plan.rank_deficient_gram() if faulty else plan.healthy_gram()
+    L, rung = jax.jit(ladder_cholesky_with_rung)(jnp.asarray(K))
+    np.asarray(L)  # realize the primary output first: harvest rides the transfer
+    device_stats.harvest({"gp.ladder_rung": rung})
+
+
+def test_faulted_study_reports_plan_exactly_and_twin_reports_zeros():
+    plan = device_stat_chaos_plan()
+
+    # --- the faulted study: NaN slots in the first dispatch + the Gram.
+    study = _seeded_study()
+    faulty = FaultyVectorizedObjective(
+        _objective, SPACE, nan_at={0: list(plan.nan_slots)}
+    )
+    optimize_vectorized(
+        study, faulty, n_trials=plan.n_trials, batch_size=plan.batch_size
+    )
+    _inject_gram(plan, faulty=True)
+
+    gauges = device_stats.stat_gauges()
+    assert gauges["device.gp.ladder_rung.max"] >= plan.min_ladder_rung
+    assert (
+        gauges["device.executor.quarantined.total"] == plan.expected_quarantined
+    )
+    assert (
+        gauges["device.gp.proposal_fallback_coords.total"]
+        == plan.expected_fallback_coords
+    )
+    # The fused chain dispatch really ran and reported its work.
+    assert gauges["device.gp.fit_iterations.total"] >= 1
+    assert np.isfinite(gauges["device.gp.best_acq.last"])
+    # The quarantined trials really were told FAIL (channel matches state).
+    states = [t.state for t in study.trials[8:]]
+    assert states.count(TrialState.FAIL) == plan.expected_quarantined
+    # Every harvested stat also landed on the flight timeline as an ordered
+    # gauge event, beside the host-side containment events.
+    gauge_events = [ev.name for ev in flight.events() if ev.kind == "gauge"]
+    assert "device.executor.quarantined" in gauge_events
+    assert "device.gp.ladder_rung" in gauge_events
+    containments = [ev.name for ev in flight.events() if ev.kind == "containment"]
+    assert containments.count("executor.quarantine") == plan.expected_quarantined
+
+    # --- the fault-free twin: fresh window, same shapes, zero faults.
+    telemetry.enable(telemetry.MetricsRegistry())
+    flight.enable(flight.FlightRecorder())
+    twin = _seeded_study()
+    clean = FaultyVectorizedObjective(_objective, SPACE)
+    optimize_vectorized(
+        twin, clean, n_trials=plan.n_trials, batch_size=plan.batch_size
+    )
+    _inject_gram(plan, faulty=False)
+
+    twin_gauges = device_stats.stat_gauges()
+    assert twin_gauges["device.gp.ladder_rung.max"] == 0
+    assert twin_gauges["device.executor.quarantined.total"] == 0
+    assert twin_gauges["device.gp.proposal_fallback_coords.total"] == 0
+    assert all(t.state == TrialState.COMPLETE for t in twin.trials[8:])
+    assert [ev for ev in flight.events() if ev.kind == "containment"] == []
+
+
+def test_quarantine_stat_counts_each_trial_once_under_padding():
+    """SPMD-style ragged tails pad by repeating the last row — a NaN in the
+    tail slot must still count exactly once (the mask is sliced to the real
+    width at the boundary)."""
+    study = optuna_tpu.create_study()
+    faulty = FaultyVectorizedObjective(_objective, SPACE, nan_at={0: [2]})
+    optimize_vectorized(study, faulty, n_trials=3, batch_size=3)
+    assert (
+        device_stats.stat_gauges()["device.executor.quarantined.total"] == 1.0
+    )
+
+
+def test_clip_policy_quarantines_nothing_and_stat_agrees():
+    """Under non_finite='clip' every trial COMPLETEs with nan_to_num values
+    and nothing is quarantined — the device stat must agree with the
+    executor.quarantine counter and the terminal states, not report the raw
+    non-finite mask as quarantines."""
+    study = optuna_tpu.create_study()
+    faulty = FaultyVectorizedObjective(_objective, SPACE, nan_at={0: [1]})
+    optimize_vectorized(
+        study, faulty, n_trials=4, batch_size=4, non_finite="clip"
+    )
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert "device.executor.quarantined.total" not in device_stats.stat_gauges()
+    assert telemetry.get_registry().counter_value("executor.quarantine") == 0
+
+
+def test_disabled_chaos_records_nothing():
+    """The disabled-mode contract under chaos: the same faulted study with
+    both surfaces off leaves no gauges, no events — and the trials still
+    quarantine correctly (observability is read-only)."""
+    telemetry.disable()
+    flight.disable()
+    plan = device_stat_chaos_plan()
+    study = optuna_tpu.create_study()
+    faulty = FaultyVectorizedObjective(
+        _objective, SPACE, nan_at={0: list(plan.nan_slots)}
+    )
+    optimize_vectorized(
+        study, faulty, n_trials=plan.n_trials, batch_size=plan.batch_size
+    )
+    _inject_gram(plan, faulty=True)
+    assert flight.events() == []
+    telemetry.enable(telemetry.get_registry())
+    assert device_stats.stat_gauges() == {}
+    states = [t.state for t in study.trials]
+    assert states.count(TrialState.FAIL) == plan.expected_quarantined

@@ -369,6 +369,93 @@ def test_cli_trace_smoke_emits_valid_chrome_json(capsys, tmp_path):
     assert cli_main(["trace", "--format=events", "--endpoint", "http://x"]) == 2
 
 
+def test_filter_trial_keeps_one_trials_events_and_parent_spans():
+    """ISSUE 9 satellite: the single-trial slice keeps the trial's own
+    events plus the (transitive) parent spans they hang under, and nothing
+    else — ring order preserved."""
+    recorder = flight.get_recorder()
+    # A batch-level span two trials' events parent onto.
+    batch_span = recorder.new_span_id()
+    recorder.record("phase", "dispatch", dur=0.5, span=batch_span)
+    recorder.record("trial", "ask", trial=0)
+    recorder.record("trial", "ask", trial=1)
+    recorder.record(
+        "phase", "tell", dur=0.1, trial=0,
+        span=recorder.new_span_id(), parent=batch_span,
+    )
+    recorder.record("trial", "tell", trial=0)
+    recorder.record("trial", "tell", trial=1)
+    sliced = flight.filter_trial(flight.events(), 0)
+    assert [(ev.kind, ev.name, ev.trial) for ev in sliced] == [
+        ("phase", "dispatch", None),  # parent span, kept transitively
+        ("trial", "ask", 0),
+        ("phase", "tell", 0),
+        ("trial", "tell", 0),
+    ]
+
+
+def test_filter_chrome_trace_slices_rendered_payloads():
+    """The --endpoint flavor: filtering an already-rendered Chrome dict
+    keeps the trial's entries, their parent spans, metadata records, AND
+    counter tracks (gauge events lose their trial tag in rendering, so they
+    are kept as context rather than silently dropped)."""
+    recorder = flight.get_recorder()
+    batch_span = recorder.new_span_id()
+    recorder.record("phase", "dispatch", dur=0.5, span=batch_span)
+    recorder.record("trial", "ask", trial=0)
+    recorder.record(
+        "phase", "tell", dur=0.1, trial=0,
+        span=recorder.new_span_id(), parent=batch_span,
+    )
+    recorder.record("trial", "ask", trial=1)
+    recorder.record("gauge", "device.gp.ladder_rung", trial=0, meta={"value": 1.0})
+    sliced = flight.filter_chrome_trace(flight.chrome_trace(), 0)
+    names = [(e["name"], e.get("ph")) for e in sliced["traceEvents"]]
+    assert ("process_name", "M") in names  # metadata kept
+    assert ("dispatch", "X") in names  # parent span kept transitively
+    assert ("ask", "i") in names and ("tell", "X") in names
+    assert ("device.gp.ladder_rung", "C") in names  # counter track kept
+    # trial 1's lifecycle instant is gone.
+    trials = {
+        e["args"]["trial"]
+        for e in sliced["traceEvents"]
+        if isinstance(e.get("args"), dict) and "trial" in e.get("args", {})
+    }
+    assert trials == {0}
+
+
+def test_cli_trace_trial_filter(capsys):
+    """`optuna-tpu trace --trial N` dumps one trial's postmortem slice in
+    both formats instead of the whole ring."""
+    from optuna_tpu.cli import main as cli_main
+
+    with flight.span("dispatch") as batch:
+        pass
+    recorder = flight.get_recorder()
+    recorder.record("trial", "ask", trial=0)
+    recorder.record("trial", "ask", trial=1)
+    recorder.record(
+        "phase", "tell", dur=0.1, trial=1,
+        span=recorder.new_span_id(), parent=batch.span_id,
+    )
+    assert cli_main(["trace", "--trial", "1", "--format=events"]) == 0
+    events = json.loads(capsys.readouterr().out)
+    assert [(e["kind"], e.get("trial")) for e in events] == [
+        ("phase", None),  # the parent dispatch span
+        ("trial", 1),
+        ("phase", 1),
+    ]
+    assert cli_main(["trace", "--trial", "1", "--format=chrome"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    _validate_chrome_trace(data)
+    trials = {
+        e["args"]["trial"]
+        for e in data["traceEvents"]
+        if e.get("args", {}).get("trial") is not None
+    }
+    assert trials == {1}
+
+
 @pytest.mark.parametrize(
     "raw,expected",
     [

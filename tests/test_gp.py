@@ -93,7 +93,7 @@ def test_gp_interpolates_noiseless_data():
     X = rng.uniform(0, 1, (20, 2)).astype(np.float32)
     y = np.sin(4 * X[:, 0]) * np.cos(3 * X[:, 1])
     y = ((y - y.mean()) / y.std()).astype(np.float32)
-    state, _ = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0, minimum_noise=1e-7)
+    state, _, _ = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0, minimum_noise=1e-7)
     mean, var = posterior(state, jnp.asarray(X), jnp.asarray([False, False]))
     np.testing.assert_allclose(np.asarray(mean)[:20], y, atol=0.05)
 
@@ -101,7 +101,7 @@ def test_gp_interpolates_noiseless_data():
 def test_gp_posterior_var_grows_away_from_data():
     X = np.array([[0.5, 0.5]], dtype=np.float32)
     y = np.array([0.0], dtype=np.float32)
-    state, _ = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0)
+    state, _, _ = fit_gp(X, y, np.zeros(2, dtype=bool), seed=0)
     q = jnp.asarray([[0.5, 0.5], [0.0, 0.0]], dtype=jnp.float32)
     _, var = posterior(state, q, jnp.asarray([False, False]))
     assert float(var[1]) > float(var[0])

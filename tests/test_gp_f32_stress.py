@@ -214,7 +214,7 @@ def test_fit_stays_finite_under_stress(dup_frac: float) -> None:
     """End-to-end MAP fit (multi-start device L-BFGS) on stressed data must
     return finite params within the raw bounds and a usable posterior."""
     X, y, Xq = _problem(n=300, d=5, seed=7, dup_frac=dup_frac)
-    state, raw = fit_gp(X, y, np.zeros(5, bool))
+    state, raw, _ = fit_gp(X, y, np.zeros(5, bool))
     assert np.all(np.isfinite(raw)) and np.all(np.abs(raw) <= 15.0)
     mean, var = posterior(state, jnp.asarray(Xq), jnp.zeros((5,), bool))
     assert np.all(np.isfinite(np.asarray(mean)))

@@ -183,6 +183,69 @@ def test_obs002_gate_rejects_drift():
     assert all("wormhole" in f.message for f in drifted)
 
 
+def test_obs003_registry_matches_runtime_sets():
+    """The canonical device-stat registry equals the *runtime* values of
+    both hand-written copies (the lint compares them statically) — and the
+    harvest harness's aggregation table covers exactly the vocabulary."""
+    from optuna_tpu import device_stats
+    from optuna_tpu.testing.fault_injection import DEVICE_STAT_CHAOS_MATRIX
+
+    canonical = set(lint_registry.DEVICE_STAT_REGISTRY)
+    assert set(device_stats.DEVICE_STATS) == canonical
+    assert set(DEVICE_STAT_CHAOS_MATRIX) == canonical
+    assert set(device_stats.STAT_AGGREGATIONS) == canonical
+
+
+def test_obs003_gate_rejects_drift():
+    """Point OBS003 at the real files with a registry containing a stat the
+    code does not know: both copies must be reported as drifted — adding an
+    in-graph stat without an injection scenario proving it reports is a
+    lint failure (the STO001/EXE001/SMP001/OBS002 discipline)."""
+    fat_registry = dict(lint_registry.DEVICE_STAT_REGISTRY)
+    fat_registry["gp.phantom_stat"] = "made-up stat to prove the check is live"
+    config = Config(obs003_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.obs003_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "OBS003"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("gp.phantom_stat" in f.message for f in drifted)
+
+
+_OBS003_FIXTURE_REGISTRY = {
+    "gp.rung": "jitter escalations the factor needed",
+    "exec.quarantined": "non-finite slots in one dispatch",
+}
+
+
+def _obs003_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        obs003_registry=_OBS003_FIXTURE_REGISTRY,
+        obs003_targets=(
+            (f"fixtures/lint/{tree}/stats_mod.py", "DEVICE_STATS", "harness vocabulary"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "DEVICE_STAT_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_obs003_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "obs003_pos")
+    result = run_lint([tree], _obs003_config("obs003_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "gp.secret_stat" in by_file["stats_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_obs003_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "obs003_neg")
+    result = run_lint([tree], _obs003_config("obs003_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 def test_smp002_gate_fires_on_a_bare_cholesky_in_samplers():
     """Prove SMP002 is live against the real tree: a scan of the samplers
     subtree with the resilience module's pragmas ignored must flag exactly
