@@ -15,6 +15,7 @@ TPU004   stray print / jax.debug.print in package code
 OBS001   telemetry/flight/device-stats/logging call inside a jit trace of a device module
 OBS002   flight-recorder event vocabularies drifted from the canonical one
 OBS003   device-stat vocabularies drifted from the canonical one
+OBS004   study-doctor check vocabularies drifted from the canonical one
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 EXE001   non-finite quarantine policy sets drifted from the canonical one
@@ -48,6 +49,7 @@ def all_rules() -> list[Rule]:
         OBS001TelemetryInTrace,
         OBS002FlightEventSync,
         OBS003DeviceStatSync,
+        OBS004HealthCheckSync,
         TPU001HostSyncInJit,
         TPU002RecompileHazard,
         TPU003DtypeDrift,
@@ -72,6 +74,7 @@ def all_rules() -> list[Rule]:
         OBS001TelemetryInTrace(),
         OBS002FlightEventSync(),
         OBS003DeviceStatSync(),
+        OBS004HealthCheckSync(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
         EXE001NonFinitePolicySync(),

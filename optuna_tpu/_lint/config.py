@@ -93,6 +93,10 @@ class Config:
     obs003_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.DEVICE_STAT_REGISTRY
     )
+    obs004_targets: tuple[tuple[str, str, str], ...] = registry.OBS004_TARGETS
+    obs004_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.HEALTH_CHECK_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

@@ -219,6 +219,41 @@ OBS003_TARGETS: tuple[tuple[str, str, str], ...] = (
     ),
 )
 
+#: The study doctor's check-id vocabulary: every diagnostic finding
+#: ``optuna_tpu/health.py`` can emit carries one of these ids. Canonical
+#: mirror of ``health.py::HEALTH_CHECKS`` (rule **OBS004**, the STO001
+#: machinery pointed at fleet diagnostics). Values say what each check
+#: detects; every check must have a fault scenario in ``testing/
+#: fault_injection.py::HEALTH_CHECK_CHAOS_MATRIX`` (same rule) — a doctor
+#: check nobody has proven fires is worse than no check: it certifies sick
+#: studies healthy.
+HEALTH_CHECK_REGISTRY: dict[str, str] = {
+    "study.stagnation": "no new best value over the trailing window of completed tells",
+    "sampler.fallback_storm": "the configured sampler is degrading to the independent path at storm rate",
+    "sampler.duplicate_proposals": "completed trials repeat earlier parameter points at high rate",
+    "executor.quarantine_rate": "non-finite quarantines + heartbeat reaps are consuming the budget",
+    "executor.dispatch_timeouts": "repeated dispatch-deadline strikes (each abandons a watchdog thread)",
+    "jit.retrace_churn": "jit wrappers keep retracing after their first compile (runtime TPU002)",
+    "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
+    "worker.dead": "a worker's health snapshot went stale past its report interval",
+}
+
+#: The hand-maintained copies OBS004 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+OBS004_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/health.py",
+        "HEALTH_CHECKS",
+        "the doctor's accepted check ids (validated on every finding)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "HEALTH_CHECK_CHAOS_MATRIX",
+        "chaos matrix: every health check must have a fault scenario that fires it",
+    ),
+)
+
 #: The single blessed Cholesky call site for sampler code (rule **SMP002**):
 #: every kernel solve in ``optuna_tpu/samplers/`` must go through the
 #: jitter-ladder helper there, which escalates diagonal jitter in-graph until

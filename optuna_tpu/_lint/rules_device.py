@@ -213,7 +213,7 @@ class OBS001TelemetryInTrace(Rule):
     #: ``device_stats.harvest(...)``, ``logging_module.warn_once(...)``).
     _TAP_ROOTS = {
         "telemetry", "flight", "_flight", "device_stats", "_device_stats",
-        "logging", "logging_module",
+        "health", "_health", "logging", "logging_module",
     }
     #: Logger method names — flagged when called on something logger-shaped.
     _LOG_METHODS = {
@@ -292,6 +292,26 @@ class OBS002FlightEventSync(_RegistrySyncRule):
 
     def _targets(self, config):
         return config.obs002_targets
+
+
+class OBS004HealthCheckSync(_RegistrySyncRule):
+    """The STO001/.../OBS003 anti-drift machinery pointed at the study
+    doctor's check-id vocabulary: ``health.py::HEALTH_CHECKS`` and the chaos
+    matrix ``fault_injection.py::HEALTH_CHECK_CHAOS_MATRIX`` must both equal
+    the canonical ``registry.HEALTH_CHECK_REGISTRY`` — a diagnostic check
+    added without a fault scenario proving it fires is a lint failure, not a
+    review comment: an unproven doctor check certifies sick studies
+    healthy."""
+
+    id = "OBS004"
+    title = "study-doctor check vocabularies out of sync"
+    noun = "health checks"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.obs004_registry)
+
+    def _targets(self, config):
+        return config.obs004_targets
 
 
 class OBS003DeviceStatSync(_RegistrySyncRule):

@@ -2,10 +2,13 @@
 
 Parity target: ``optuna/cli.py:814-977`` — 11 subcommands including shell
 level ``ask``/``tell`` for driving distributed loops from scripts, with
-json/table/yaml output formats (``:156-273``); plus the ``metrics`` dump of
-the telemetry registry (``optuna_tpu/telemetry.py``) and the ``trace`` dump
-of the flight recorder's Chrome-trace timeline (``optuna_tpu/flight.py``) —
-neither has a reference analog.
+json/table/yaml output formats (``:156-273``); plus the observability
+surfaces with no reference analog: the ``metrics`` dump of the telemetry
+registry (``optuna_tpu/telemetry.py``), the ``trace`` dump of the flight
+recorder's Chrome-trace timeline (``optuna_tpu/flight.py``), the ``doctor``
+report of the study doctor's fleet diagnostics (``optuna_tpu/health.py``),
+and the ``trajectory`` rendering of the committed perf ledger
+(``BENCH_TRAJECTORY.json``).
 
 Entry points: ``python -m optuna_tpu.cli ...`` or the ``optuna-tpu`` console
 script.
@@ -16,6 +19,7 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
+import os
 import sys
 from typing import Any, Sequence
 
@@ -308,6 +312,140 @@ def _cmd_trace(args: argparse.Namespace) -> None:
         print(payload)
 
 
+def _cmd_doctor(args: argparse.Namespace) -> None:
+    """The study doctor's report (see :mod:`optuna_tpu.health`).
+
+    Without ``--endpoint`` the study is loaded from ``--storage`` and the
+    report computed in this process (the fleet view lives in the study's
+    system attrs, so any worker or operator shell can run the doctor);
+    with ``--endpoint`` the report is fetched from a serving process's
+    ``/health.json`` (the gRPC proxy's ``metrics_port``) and the matching
+    study's report rendered — byte-for-byte the same shape either way.
+    """
+    from optuna_tpu import health
+
+    if args.endpoint:
+        import urllib.request
+
+        base = args.endpoint.rstrip("/")
+        url = base if base.endswith("/health.json") else base + "/health.json"
+        with urllib.request.urlopen(url, timeout=10) as response:
+            payload = json.loads(response.read().decode())
+        reports = payload.get("reports", [])
+        report = next(
+            (r for r in reports if r.get("study") == args.study_name), None
+        )
+        if report is None:
+            known = sorted(r.get("study") for r in reports)
+            raise CLIUsageError(
+                f"endpoint serves no study named {args.study_name!r} "
+                f"(it has: {known})."
+            )
+    else:
+        storage = _storage(args)
+        study_id = storage.get_study_id_from_name(args.study_name)
+        report = health.health_report(
+            storage, study_id, study_name=args.study_name
+        )
+    if args.format == "json":
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(health.render_text(report))
+
+
+def _find_trajectory_file() -> str | None:
+    """Walk up from the working directory looking for the committed
+    ``BENCH_TRAJECTORY.json`` (the pyproject-discovery pattern): the CLI is
+    usually run from somewhere inside the repo that owns the ledger."""
+    cur = os.path.abspath(os.getcwd())
+    while True:
+        candidate = os.path.join(cur, "BENCH_TRAJECTORY.json")
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+
+
+def _cmd_trajectory(args: argparse.Namespace) -> None:
+    """Render the committed bench trajectory (``BENCH_TRAJECTORY.json``) —
+    per-round ours-side value, steady-state trials/s, device stats,
+    regressed/partial flags and git provenance — as a table or json,
+    replacing the hand-rolled jq the r03->r04 claw-back hunt needed.
+
+    Path resolution: ``--path``, then ``OPTUNA_TPU_BENCH_TRAJECTORY_PATH``
+    (the same override ``bench.py`` honors), then the nearest
+    ``BENCH_TRAJECTORY.json`` walking up from the working directory.
+    """
+    path = (
+        args.path
+        or os.environ.get("OPTUNA_TPU_BENCH_TRAJECTORY_PATH")
+        or _find_trajectory_file()
+    )
+    if path is None or not os.path.isfile(path):
+        raise CLIUsageError(
+            "no BENCH_TRAJECTORY.json found (looked at --path, "
+            "$OPTUNA_TPU_BENCH_TRAJECTORY_PATH, then upward from the "
+            "working directory); pass --path explicitly."
+        )
+    with open(path, encoding="utf-8") as f:
+        trajectory = json.load(f)
+    entries = trajectory.get("entries", [])
+    if args.metric:
+        entries = [e for e in entries if e.get("metric") == args.metric]
+    if args.format == "json":
+        # Full fidelity (phases, compile, device_stats blocks included):
+        # the jq-replacement surface.
+        print(json.dumps({"path": path, "entries": entries}, sort_keys=True))
+        return
+
+    def _git(entry: dict[str, Any]) -> str:
+        prov = entry.get("git") or {}
+        sha = prov.get("sha", "")[:9]
+        return sha + ("*" if prov.get("dirty") else "")
+
+    def _device(entry: dict[str, Any]) -> str:
+        stats = entry.get("device_stats") or {}
+        if not stats:
+            return ""
+        parts = []
+        if stats.get("max_ladder_rung") is not None:
+            parts.append(f"rung={stats['max_ladder_rung']}")
+        if stats.get("fit_iterations") is not None:
+            parts.append(f"fit={stats['fit_iterations']}")
+        if stats.get("quarantined") is not None:
+            parts.append(f"quar={stats['quarantined']}")
+        return " ".join(parts)
+
+    def _flags(entry: dict[str, Any]) -> str:
+        flags = []
+        if entry.get("regressed"):
+            flags.append("REGRESSED")
+        if entry.get("partial"):
+            flags.append("partial")
+        if entry.get("fallback"):
+            flags.append("fallback")
+        return ",".join(flags)
+
+    rows = [
+        {
+            "round": e.get("round"),
+            "captured": e.get("captured"),
+            "metric": e.get("metric"),
+            "mode": e.get("mode"),
+            "platform": e.get("platform"),
+            "value": e.get("value"),
+            "steady_state": e.get("steady_state_trials_per_sec", ""),
+            "device_stats": _device(e),
+            "flags": _flags(e),
+            "git": _git(e),
+        }
+        for e in entries
+    ]
+    print(_format_output(rows, "table"))
+
+
 def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="optuna-tpu")
     parser.add_argument("--storage", default=None, help="DB/journal/grpc URL")
@@ -390,6 +528,28 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "-o", "--output", default=None, help="write to this file instead of stdout"
+    )
+
+    p = add("doctor", _cmd_doctor)
+    p.add_argument("--study-name", required=True)
+    p.add_argument("-f", "--format", default="text", choices=["text", "json"])
+    p.add_argument(
+        "--endpoint",
+        default=None,
+        help="fetch /health.json from a serving process (e.g. http://host:9090) "
+        "instead of aggregating from --storage in this process",
+    )
+
+    p = add("trajectory", _cmd_trajectory)
+    p.add_argument("-f", "--format", default="table", choices=["table", "json"])
+    p.add_argument(
+        "--path",
+        default=None,
+        help="trajectory file (default: $OPTUNA_TPU_BENCH_TRAJECTORY_PATH, "
+        "then the nearest BENCH_TRAJECTORY.json walking up from the cwd)",
+    )
+    p.add_argument(
+        "--metric", default=None, help="filter entries to one bench metric"
     )
 
     p = add("tell", _cmd_tell)

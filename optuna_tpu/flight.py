@@ -84,6 +84,7 @@ __all__ = [
     "last_postmortem_path",
     "new_span_id",
     "postmortem",
+    "reset_jit_totals",
     "rpc_span",
     "sample_device_gauges",
     "snapshot",
@@ -561,6 +562,14 @@ def jit_totals() -> dict[str, dict[str, float]]:
             }
             for label, totals in _jit_totals.items()
         }
+
+
+def reset_jit_totals() -> None:
+    """Forget the cross-proxy per-label jit compile totals (tests isolating
+    a study's snapshot; production windows should diff :func:`jit_totals`
+    captures instead — the totals are process-lifetime by design)."""
+    with _jit_totals_lock:
+        _jit_totals.clear()
 
 
 def instrument_jit(fn: Callable, label: str) -> Callable:

@@ -1,0 +1,1054 @@
+"""Study doctor: fleet-wide telemetry aggregation + optimization-health checks.
+
+The telemetry spine, flight recorder and device-stats taps (PRs 6/8/9) are
+all *process-local* — but a study is a multi-worker object (gRPC clients,
+heartbeat survivors, retry clones), and a worker drowning in quarantines or
+sampler fallbacks is invisible to every other worker and to the user until
+the budget is spent. Asynchronous many-worker BO (Dorier et al.,
+arXiv:2210.00798) is exactly the regime where per-worker blindness hides a
+sick study; the reference Optuna (Akiba et al., arXiv:1907.10902) names easy
+monitoring as a framework pillar but ships only logging. This module is the
+study-scoped sibling of ``Study.telemetry_snapshot()``:
+
+* **Worker reporter** — :class:`HealthReporter` periodically publishes each
+  process's bounded telemetry snapshot (containment counters, ``device.*``/
+  ``jit.*``/``hbm.*`` gauges, phase histograms, jit compile totals, worker
+  id, last-seen timestamp) into storage as namespaced study system attrs
+  (``health:worker:<id>``) — the fleet view rides the storage layer every
+  backend already replicates, so no new wire protocol and no new process.
+* **Aggregator** — :func:`fleet_snapshot` merges the per-worker snapshots
+  into one fleet view: counters sum, ``.max``/``.last`` gauges take the max
+  (a point value has no cross-worker sum; the high-water mark is the
+  informative merge), everything else sums, histograms merge by bucket, and
+  per-worker liveness derives from last-seen age vs the published report
+  interval — a SIGKILL'd worker's snapshot goes stale exactly like its
+  heartbeat does.
+* **Diagnostics engine** — :func:`diagnose` runs stdlib-only rules over the
+  aggregate and the trial history and emits structured
+  :class:`HealthFinding` values (check id, severity, evidence counters,
+  remediation hint). The check-id vocabulary is :data:`HEALTH_CHECKS`,
+  canonical in ``_lint/registry.py::HEALTH_CHECK_REGISTRY`` and synced by
+  graphlint rule **OBS004** against the chaos matrix in
+  ``testing/fault_injection.py::HEALTH_CHECK_CHAOS_MATRIX`` — a check added
+  here without a chaos scenario proving it fires is a lint failure.
+
+Surfaces: ``Study.health_report()``, the ``optuna-tpu doctor`` CLI
+(text/json, ``--endpoint`` like ``metrics``/``trace``), ``/health.json``
+beside the gRPC proxy server's ``/metrics`` and ``/trace.json``, and a
+``warn_once`` per CRITICAL finding while ``optimize``/``optimize_vectorized``
+run with the reporter enabled.
+
+Overhead contract (telemetry's, verbatim): **off by default**; the disabled
+hot path (:func:`maybe_report` at trial/batch boundaries) is one
+module-global check and allocates nothing per trial (asserted by
+``tests/test_health.py``). Enabled, publishing is rate-limited by
+``interval_s`` and best-effort: a storage blip on the health attr write is
+warn_once'd, never study-fatal. Enable with ``OPTUNA_TPU_HEALTH=1``
+(``OPTUNA_TPU_HEALTH_INTERVAL_S`` overrides the cadence) or
+:func:`enable` / :func:`disable` at runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
+
+from optuna_tpu import telemetry
+from optuna_tpu.logging import get_logger, warn_once
+
+if TYPE_CHECKING:
+    from optuna_tpu.storages._base import BaseStorage
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.study.study import Study
+    from optuna_tpu.trial._frozen import FrozenTrial
+
+_logger = get_logger(__name__)
+
+__all__ = [
+    "CHECK_SEVERITIES",
+    "HEALTH_CHECKS",
+    "SEVERITIES",
+    "WORKER_ATTR_PREFIX",
+    "HealthFinding",
+    "HealthReporter",
+    "attach",
+    "diagnose",
+    "disable",
+    "enable",
+    "enabled",
+    "fleet_snapshot",
+    "flush",
+    "health_report",
+    "maybe_report",
+    "render_text",
+    "report_for_study",
+    "storage_health_reports",
+    "worker_snapshots",
+]
+
+
+# ------------------------------------------------------------- vocabulary
+
+#: The diagnostic check-id vocabulary: every finding the doctor can emit
+#: carries exactly one of these ids. Canonical mirror:
+#: ``_lint/registry.py::HEALTH_CHECK_REGISTRY`` — graphlint rule **OBS004**
+#: fails if this copy (or the chaos matrix in ``testing/fault_injection.py``)
+#: drifts, and ``tests/test_health.py`` asserts the rule table below covers
+#: exactly this set.
+HEALTH_CHECKS: dict[str, str] = {
+    "study.stagnation": "no new best value over the trailing window of completed tells",
+    "sampler.fallback_storm": "the configured sampler is degrading to the independent path at storm rate",
+    "sampler.duplicate_proposals": "completed trials repeat earlier parameter points at high rate",
+    "executor.quarantine_rate": "non-finite quarantines + heartbeat reaps are consuming the budget",
+    "executor.dispatch_timeouts": "repeated dispatch-deadline strikes (each abandons a watchdog thread)",
+    "jit.retrace_churn": "jit wrappers keep retracing after their first compile (runtime TPU002)",
+    "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
+    "worker.dead": "a worker's health snapshot went stale past its report interval",
+}
+
+#: Finding severities, mildest first. CRITICAL findings are additionally
+#: ``warn_once``'d while the reporter runs (the study is actively burning
+#: budget on something the operator would stop if they saw it).
+SEVERITIES: tuple[str, ...] = ("INFO", "WARNING", "CRITICAL")
+
+#: The fixed severity each check reports at (one check = one severity, so
+#: the hot path can know which checks *can* go CRITICAL without running
+#: them all — see :func:`_warn_critical_findings`). Keyed exactly by
+#: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py``).
+CHECK_SEVERITIES: dict[str, str] = {
+    "study.stagnation": "WARNING",
+    "sampler.fallback_storm": "CRITICAL",
+    "sampler.duplicate_proposals": "WARNING",
+    "executor.quarantine_rate": "WARNING",
+    "executor.dispatch_timeouts": "WARNING",
+    "jit.retrace_churn": "WARNING",
+    "gp.ladder_escalation": "WARNING",
+    "worker.dead": "CRITICAL",
+}
+
+#: Study system-attr namespace the reporter publishes under; one attr per
+#: worker (``health:worker:<worker id>``), overwritten in place so the
+#: storage holds exactly the latest snapshot per worker, not a history.
+WORKER_ATTR_PREFIX = "health:worker:"
+
+#: Default publish cadence. Deliberately coarser than a heartbeat: a health
+#: snapshot is a diagnosis input, not a liveness primitive — the heartbeat
+#: layer owns reaping, the doctor only *reports* staleness.
+DEFAULT_INTERVAL_S = 15.0
+
+#: A worker is reported dead when its snapshot age exceeds this multiple of
+#: the interval it promised to publish at (grace for GC pauses, storage
+#: retries, a slow batch between boundaries).
+LIVENESS_GRACE_FACTOR = 2.5
+
+# Diagnostic thresholds. Plain module constants, documented here and in
+# ARCHITECTURE.md's check table, so an operator reading a finding can see
+# exactly what tripped it; `diagnose` takes overrides for tests.
+STAGNATION_WINDOW = 16  # completed tells without a new best before flagging
+FALLBACK_STORM_RATE = 0.25  # fallbacks per finished trial
+FALLBACK_STORM_MIN = 4  # ...and at least this many in absolute terms
+QUARANTINE_RATE = 0.10  # quarantines+reaps per finished trial
+QUARANTINE_MIN = 3
+DISPATCH_TIMEOUT_STRIKES = 2  # watchdog strikes before flagging
+RETRACE_CHURN_MIN = 3  # retraces-after-first across all jit labels
+LADDER_RUNG_WARN = 3  # device.gp.ladder_rung.max at or above this escalates
+DUPLICATE_RATE = 0.25  # exact-duplicate completed trials per completed trial
+DUPLICATE_MIN = 4
+
+#: Gauge prefixes a worker snapshot carries (bounded: the device-stat and
+#: jit-label vocabularies are small by construction; everything else —
+#: ad-hoc gauges like ``batch_size`` — stays process-local).
+_SNAPSHOT_GAUGE_PREFIXES = ("device.", "jit.", "hbm.")
+_PHASE_HISTOGRAM_PREFIX = "phase."
+
+
+@dataclass(frozen=True)
+class HealthFinding:
+    """One structured diagnostic: what tripped, how bad, the numbers that
+    prove it, and what an operator should do about it."""
+
+    check: str
+    severity: str
+    summary: str
+    evidence: dict[str, Any] = field(default_factory=dict)
+    remediation: str = ""
+
+    def __post_init__(self) -> None:
+        if self.check not in HEALTH_CHECKS:
+            raise ValueError(
+                f"unknown health check {self.check!r}; the vocabulary is "
+                f"{sorted(HEALTH_CHECKS)} (HEALTH_CHECKS / HEALTH_CHECK_REGISTRY)."
+            )
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"unknown severity {self.severity!r}; must be one of {SEVERITIES}."
+            )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "check": self.check,
+            "severity": self.severity,
+            "summary": self.summary,
+            "evidence": dict(self.evidence),
+            "remediation": self.remediation,
+        }
+
+
+# ------------------------------------------------------- worker reporter
+
+
+class HealthReporter:
+    """Publishes this process's telemetry snapshot into the study's storage.
+
+    One reporter = one (study, worker) pair. ``clock`` (monotonic, for the
+    publish rate limit) and ``now`` (wall, for the last-seen stamp) are
+    injectable like :class:`~optuna_tpu.telemetry.MetricsRegistry`'s clock,
+    so tests drive publishes and staleness deterministically. Publishing is
+    best-effort by contract: the health attr is diagnostics, and a storage
+    blip on it must never become a study failure.
+
+    Snapshots are **deltas since the reporter attached** (the telemetry
+    registry is process-global by design, so a reporter constructed when
+    its study's run begins — :func:`attach` does this at every optimize
+    loop's entry — baselines the registry and publishes only what moved
+    since): a second study driven by the same process must not inherit the
+    first study's quarantine/fallback counts into its own rates. Two
+    studies optimizing *concurrently* in one process still share the
+    registry and therefore each other's deltas — the distributed layout is
+    one study per worker process, and the doctor inherits that assumption.
+    """
+
+    def __init__(
+        self,
+        study: "Study",
+        *,
+        worker_id: str | None = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        clock: Callable[[], float] = time.monotonic,
+        now: Callable[[], float] = time.time,
+    ) -> None:
+        from optuna_tpu import flight
+
+        self._study = study
+        self.worker_id = worker_id if worker_id is not None else default_worker_id()
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._now = now
+        self._last_publish: float | None = None
+        self._max_observed_gap = 0.0
+        self._seq = 0
+        self._lock = threading.Lock()
+        # The delta baseline: everything the process-global registry held
+        # when this reporter attached to its study belongs to whatever ran
+        # before, not to this study's fleet rates.
+        baseline = telemetry.snapshot()
+        self._baseline_counters: dict[str, int] = dict(baseline.get("counters", {}))
+        self._baseline_gauges: dict[str, float] = dict(baseline.get("gauges", {}))
+        self._baseline_histograms: dict[str, dict] = baseline.get("histograms", {})
+        self._baseline_jit: dict[str, dict] = flight.jit_totals()
+
+    def snapshot(self, *, final: bool = False, observed_gap: float = 0.0) -> dict[str, Any]:
+        """This worker's bounded health snapshot: the JSON-able dict the
+        aggregator merges. Bounded by construction — counters come from the
+        registered families, gauges are filtered to the ``device.``/``jit.``/
+        ``hbm.`` vocabularies, histograms to the ``phase.`` set — so the
+        study attr stays kilobytes no matter how long the worker runs.
+        Cumulative series (counters, ``.total`` gauges, ``jit.*`` gauges,
+        histograms, jit totals) are published as deltas vs the attach-time
+        baseline; level/high-water gauges (``.max``/``.last``/``hbm.*``)
+        publish their current value only when it moved since attach.
+        ``final`` marks a clean exit (see :func:`flush`): the aggregator
+        reports the worker *exited* instead of letting the snapshot age
+        into a false ``worker.dead``."""
+        from optuna_tpu import flight
+
+        snap = telemetry.snapshot()
+        counters = {}
+        for name, value in snap.get("counters", {}).items():
+            delta = value - self._baseline_counters.get(name, 0)
+            if delta > 0:
+                counters[name] = delta
+        gauges = {}
+        for name, value in snap.get("gauges", {}).items():
+            if not name.startswith(_SNAPSHOT_GAUGE_PREFIXES):
+                continue
+            base = self._baseline_gauges.get(name)
+            if name.endswith(".total") or name.startswith("jit."):
+                delta = value - (base or 0.0)
+                if delta > 0:
+                    gauges[name] = delta
+            elif base is None or value != base:
+                gauges[name] = value
+        histograms = {}
+        for name, hist in snap.get("histograms", {}).items():
+            if not name.startswith(_PHASE_HISTOGRAM_PREFIX):
+                continue
+            base_hist = self._baseline_histograms.get(name)
+            if base_hist is not None:
+                base_buckets = base_hist.get("buckets", {})
+                hist = {
+                    "count": hist["count"] - base_hist.get("count", 0),
+                    "sum": hist["sum"] - base_hist.get("sum", 0.0),
+                    "buckets": {
+                        bound: count - base_buckets.get(bound, 0)
+                        for bound, count in hist["buckets"].items()
+                    },
+                }
+            if hist["count"] > 0:
+                histograms[name] = hist
+        jit = {}
+        for label, totals in flight.jit_totals().items():
+            base_totals = self._baseline_jit.get(label, {})
+            delta = {
+                "compiles": totals["compiles"] - base_totals.get("compiles", 0),
+                "compile_seconds": round(
+                    totals["compile_seconds"]
+                    - base_totals.get("compile_seconds", 0.0),
+                    6,
+                ),
+                "retraces_after_first": totals["retraces_after_first"]
+                - base_totals.get("retraces_after_first", 0),
+            }
+            if delta["compiles"] > 0 or delta["retraces_after_first"] > 0:
+                jit[label] = delta
+        out = {
+            "worker": self.worker_id,
+            "pid": os.getpid(),
+            "seq": self._seq,
+            "last_seen_unix": self._now(),
+            "interval_s": self._promised_interval(observed_gap),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": histograms,
+            "jit": jit,
+        }
+        if final:
+            out["final"] = True
+        return out
+
+    def _promised_interval(self, observed_gap: float) -> float:
+        """The cadence the liveness grace is measured against. The reporter
+        only publishes at trial/batch boundaries, so a 60s objective makes
+        the configured 15s a promise it cannot keep — the published
+        interval adapts to the **slowest** observed publish gap (a running
+        max, not the latest gap: an alternating slow/fast objective must
+        not shrink the grace back after every fast trial and re-flag the
+        next slow one), and the aggregator's grace stretches with it. One
+        window remains: the *first* trial slower than the current grace can
+        read dead until its boundary publishes (documented in
+        ARCHITECTURE.md's liveness note)."""
+        self._max_observed_gap = max(self._max_observed_gap, observed_gap)
+        return max(self.interval_s, self._max_observed_gap)
+
+    def maybe_publish(self) -> bool:
+        """Publish if ``interval_s`` has elapsed since the last publish (the
+        first call always publishes). Returns True when a publish happened."""
+        with self._lock:
+            t = self._clock()
+            if (
+                self._last_publish is not None
+                and t - self._last_publish < self.interval_s
+            ):
+                return False
+        self.publish()
+        return True
+
+    def publish(self, *, final: bool = False) -> dict[str, Any] | None:
+        """Write this worker's snapshot attr now (unconditionally). Returns
+        the snapshot written, or None when the storage write failed — the
+        failure is warn_once'd and swallowed (diagnostics must never abort
+        the study they diagnose)."""
+        with self._lock:
+            t = self._clock()
+            observed_gap = 0.0 if self._last_publish is None else t - self._last_publish
+            self._last_publish = t
+            self._seq += 1
+        snapshot = self.snapshot(final=final, observed_gap=observed_gap)
+        try:
+            self._study._storage.set_study_system_attr(
+                self._study._study_id, WORKER_ATTR_PREFIX + self.worker_id, snapshot
+            )
+        except Exception as err:  # graphlint: ignore[PY001] -- best-effort diagnostics write: any storage failure here degrades to "no fresh snapshot", never a study abort; the aggregator reports the resulting staleness
+            warn_once(
+                _logger,
+                f"health_publish:{self._study._study_id}:{self.worker_id}",
+                f"publishing the health snapshot for worker {self.worker_id!r} "
+                f"raised {err!r}; the study continues, but the fleet view will "
+                "report this worker stale until a publish succeeds.",
+            )
+            return None
+        return snapshot
+
+
+def default_worker_id() -> str:
+    """``<hostname>-<pid>``: unique per process across the hosts of one
+    study, stable for the process lifetime (a retried trial keeps its
+    worker), and human-legible in the doctor's worker table."""
+    try:
+        host = socket.gethostname() or "host"
+    except OSError:
+        host = "host"
+    return f"{host}-{os.getpid()}"
+
+
+# ------------------------------------------------- module-level fast path
+
+_enabled = False
+_interval_s = DEFAULT_INTERVAL_S
+_worker_id: str | None = None
+_clock: Callable[[], float] = time.monotonic
+_now: Callable[[], float] = time.time
+
+
+def _env_enabled() -> bool:
+    """``OPTUNA_TPU_HEALTH``: unset/empty/0/false/no/off stay disabled (the
+    flight recorder's opt-out spellings — an explicit disable must not arm
+    the reporter), anything else enables."""
+    raw = os.environ.get("OPTUNA_TPU_HEALTH", "").strip()
+    return bool(raw) and raw.lower() not in ("0", "false", "no", "off")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(
+    *,
+    interval_s: float | None = None,
+    worker_id: str | None = None,
+    clock: Callable[[], float] | None = None,
+    now: Callable[[], float] | None = None,
+) -> None:
+    """Turn the reporter on for studies this process subsequently drives.
+    ``interval_s``/``worker_id``/``clock``/``now`` seed the reporters
+    :func:`maybe_report` lazily creates (tests inject deterministic clocks
+    here; a study already carrying a reporter keeps it)."""
+    global _enabled, _interval_s, _worker_id, _clock, _now
+    if interval_s is not None:
+        _interval_s = float(interval_s)
+    if worker_id is not None:
+        _worker_id = worker_id
+    if clock is not None:
+        _clock = clock
+    if now is not None:
+        _now = now
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def _reporter_for(study: "Study") -> HealthReporter:
+    reporter = study.__dict__.get("_health_reporter")
+    if reporter is None:
+        reporter = HealthReporter(
+            study,
+            worker_id=_worker_id,
+            interval_s=_interval_s,
+            clock=_clock,
+            now=_now,
+        )
+        study.__dict__["_health_reporter"] = reporter
+    return reporter
+
+
+def attach(study: "Study") -> None:
+    """Attach a reporter to ``study`` now (no publish yet): called at every
+    optimize loop's entry so the delta baseline is captured *before* the
+    run records anything — counters a previous study left in the
+    process-global registry must not leak into this study's snapshots. A
+    no-op while disabled; idempotent (an existing reporter keeps its
+    baseline)."""
+    if not _enabled:
+        return
+    _reporter_for(study)
+
+
+def maybe_report(study: "Study") -> None:
+    """The trial/batch-boundary hook ``Study.optimize`` and the batch
+    executor call: rate-limited publish + CRITICAL-finding warn pass. A
+    no-op (one module-global check, zero allocations) while disabled."""
+    if not _enabled:
+        return
+    if _reporter_for(study).maybe_publish():
+        _warn_critical_findings(study)
+
+
+def flush(study: "Study") -> None:
+    """Publish the terminal snapshot immediately (end of an optimize loop),
+    marked ``final``: the worker *exited* — the aggregator must not let the
+    snapshot age into a false ``worker.dead``. A no-op while disabled;
+    best-effort like every reporter write."""
+    if not _enabled:
+        return
+    _reporter_for(study).publish(final=True)
+
+
+#: The checks whose findings can be CRITICAL (derived from the severity
+#: table): the hot path's warn pass evaluates only these — stagnation and
+#: duplicate scans are O(trials) and only ever WARNING, so re-running them
+#: per publish would tax the optimize loop for findings it never warns on.
+_CRITICAL_CAPABLE: tuple[str, ...] = tuple(
+    check for check, severity in CHECK_SEVERITIES.items() if severity == "CRITICAL"
+)
+
+
+def _warn_critical_findings(study: "Study") -> None:
+    """Surface CRITICAL findings into the worker's own log, once per
+    (study, check) — the operator watching any worker's stderr learns the
+    study is sick without running the doctor. Only the CRITICAL-capable
+    checks run here (the full battery belongs to the report surfaces).
+    Best-effort: diagnosis reads storage, and a blip there must not fail
+    the loop that called us."""
+    try:
+        storage, study_id = study._storage, study._study_id
+        fleet = fleet_snapshot(storage, study_id)
+        trials = storage.get_all_trials(study_id, deepcopy=False)
+        findings = diagnose(
+            fleet, trials, study.directions, checks=_CRITICAL_CAPABLE
+        )
+    except Exception as err:  # graphlint: ignore[PY001] -- best-effort diagnosis on the hot path's rate-limited branch: a storage blip while *reading* the fleet view must not abort the optimize loop
+        _logger.info(f"health diagnosis skipped after read error: {err!r}")
+        return
+    for finding in findings:
+        if finding.severity != "CRITICAL":
+            continue
+        warn_once(
+            _logger,
+            f"health_finding:{study._study_id}:{finding.check}",
+            f"study doctor: CRITICAL [{finding.check}] {finding.summary} "
+            f"— {finding.remediation} (run `optuna-tpu doctor` for the "
+            "full report; this warning fires once per study+check, the "
+            "report keeps the live numbers.)",
+        )
+
+
+# ------------------------------------------------------------ aggregator
+
+
+def worker_snapshots(storage: "BaseStorage", study_id: int) -> dict[str, dict]:
+    """The raw per-worker snapshots currently in storage, keyed by worker
+    id. Non-dict values under the namespace are skipped (a corrupt attr must
+    not take the doctor down with it)."""
+    out: dict[str, dict] = {}
+    for key, value in storage.get_study_system_attrs(study_id).items():
+        if not key.startswith(WORKER_ATTR_PREFIX):
+            continue
+        if not isinstance(value, Mapping):
+            # Once per attr, not once per scrape: /health.json re-aggregates
+            # every few seconds, and one corrupt attr must not flood the log.
+            warn_once(
+                _logger,
+                f"health_malformed_attr:{study_id}:{key}",
+                f"ignoring malformed health snapshot attr {key!r} "
+                f"(expected a dict, got {type(value).__name__})",
+            )
+            continue
+        out[key[len(WORKER_ATTR_PREFIX):]] = dict(value)
+    return out
+
+
+def _merge_gauge(name: str) -> str:
+    # `.max` gauges are high-water marks and `.last` gauges point values —
+    # neither has a meaningful cross-worker sum, so both merge by max (the
+    # worst worker is the story). Everything else (`.total` device stats,
+    # `jit.compiles.<label>`, `hbm.*` bytes) is additive work.
+    if name.endswith((".max", ".last")):
+        return "max"
+    return "sum"
+
+
+def fleet_snapshot(
+    storage: "BaseStorage", study_id: int, *, now: float | None = None
+) -> dict[str, Any]:
+    """Merge every worker's published snapshot into one fleet view.
+
+    Counters sum; gauges merge per :func:`_merge_gauge`; histograms merge
+    bucket-by-bucket (counts and sums add; the bucket bounds are fixed
+    module-wide, so keys always line up); ``jit`` per-label totals sum.
+    Liveness: a worker is ``alive`` while its snapshot age is within
+    :data:`LIVENESS_GRACE_FACTOR` x the interval it published (falling back
+    to :data:`DEFAULT_INTERVAL_S` for snapshots that omit it); a snapshot
+    marked ``final`` (the terminal :func:`flush`) is an *exited* worker —
+    neither alive nor dead, its clean exit must not age into a false
+    ``worker.dead``.
+    """
+    now = time.time() if now is None else now
+    workers: list[dict[str, Any]] = []
+    counters: dict[str, int] = {}
+    gauges: dict[str, float] = {}
+    histograms: dict[str, dict] = {}
+    jit: dict[str, dict[str, float]] = {}
+    for worker_id, snap in sorted(worker_snapshots(storage, study_id).items()):
+        last_seen = float(snap.get("last_seen_unix", 0.0))
+        interval = float(snap.get("interval_s", DEFAULT_INTERVAL_S)) or DEFAULT_INTERVAL_S
+        age = max(0.0, now - last_seen)
+        exited = bool(snap.get("final"))
+        workers.append(
+            {
+                "worker": worker_id,
+                "pid": snap.get("pid"),
+                "seq": snap.get("seq"),
+                "last_seen_unix": last_seen,
+                "age_s": round(age, 3),
+                "interval_s": interval,
+                "exited": exited,
+                "alive": not exited and age <= LIVENESS_GRACE_FACTOR * interval,
+            }
+        )
+        for name, value in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(value)
+        for name, value in (snap.get("gauges") or {}).items():
+            value = float(value)
+            if _merge_gauge(name) == "max":
+                current = gauges.get(name)
+                if current is None or value > current:
+                    gauges[name] = value
+            else:
+                gauges[name] = gauges.get(name, 0.0) + value
+        for name, hist in (snap.get("histograms") or {}).items():
+            merged = histograms.setdefault(
+                name, {"count": 0, "sum": 0.0, "buckets": {}}
+            )
+            merged["count"] += int(hist.get("count", 0))
+            merged["sum"] += float(hist.get("sum", 0.0))
+            for bound, bucket_count in (hist.get("buckets") or {}).items():
+                merged["buckets"][bound] = (
+                    merged["buckets"].get(bound, 0) + int(bucket_count)
+                )
+        for label, totals in (snap.get("jit") or {}).items():
+            agg = jit.setdefault(
+                label, {"compiles": 0, "compile_seconds": 0.0, "retraces_after_first": 0}
+            )
+            agg["compiles"] += int(totals.get("compiles", 0))
+            agg["compile_seconds"] = round(
+                agg["compile_seconds"] + float(totals.get("compile_seconds", 0.0)), 6
+            )
+            agg["retraces_after_first"] += int(totals.get("retraces_after_first", 0))
+    return {
+        "workers": workers,
+        "n_workers": len(workers),
+        "n_alive": sum(1 for w in workers if w["alive"]),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "jit": jit,
+    }
+
+
+# ----------------------------------------------------- diagnostics engine
+
+
+def _counter_family_total(counters: Mapping[str, int], family: str) -> int:
+    return sum(
+        value
+        for name, value in counters.items()
+        if name == family or name.startswith(family + ".")
+    )
+
+
+def _check_stagnation(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    window = kw.get("stagnation_window", STAGNATION_WINDOW)
+    if len(directions) > 1:
+        return None  # Pareto stagnation needs a dominance notion; out of scope
+    from optuna_tpu.study._study_direction import StudyDirection
+    from optuna_tpu.trial._state import TrialState
+
+    completed = [
+        t for t in trials if t.state == TrialState.COMPLETE and t.values
+    ]
+    if len(completed) <= window:
+        return None
+    completed.sort(key=lambda t: t.number)
+    maximize = directions[0] == StudyDirection.MAXIMIZE
+    best_before = None
+    for t in completed[:-window]:
+        v = t.values[0]
+        if best_before is None or (v > best_before if maximize else v < best_before):
+            best_before = v
+    for t in completed[-window:]:
+        v = t.values[0]
+        if v > best_before if maximize else v < best_before:
+            return None  # the window improved: not stagnant
+    return HealthFinding(
+        check="study.stagnation",
+        severity=CHECK_SEVERITIES["study.stagnation"],
+        summary=(
+            f"no new best value in the last {window} completed trials "
+            f"(best still {best_before})"
+        ),
+        evidence={
+            "window": window,
+            "n_complete": len(completed),
+            "best_value": best_before,
+        },
+        remediation=(
+            "the search has plateaued: widen the search space, switch sampler "
+            "family (GP -> ES/CMA-ES for high-dim), or stop and bank the budget"
+        ),
+    )
+
+
+def _check_fallback_storm(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    fallbacks = _counter_family_total(fleet["counters"], "sampler.fallback")
+    finished = sum(1 for t in trials if t.state.is_finished())
+    rate = fallbacks / max(1, finished)
+    if fallbacks < FALLBACK_STORM_MIN or rate < FALLBACK_STORM_RATE:
+        return None
+    return HealthFinding(
+        check="sampler.fallback_storm",
+        severity=CHECK_SEVERITIES["sampler.fallback_storm"],
+        summary=(
+            f"{fallbacks} sampler fallbacks over {finished} finished trials "
+            f"({rate:.0%}): the configured sampler is effectively not running"
+        ),
+        evidence={"fallbacks": fallbacks, "finished_trials": finished, "rate": round(rate, 3)},
+        remediation=(
+            "the budget is being spent on independent/random sampling; check "
+            "the sampler_fallback:* trial attrs for the failure, fix the "
+            "history pathology or sampler config, or switch samplers"
+        ),
+    )
+
+
+def _check_duplicate_proposals(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    from optuna_tpu.trial._state import TrialState
+
+    completed = [t for t in trials if t.state == TrialState.COMPLETE]
+    seen: set[tuple] = set()
+    duplicates = 0
+    for t in completed:
+        key = tuple(sorted((name, repr(value)) for name, value in t.params.items()))
+        if key and key in seen:
+            duplicates += 1
+        else:
+            seen.add(key)
+    rate = duplicates / max(1, len(completed))
+    if duplicates < DUPLICATE_MIN or rate < DUPLICATE_RATE:
+        return None
+    return HealthFinding(
+        check="sampler.duplicate_proposals",
+        severity=CHECK_SEVERITIES["sampler.duplicate_proposals"],
+        summary=(
+            f"{duplicates} of {len(completed)} completed trials repeat an "
+            f"earlier parameter point exactly ({rate:.0%})"
+        ),
+        evidence={
+            "duplicates": duplicates,
+            "n_complete": len(completed),
+            "rate": round(rate, 3),
+        },
+        remediation=(
+            "duplicate proposals waste device evals: check for a collapsed "
+            "search space (all-categorical / step-quantized), retry-clone "
+            "storms, or a sampler stuck at its incumbent"
+        ),
+    )
+
+
+def _check_quarantine_rate(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    quarantines = _counter_family_total(fleet["counters"], "executor.quarantine")
+    reaps = _counter_family_total(fleet["counters"], "heartbeat.reap")
+    finished = sum(1 for t in trials if t.state.is_finished())
+    lost = quarantines + reaps
+    rate = lost / max(1, finished)
+    if lost < QUARANTINE_MIN or rate < QUARANTINE_RATE:
+        return None
+    return HealthFinding(
+        check="executor.quarantine_rate",
+        severity=CHECK_SEVERITIES["executor.quarantine_rate"],
+        summary=(
+            f"{quarantines} quarantined + {reaps} reaped of {finished} "
+            f"finished trials ({rate:.0%} of the budget lost to containment)"
+        ),
+        evidence={
+            "quarantines": quarantines,
+            "reaps": reaps,
+            "finished_trials": finished,
+            "rate": round(rate, 3),
+        },
+        remediation=(
+            "the containment layers are absorbing a systematic fault: check "
+            "fail_reason trial attrs for the NaN source (objective or "
+            "preprocessing), and worker stability if reaps dominate"
+        ),
+    )
+
+
+def _check_dispatch_timeouts(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    strikes = _counter_family_total(fleet["counters"], "executor.dispatch_timeout")
+    if strikes < DISPATCH_TIMEOUT_STRIKES:
+        return None
+    return HealthFinding(
+        check="executor.dispatch_timeouts",
+        severity=CHECK_SEVERITIES["executor.dispatch_timeouts"],
+        summary=f"{strikes} dispatch-deadline strikes (each abandons a watchdog thread)",
+        evidence={"strikes": strikes},
+        remediation=(
+            "dispatches are hanging: raise dispatch_deadline_s if the model "
+            "is legitimately slow, otherwise look for a width-dependent "
+            "deadlock in the objective (the flight trace shows which widths hung)"
+        ),
+    )
+
+
+def _check_retrace_churn(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    retraces = sum(
+        int(totals.get("retraces_after_first", 0))
+        for totals in fleet.get("jit", {}).values()
+    )
+    if retraces < RETRACE_CHURN_MIN:
+        return None
+    labels = sorted(
+        label
+        for label, totals in fleet.get("jit", {}).items()
+        if totals.get("retraces_after_first")
+    )
+    return HealthFinding(
+        check="jit.retrace_churn",
+        severity=CHECK_SEVERITIES["jit.retrace_churn"],
+        summary=(
+            f"{retraces} jit retraces after first compile "
+            f"(labels: {', '.join(labels)})"
+        ),
+        evidence={"retraces_after_first": retraces, "labels": labels},
+        remediation=(
+            "steady-state retracing means a shape or static-arg keeps "
+            "changing: pin batch widths to a fixed set (pad, don't vary) — "
+            "the runtime face of graphlint TPU002"
+        ),
+    )
+
+
+def _check_ladder_escalation(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    rung = fleet["gauges"].get("device.gp.ladder_rung.max")
+    if rung is None or rung < LADDER_RUNG_WARN:
+        return None
+    return HealthFinding(
+        check="gp.ladder_escalation",
+        severity=CHECK_SEVERITIES["gp.ladder_escalation"],
+        summary=(
+            f"the Cholesky jitter ladder escalated to rung {int(rung)} "
+            f"(>= {LADDER_RUNG_WARN}): Gram matrices are near-singular"
+        ),
+        evidence={"max_ladder_rung": rung},
+        remediation=(
+            "each rung is an extra on-device refactorization per fit: look "
+            "for duplicated/clustered history rows (retry-clone storms) or a "
+            "kernel length-scale collapsed by a degenerate objective"
+        ),
+    )
+
+
+def _check_worker_dead(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    # Exited workers flushed a final snapshot on a clean loop exit: not
+    # dead, however old that snapshot grows.
+    dead = [w for w in fleet["workers"] if not w["alive"] and not w.get("exited")]
+    if not dead:
+        return None
+    names = [w["worker"] for w in dead]
+    return HealthFinding(
+        check="worker.dead",
+        severity=CHECK_SEVERITIES["worker.dead"],
+        summary=(
+            f"{len(dead)} of {fleet['n_workers']} workers stale past their "
+            f"report interval: {', '.join(names)}"
+        ),
+        evidence={
+            "dead_workers": names,
+            "ages_s": {w["worker"]: w["age_s"] for w in dead},
+            "n_workers": fleet["n_workers"],
+        },
+        remediation=(
+            "a stale snapshot means the process died or wedged: its RUNNING "
+            "trials are reapable by heartbeat failover; check the host, then "
+            "re-launch the worker (retry clones re-enqueue its lost trials)"
+        ),
+    )
+
+
+#: The rule table: one function per check id, keyed exactly by
+#: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py`` — a check in
+#: the vocabulary without a rule, or vice versa, is a test failure).
+_CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
+    "study.stagnation": _check_stagnation,
+    "sampler.fallback_storm": _check_fallback_storm,
+    "sampler.duplicate_proposals": _check_duplicate_proposals,
+    "executor.quarantine_rate": _check_quarantine_rate,
+    "executor.dispatch_timeouts": _check_dispatch_timeouts,
+    "jit.retrace_churn": _check_retrace_churn,
+    "gp.ladder_escalation": _check_ladder_escalation,
+    "worker.dead": _check_worker_dead,
+}
+
+_SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}
+
+
+def diagnose(
+    fleet: dict,
+    trials: Sequence["FrozenTrial"],
+    directions: Sequence["StudyDirection"],
+    *,
+    checks: Sequence[str] | None = None,
+    **overrides: Any,
+) -> list[HealthFinding]:
+    """Run the registered checks over a fleet snapshot + trial history and
+    return the findings, most severe first (ties keep check-table order).
+    ``checks`` restricts the run to a subset of ids (the hot path's warn
+    pass evaluates only the CRITICAL-capable ones); ``overrides`` are
+    threshold keyword overrides individual checks accept (currently
+    ``stagnation_window``)."""
+    findings = []
+    for check, fn in _CHECK_FUNCS.items():
+        if checks is not None and check not in checks:
+            continue
+        finding = fn(fleet, trials, directions, **overrides)
+        if finding is not None:
+            assert finding.check == check
+            findings.append(finding)
+    findings.sort(key=lambda f: -_SEVERITY_ORDER[f.severity])
+    return findings
+
+
+# ----------------------------------------------------------------- report
+
+
+def health_report(
+    storage: "BaseStorage",
+    study_id: int,
+    *,
+    study_name: str | None = None,
+    now: float | None = None,
+    **overrides: Any,
+) -> dict[str, Any]:
+    """The doctor's full report for one study: fleet snapshot + liveness +
+    findings, as one JSON-able dict. This is the single implementation every
+    surface serves — ``Study.health_report()``, ``optuna-tpu doctor`` and
+    ``/health.json`` all return exactly this shape."""
+    now = time.time() if now is None else now
+    if study_name is None:
+        study_name = storage.get_study_name_from_id(study_id)
+    fleet = fleet_snapshot(storage, study_id, now=now)
+    trials = storage.get_all_trials(study_id, deepcopy=False)
+    directions = storage.get_study_directions(study_id)
+    findings = diagnose(fleet, trials, directions, **overrides)
+    from optuna_tpu.trial._state import TrialState
+
+    return {
+        "study": study_name,
+        "generated_unix": now,
+        "n_trials": len(trials),
+        "n_complete": sum(1 for t in trials if t.state == TrialState.COMPLETE),
+        "n_failed": sum(1 for t in trials if t.state == TrialState.FAIL),
+        "n_running": sum(1 for t in trials if t.state == TrialState.RUNNING),
+        "checks_evaluated": sorted(HEALTH_CHECKS),
+        "workers": fleet["workers"],
+        "fleet": {
+            "counters": fleet["counters"],
+            "gauges": fleet["gauges"],
+            "histograms": fleet["histograms"],
+            "jit": fleet["jit"],
+        },
+        "findings": [f.to_dict() for f in findings],
+        "healthy": not findings,
+    }
+
+
+def report_for_study(study: "Study", **kwargs: Any) -> dict[str, Any]:
+    """:func:`health_report` over a live :class:`Study` object."""
+    return health_report(
+        study._storage, study._study_id, study_name=study.study_name, **kwargs
+    )
+
+
+def storage_health_reports(
+    storage: "BaseStorage", *, now: float | None = None
+) -> dict[str, Any]:
+    """Reports for every study in a storage — the ``/health.json`` payload
+    the gRPC proxy server exposes beside ``/metrics`` (the hub owns the
+    storage, so it is the one process that can see the whole fleet)."""
+    now = time.time() if now is None else now
+    reports = []
+    for frozen in storage.get_all_studies():
+        reports.append(
+            health_report(
+                storage, frozen._study_id, study_name=frozen.study_name, now=now
+            )
+        )
+    return {"generated_unix": now, "reports": reports}
+
+
+def render_text(report: Mapping[str, Any]) -> str:
+    """The ``optuna-tpu doctor`` table rendering of one report: verdict
+    line, worker liveness, fleet containment counters, then one block per
+    finding with evidence and remediation."""
+    lines: list[str] = []
+    verdict = "HEALTHY" if report["healthy"] else (
+        f"{len(report['findings'])} finding(s)"
+    )
+    lines.append(
+        f"study {report['study']!r}: {verdict} — "
+        f"{report['n_complete']} complete / {report['n_failed']} failed / "
+        f"{report['n_running']} running of {report['n_trials']} trials"
+    )
+    workers = report.get("workers", ())
+    if workers:
+        lines.append("workers:")
+        for w in workers:
+            if w.get("exited"):
+                state = "exited"  # clean terminal flush: done, not dead
+            else:
+                state = "alive" if w["alive"] else "DEAD"
+            lines.append(
+                f"  {w['worker']}: {state} (last seen {w['age_s']:.1f}s ago, "
+                f"interval {w['interval_s']}s, seq {w.get('seq')})"
+            )
+    else:
+        lines.append(
+            "workers: none reported (enable the reporter with "
+            "OPTUNA_TPU_HEALTH=1 on the workers)"
+        )
+    counters = report.get("fleet", {}).get("counters", {})
+    if counters:
+        lines.append("fleet counters:")
+        for name in sorted(counters):
+            lines.append(f"  {name}: {counters[name]}")
+    for finding in report["findings"]:
+        lines.append(f"[{finding['severity']}] {finding['check']}: {finding['summary']}")
+        for key in sorted(finding["evidence"]):
+            lines.append(f"    {key}: {finding['evidence'][key]}")
+        if finding["remediation"]:
+            lines.append(f"    -> {finding['remediation']}")
+    return "\n".join(lines)
+
+
+# The environment switch mirrors telemetry's/flight's: set before import,
+# reporting is armed from trial zero.
+if _env_enabled():
+    interval_raw = os.environ.get("OPTUNA_TPU_HEALTH_INTERVAL_S", "").strip()
+    try:
+        enable(interval_s=float(interval_raw) if interval_raw else None)
+    except ValueError:
+        enable()

@@ -41,7 +41,7 @@ from typing import TYPE_CHECKING, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, device_stats, flight, telemetry
+from optuna_tpu import _tracing, device_stats, flight, health, telemetry
 from optuna_tpu.exceptions import OptunaTPUError, UpdateFinishedTrialError
 from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
@@ -278,6 +278,10 @@ class ResilientBatchExecutor:
             )
         study._stop_flag = False
         study._thread_local.in_optimize_loop = True  # callbacks may stop()
+        # Attach the health reporter before the first batch records
+        # anything, so its delta baseline excludes an earlier study's
+        # counters (no-op while the reporter is off).
+        health.attach(study)
         try:
             done = 0
             # OPTUNA_TPU_TRACE covers the vectorized loop the same way
@@ -287,6 +291,11 @@ class ResilientBatchExecutor:
                     done += self._run_one_batch(n_trials - done)
         finally:
             study._thread_local.in_optimize_loop = False
+            # Terminal health publish (no-op while the reporter is off): a
+            # run ending mid-interval must still land its last snapshot, so
+            # the fleet view shows this worker's final counters, not a
+            # stale mid-run state.
+            health.flush(study)
 
     def _run_one_batch(self, remaining: int) -> int:
         """One ask -> heartbeat(suggest + dispatch + tell) cycle; returns the
@@ -358,6 +367,9 @@ class ResilientBatchExecutor:
         # backend exposes memory stats): the high-water mark that tells an
         # OOM postmortem how close to the cliff the healthy batches ran.
         flight.sample_device_gauges()
+        # Batch-boundary health publish (rate-limited; one module-global
+        # check while the reporter is disabled).
+        health.maybe_report(study)
         return len(trials)
 
     # ----------------------------------------------------------------- phases

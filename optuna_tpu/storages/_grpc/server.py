@@ -164,20 +164,30 @@ def run_grpc_proxy_server(
     over HTTP (``/metrics`` Prometheus text, ``/metrics.json`` snapshot —
     :func:`optuna_tpu.telemetry.serve_metrics`) and turns recording on —
     metrics AND the flight recorder, whose Chrome-trace export is served at
-    ``/trace.json`` beside them: the storage hub is where op-token dedup
-    hits, server-side storage latencies live, and every worker's trace ids
-    cross, so this one endpoint stitches a fleet's timeline.
+    ``/trace.json`` beside them, AND the study doctor's ``/health.json``
+    (per-study fleet reports aggregated from the worker snapshots in the
+    backing storage — :func:`optuna_tpu.health.storage_health_reports`):
+    the storage hub is where op-token dedup hits, server-side storage
+    latencies live, every worker's trace ids cross, and every worker's
+    health snapshot lands, so this one endpoint watches a fleet.
     """
     import signal
+
+    from optuna_tpu import health
 
     server = make_grpc_server(storage, host, port, thread_pool_size)
     metrics_server = None
     if metrics_port is not None:
         telemetry.enable()
         flight.enable()
-        metrics_server = telemetry.serve_metrics(metrics_port, host=host)
+        metrics_server = telemetry.serve_metrics(
+            metrics_port,
+            host=host,
+            health_source=lambda: health.storage_health_reports(storage),
+        )
         _logger.info(f"Telemetry endpoint at http://{host}:{metrics_port}/metrics")
         _logger.info(f"Flight-trace endpoint at http://{host}:{metrics_port}/trace.json")
+        _logger.info(f"Study-doctor endpoint at http://{host}:{metrics_port}/health.json")
     server.start()
     _logger.info(f"Server started at {host}:{port}")
     _logger.info("Listening...")

@@ -21,7 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
-from optuna_tpu import exceptions, flight, logging as logging_module, telemetry
+from optuna_tpu import exceptions, flight, health, logging as logging_module, telemetry
 from optuna_tpu.progress_bar import _ProgressBar
 from optuna_tpu.study._tell import _tell_with_warning
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -213,6 +213,9 @@ def _worker(
                 callback(study, frozen)
             if progress_bar is not None:
                 progress_bar.update(budget.elapsed(), study)
+            # Trial-boundary health publish (rate-limited; one module-global
+            # check while the reporter is disabled).
+            health.maybe_report(study)
         except BaseException:  # graphlint: ignore[PY001] -- halt-then-reraise: the trial budget must stop even on SimulatedWorkerDeath/SystemExit; nothing is swallowed
             budget.halt()
             raise
@@ -244,6 +247,10 @@ def _optimize(
     progress_bar = _ProgressBar(show_progress_bar, n_trials, timeout)
     study._stop_flag = False
     budget = _RunBudget(study, n_trials, timeout)
+    # Attach the health reporter before the first trial records anything,
+    # so its delta baseline excludes whatever an earlier study left in the
+    # process-global registry (no-op while the reporter is off).
+    health.attach(study)
 
     try:
         if n_jobs == 1:
@@ -275,3 +282,6 @@ def _optimize(
     finally:
         study._thread_local.in_optimize_loop = False
         progress_bar.close()
+        # Terminal health publish: the worker's last snapshot must land even
+        # when the loop ends mid-interval (no-op while the reporter is off).
+        health.flush(study)

@@ -80,6 +80,10 @@ class Study:
     def __getstate__(self) -> dict[str, Any]:
         state = self.__dict__.copy()
         del state["_thread_local"]
+        # The health reporter (when the doctor attached one) is per-process
+        # by identity — its worker id embeds this pid and it holds a lock —
+        # so an unpickled study mints a fresh one on its first report.
+        state.pop("_health_reporter", None)
         return state
 
     def __setstate__(self, state: dict[str, Any]) -> None:
@@ -362,24 +366,46 @@ class Study:
         return _trials_dataframe(self, attrs, multi_index)
 
     def telemetry_snapshot(self) -> dict[str, Any]:
-        """The process-wide telemetry snapshot (see :mod:`optuna_tpu.telemetry`):
-        study-loop phase histograms, every containment counter the
-        resilience layers fired (retries, fallbacks, quarantines, reaps),
-        the ``device.*`` gauges harvested from in-graph stats structs
-        (:mod:`optuna_tpu.device_stats`), and — under a ``"jit"`` key — the
-        flight recorder's per-label jit compile/retrace totals, so one
-        export surface carries host phases, device stats and compile counts
-        together. Enable recording with ``OPTUNA_TPU_TELEMETRY=1`` or
-        ``telemetry.enable()`` — with telemetry disabled the
-        counters/gauges/histograms are empty, not an error (the ``"jit"``
-        totals aggregate whenever flight *or* telemetry records, so they can
-        be non-empty with the registry off). Process-wide by design: workers
-        are
-        single-study processes in the distributed layout, and the registry
-        deliberately has no per-study sharding on the hot path."""
+        """The **process-local** telemetry snapshot (see
+        :mod:`optuna_tpu.telemetry`): study-loop phase histograms, every
+        containment counter the resilience layers fired (retries, fallbacks,
+        quarantines, reaps), the ``device.*`` gauges harvested from in-graph
+        stats structs (:mod:`optuna_tpu.device_stats`), and — under a
+        ``"jit"`` key — the flight recorder's per-label jit compile/retrace
+        totals, so one export surface carries host phases, device stats and
+        compile counts together. Enable recording with
+        ``OPTUNA_TPU_TELEMETRY=1`` or ``telemetry.enable()`` — with
+        telemetry disabled the counters/gauges/histograms are empty, not an
+        error (the ``"jit"`` totals aggregate whenever flight *or* telemetry
+        records, so they can be non-empty with the registry off).
+
+        Process-local by design: the registry deliberately has no per-study
+        sharding on the hot path, so this snapshot only sees what *this
+        process* did. The study-scoped sibling is :meth:`health_report` —
+        with the health reporter enabled (``OPTUNA_TPU_HEALTH=1``), every
+        worker publishes this snapshot into storage and the doctor merges
+        them into one fleet view (see :mod:`optuna_tpu.health`)."""
         from optuna_tpu import telemetry
 
         return telemetry.export_snapshot()
+
+    def health_report(self, **kwargs: Any) -> dict[str, Any]:
+        """The study doctor's **fleet-wide** report (see
+        :mod:`optuna_tpu.health`): every worker's published telemetry
+        snapshot merged (counters summed, high-water gauges maxed,
+        histograms merged by bucket), per-worker liveness derived from
+        snapshot age, and the diagnostic findings (stagnation, sampler
+        fallback storms, quarantine/reap rate, dispatch timeouts, jit
+        retrace churn, ladder escalation, duplicate proposals, dead
+        workers) with severities and remediation hints. The same report is
+        served by ``optuna-tpu doctor`` and the gRPC proxy's
+        ``/health.json``. Workers publish only while the reporter is
+        enabled (``OPTUNA_TPU_HEALTH=1`` or ``health.enable()``); with no
+        snapshots in storage the report still renders — trial-history
+        checks (stagnation, duplicates) run on any study."""
+        from optuna_tpu import health
+
+        return health.report_for_study(self, **kwargs)
 
     def trace_snapshot(self) -> dict[str, Any]:
         """The flight recorder's timeline as Chrome trace-event JSON (load

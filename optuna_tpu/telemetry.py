@@ -269,18 +269,46 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format (v0.0.4): metric names are
-        sanitized (dots -> underscores) under the ``optuna_tpu_`` namespace;
-        histogram buckets are cumulative with the conventional ``le`` label."""
+        sanitized (non-``[a-zA-Z0-9_]`` -> underscores) under the
+        ``optuna_tpu_`` namespace; histogram buckets are cumulative with the
+        conventional ``le`` label. **Dynamic-suffix families** — counters
+        like ``sampler.fallback.<family>`` and the per-label jit gauges —
+        render the suffix as an escaped *label* instead of flattening it
+        into the metric name: the suffix is open vocabulary (a sampler
+        phase, a user-chosen jit label) and flattening it would mint one
+        metric name per value, break aggregation across the family, and let
+        an unsanitized character corrupt the exposition."""
         lines: list[str] = []
         snap = self.snapshot()
+        emitted_types: set[str] = set()
+
+        def emit(metric: str, kind: str, labels: str, value: str) -> None:
+            if metric not in emitted_types:
+                emitted_types.add(metric)
+                lines.append(f"# TYPE {metric} {kind}")
+            lines.append(f"{metric}{labels} {value}")
+
         for name, value in sorted(snap["counters"].items()):
-            metric = _prom_name(name) + "_total"
-            lines.append(f"# TYPE {metric} counter")
-            lines.append(f"{metric} {value}")
+            family = _split_labeled(name, _LABELED_COUNTER_FAMILIES)
+            if family is not None:
+                base, label_name, label_value = family
+                emit(
+                    _prom_name(base) + "_total", "counter",
+                    _render_labels({label_name: label_value}), str(value),
+                )
+            else:
+                emit(_prom_name(name) + "_total", "counter", "", str(value))
         for name, value in sorted(snap["gauges"].items()):
-            metric = _prom_name(name)
-            lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {_format_value(value)}")
+            family = _split_labeled(name, _LABELED_GAUGE_FAMILIES)
+            if family is not None:
+                base, label_name, label_value = family
+                emit(
+                    _prom_name(base), "gauge",
+                    _render_labels({label_name: label_value}),
+                    _format_value(value),
+                )
+            else:
+                emit(_prom_name(name), "gauge", "", _format_value(value))
         for name, hist in sorted(snap["histograms"].items()):
             metric = _prom_name(name) + "_seconds"
             lines.append(f"# TYPE {metric} histogram")
@@ -302,8 +330,64 @@ def _format_value(value: float) -> str:
 
 
 def _prom_name(name: str) -> str:
-    cleaned = "".join(c if c.isalnum() else "_" for c in name)
+    # Explicitly ASCII: str.isalnum() admits any Unicode letter/digit, which
+    # the exposition grammar ([a-zA-Z0-9_:]) does not — a gauge named with a
+    # non-ASCII character must sanitize, not corrupt the scrape.
+    cleaned = "".join(
+        c if (c.isascii() and c.isalnum()) else "_" for c in name
+    )
     return "optuna_tpu_" + cleaned
+
+
+#: Metric families whose trailing segment is open vocabulary and therefore
+#: renders as a label, as ``{family prefix: label name}``. The counter side
+#: is exactly the ``(suffixed)`` families in :data:`COUNTERS`; the gauge
+#: side is the per-label jit instrumentation from :mod:`optuna_tpu.flight`.
+_LABELED_COUNTER_FAMILIES: dict[str, str] = {"sampler.fallback": "family"}
+_LABELED_GAUGE_FAMILIES: dict[str, str] = {
+    "jit.compiles": "label",
+    "jit.compile_seconds": "label",
+    "jit.retraces_after_first": "label",
+}
+
+
+def _split_labeled(
+    name: str, families: Mapping[str, str]
+) -> tuple[str, str, str] | None:
+    """``(family, label name, label value)`` when ``name`` extends a labeled
+    family (``sampler.fallback.relative`` -> ``("sampler.fallback",
+    "family", "relative")``); None for everything else, including the bare
+    family name (which renders unlabeled — a legal series of the same
+    metric)."""
+    for family, label_name in families.items():
+        if name.startswith(family + ".") and len(name) > len(family) + 1:
+            return family, label_name, name[len(family) + 1:]
+    return None
+
+
+def _escape_label_value(value: str) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote and
+    newline are the three characters the grammar reserves."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _render_labels(labels: Mapping[str, str]) -> str:
+    inner = ",".join(
+        f'{_prom_label_name(k)}="{_escape_label_value(str(v))}"'
+        for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
+def _prom_label_name(name: str) -> str:
+    cleaned = "".join(
+        c if (c.isascii() and c.isalnum()) else "_" for c in name
+    )
+    # Label names may not start with a digit (metric names dodge this via
+    # the optuna_tpu_ prefix; labels have no such shield).
+    return ("_" + cleaned) if cleaned[:1].isdigit() else (cleaned or "_")
 
 
 # ------------------------------------------------- module-level fast path
@@ -451,14 +535,23 @@ def phase_totals(snap: Mapping | None = None) -> dict[str, dict[str, float]]:
     return out
 
 
-def serve_metrics(port: int, host: str = "localhost"):
+def serve_metrics(
+    port: int,
+    host: str = "localhost",
+    health_source: Callable[[], Mapping] | None = None,
+):
     """Serve the registry over HTTP on a daemon thread and return the server
     (call ``.shutdown()`` to stop it). Endpoints: ``/metrics`` (Prometheus
-    text), ``/metrics.json`` (the :func:`snapshot` dict), and
-    ``/trace.json`` (the flight recorder's Chrome-trace export — empty
-    ``traceEvents`` while flight recording is off). Stdlib-only; used by
-    the gRPC proxy server's ``metrics_port=`` knob so a fleet scraper can
-    watch the storage hub without extra dependencies."""
+    text), ``/metrics.json`` (the :func:`snapshot` dict), ``/trace.json``
+    (the flight recorder's Chrome-trace export — empty ``traceEvents``
+    while flight recording is off), and — when ``health_source`` is given —
+    ``/health.json`` (the study doctor's fleet reports; the gRPC proxy
+    server passes :func:`optuna_tpu.health.storage_health_reports` over its
+    backing storage, the one process that can see the whole fleet). Without
+    a source, ``/health.json`` is 404: this process has no storage to
+    aggregate over. Stdlib-only; used by the gRPC proxy server's
+    ``metrics_port=`` knob so a fleet scraper can watch the storage hub
+    without extra dependencies."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
@@ -473,6 +566,17 @@ def serve_metrics(port: int, host: str = "localhost"):
                 from optuna_tpu import flight
 
                 body = json.dumps(flight.chrome_trace()).encode()
+                content_type = "application/json"
+            elif self.path.split("?")[0] == "/health.json":
+                if health_source is None:
+                    self.send_error(404)
+                    return
+                try:
+                    payload = health_source()
+                except Exception as err:  # graphlint: ignore[PY001] -- HTTP boundary: a storage blip while aggregating must come back as a 500 to the scraper, never kill the serving thread
+                    self.send_error(500, f"health aggregation failed: {err!r}")
+                    return
+                body = json.dumps(payload).encode()
                 content_type = "application/json"
             else:
                 self.send_error(404)

@@ -31,6 +31,13 @@ the faults they claim to absorb. This module provides:
   pins a rank-deficient Gram, scheduled NaN batch slots, and the exact
   stats the in-graph channel must report (:data:`DEVICE_STAT_CHAOS_MATRIX`
   is the matrix, synced by graphlint rule OBS003).
+* Study-doctor chaos (:mod:`optuna_tpu.health` is the layer under test):
+  :class:`HealthChaosPlan` / :func:`health_chaos_plan` combines NaN batch
+  slots, a pathological seeded history, storage blips and a dead worker
+  into one study and names the exact findings the doctor must report
+  (:data:`HEALTH_CHECK_CHAOS_MATRIX` is the matrix, synced by graphlint
+  rule OBS004); :func:`plant_dead_worker` leaves behind exactly the stale
+  health snapshot a SIGKILL'd worker would.
 
 Typical chaos test::
 
@@ -272,6 +279,125 @@ def device_stat_chaos_plan() -> DeviceStatChaosPlan:
     """The default :class:`DeviceStatChaosPlan` the chaos suite runs —
     two NaN slots in a four-wide batch, an 8x8 rank-one Gram."""
     return DeviceStatChaosPlan()
+
+
+# ------------------------------------------------------- study-doctor chaos
+
+
+# Chaos matrix for the study doctor's diagnostic checks: every check id the
+# doctor accepts (``health.py::HEALTH_CHECKS``) maps to the fault scenario
+# ``tests/test_health_chaos.py`` / ``tests/test_health.py`` must prove fires
+# it. Deliberately a hand-written literal (not an import of
+# ``health.HEALTH_CHECKS``): graphlint rule OBS004 cross-checks both against
+# ``_lint/registry.py::HEALTH_CHECK_REGISTRY`` — adding a diagnostic check
+# without deciding how to prove it fires is a lint failure (the
+# STO001/EXE001/SMP001/OBS002/OBS003 pattern), because an unproven doctor
+# check certifies sick studies healthy.
+HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
+    "study.stagnation": "seed a constant-value history + a never-improving objective past "
+    "the window; the doctor flags stagnation, the improving twin stays clean",
+    "sampler.fallback_storm": "inject NaN proposals at storm rate via FaultySampler under "
+    "GuardedSampler; the fallback counters cross the rate threshold",
+    "sampler.duplicate_proposals": "seed pairwise-duplicated retry-clone history; the exact-"
+    "duplicate rate crosses the threshold",
+    "executor.quarantine_rate": "inject NaN batch slots; quarantine counters cross the "
+    "budget-loss rate threshold",
+    "executor.dispatch_timeouts": "publish a worker snapshot carrying dispatch_timeout "
+    "strikes at the budget; the strike count alone flags",
+    "jit.retrace_churn": "publish jit totals with retraces_after_first past the churn "
+    "floor; the labels are named in the finding",
+    "gp.ladder_escalation": "publish device.gp.ladder_rung.max at the escalation rung; "
+    "the gauge alone flags",
+    "worker.dead": "plant a stale worker snapshot (plant_dead_worker — what a SIGKILL'd "
+    "worker leaves); liveness derives dead from snapshot age vs interval",
+}
+
+
+@dataclass(frozen=True)
+class HealthChaosPlan:
+    """One deterministic study-doctor chaos scenario: the combined faults to
+    inject (NaN batch slots, pathological seeded history, storage blips, a
+    dead worker's stale snapshot) and the exact finding ids the doctor must
+    report for them — ``tests/test_health_chaos.py`` asserts the report's
+    check-id set equals :attr:`expected_findings` exactly, and the
+    fault-free twin reports healthy (the executable form of
+    :data:`HEALTH_CHECK_CHAOS_MATRIX`'s combined row).
+
+    The numbers are chosen to clear the doctor's documented thresholds with
+    margin: ``n_trials`` completed tells on a never-improving objective over
+    a constant-value seeded history crosses the stagnation window;
+    ``sampler_nan_at`` yields a fallback rate past the storm threshold;
+    ``nan_slots`` quarantines past the budget-loss rate; the planted worker
+    is ``dead_worker_age_s`` stale — orders of magnitude past the liveness
+    grace.
+    """
+
+    n_trials: int = 24
+    batch_size: int = 8
+    seeded_history_plan: int = 1  # PATHOLOGICAL_HISTORY_PLANS index: constant_values
+    nan_slots: Mapping[int, Sequence[int]] = field(
+        default_factory=lambda: {0: (1, 2), 1: (0,), 2: (3,)}
+    )
+    sampler_nan_at: tuple[int, ...] = tuple(range(2, 12))
+    storage_blip_schedule: Mapping[str, Sequence[int]] = field(
+        default_factory=lambda: {
+            "get_all_trials": (0, 1),
+            "set_study_system_attr": (0,),
+        }
+    )
+    dead_worker_id: str = "chaos-host-dead"
+    dead_worker_age_s: float = 3600.0
+    expected_findings: tuple[str, ...] = (
+        "study.stagnation",
+        "sampler.fallback_storm",
+        "executor.quarantine_rate",
+        "worker.dead",
+    )
+
+    @property
+    def expected_quarantined(self) -> int:
+        return sum(len(slots) for slots in self.nan_slots.values())
+
+    def storage_fault_plan(self) -> FaultPlan:
+        """The storage blips (transient, pre-commit, retry-safe) riding
+        along: the reporter's attr writes and the aggregator's reads must
+        survive them under RetryingStorage without changing the findings."""
+        return FaultPlan(schedule=dict(self.storage_blip_schedule))
+
+
+def health_chaos_plan() -> HealthChaosPlan:
+    """The default :class:`HealthChaosPlan` the chaos suite runs — four NaN
+    slots across three batches, eight NaN sampler proposals, a constant
+    seeded history, three storage blips, one hour-stale worker."""
+    return HealthChaosPlan()
+
+
+def plant_dead_worker(
+    study: Any, worker_id: str = "chaos-host-dead", age_s: float = 3600.0
+) -> dict:
+    """Publish the stale health snapshot a SIGKILL'd worker would leave:
+    its last successful publish, ``age_s`` seconds old, never refreshed
+    (the health-reporter analog of :func:`plant_stale_lock`). Returns the
+    snapshot planted. The counters are empty by design — a dead worker's
+    finding must come from *staleness*, not from its counter payload
+    contaminating the fleet rates."""
+    from optuna_tpu.health import DEFAULT_INTERVAL_S, WORKER_ATTR_PREFIX
+
+    snapshot = {
+        "worker": worker_id,
+        "pid": 0,
+        "seq": 1,
+        "last_seen_unix": time.time() - age_s,
+        "interval_s": DEFAULT_INTERVAL_S,
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "jit": {},
+    }
+    study._storage.set_study_system_attr(
+        study._study_id, WORKER_ATTR_PREFIX + worker_id, snapshot
+    )
+    return snapshot
 
 
 # ----------------------------------------------------- device-dispatch chaos

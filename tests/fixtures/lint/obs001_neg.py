@@ -2,7 +2,7 @@
 import jax
 import jax.numpy as jnp
 
-from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu import device_stats, flight, health, telemetry
 from optuna_tpu.logging import get_logger, warn_once
 
 _logger = get_logger(__name__)
@@ -24,6 +24,7 @@ def host_dispatch(x):
     # Harvesting at the host boundary — after the dispatch — is sanctioned.
     device_stats.harvest(stats)
     flight.trial_event("tell", 0)
+    health.maybe_report(None)  # batch-boundary health publish: host-side
     _logger.warning("host-side logging is fine")
     warn_once(_logger, "key", "host-side warn_once is fine")
     return result

@@ -2,7 +2,7 @@
 import jax
 import jax.numpy as jnp
 
-from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu import device_stats, flight, health, telemetry
 from optuna_tpu.device_stats import harvest
 from optuna_tpu.logging import get_logger, warn_once
 
@@ -39,6 +39,15 @@ def host_wrapper(x):
         return carry - 1
 
     return jax.lax.while_loop(lambda c: c > 0, body, x)
+
+
+@jax.jit
+def bad_health_in_jit(x, study):
+    # A health publish is a storage write — inside a trace it would fire
+    # once per compile (recording garbage) and drag storage I/O into the
+    # program; report at trial/batch boundaries, never in-graph.
+    health.maybe_report(study)  # EXPECT: OBS001
+    return x + 1
 
 
 @jax.jit

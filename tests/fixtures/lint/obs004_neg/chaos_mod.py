@@ -1,0 +1,5 @@
+# graphlint fixture: OBS004 negative — both copies agree with the registry.
+HEALTH_CHECK_CHAOS_MATRIX = {
+    "study.stale": "seed a stagnant history; the check fires",
+    "worker.gone": "plant a stale snapshot; liveness reports dead",
+}

@@ -1,0 +1,5 @@
+# graphlint fixture: OBS004 negative — both copies agree with the registry.
+HEALTH_CHECKS = {
+    "study.stale": "what the check detects",
+    "worker.gone": "what the check detects",
+}

@@ -1,0 +1,2 @@
+# graphlint fixture: OBS004 — this copy DRIFTED: 'worker.gone' is missing.
+HEALTH_CHECK_CHAOS_MATRIX = {"study.stale": "scenario"}  # EXPECT: OBS004

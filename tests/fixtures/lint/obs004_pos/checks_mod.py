@@ -1,0 +1,6 @@
+# graphlint fixture: OBS004 — this copy DRIFTED: 'study.phantom_check' is extra.
+HEALTH_CHECKS = {  # EXPECT: OBS004
+    "study.stale": "scenario",
+    "worker.gone": "scenario",
+    "study.phantom_check": "scenario",
+}

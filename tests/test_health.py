@@ -1,0 +1,744 @@
+"""Study-doctor unit tests (ISSUE 10): the worker reporter's attr schema
+and rate limit, fleet aggregation semantics (counters sum, high-water
+gauges max, histograms merge by bucket), liveness, every diagnostic rule's
+fire/stay-silent behavior, the delivery surfaces (Study.health_report /
+``optuna-tpu doctor`` / ``/health.json`` serving one report), the
+``trajectory`` CLI, the concurrent-scrape stress over all four HTTP
+endpoints, and the disabled-path zero-allocation contract.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import health, telemetry
+from optuna_tpu.cli import main as cli_main
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.storages._in_memory import InMemoryStorage
+from optuna_tpu.study._study_direction import StudyDirection
+from optuna_tpu.trial._frozen import create_trial
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_health():
+    """Each test gets a fresh registry, jit-total slate and leaves health +
+    telemetry off (the jit totals are process-lifetime by design, and a
+    retrace from an earlier test must not trip this test's churn check)."""
+    from optuna_tpu import flight
+
+    saved_registry = telemetry.get_registry()
+    saved_telemetry = telemetry.enabled()
+    saved_health = health.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    flight.reset_jit_totals()
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_telemetry:
+        telemetry.disable()
+    if not saved_health:
+        health.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _trial(number: int, value: float | None = None, *,
+           state: TrialState = TrialState.COMPLETE,
+           params: dict | None = None):
+    t = create_trial(
+        state=state,
+        values=None if value is None else [value],
+        params=params if params is not None else {"x": (number % 97) / 100.0},
+        distributions={"x": SPACE["x"]} if (params is None or params) else {},
+    )
+    t.number = number
+    return t
+
+
+def _fleet(counters=None, gauges=None, jit=None, workers=None):
+    """A synthetic fleet snapshot for diagnose() unit tests."""
+    workers = workers if workers is not None else []
+    return {
+        "workers": workers,
+        "n_workers": len(workers),
+        "n_alive": sum(1 for w in workers if w.get("alive")),
+        "counters": counters or {},
+        "gauges": gauges or {},
+        "histograms": {},
+        "jit": jit or {},
+    }
+
+
+MIN = [StudyDirection.MINIMIZE]
+
+
+# --------------------------------------------------------------- reporter
+
+
+def test_reporter_publishes_bounded_namespaced_snapshot():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    reporter = health.HealthReporter(
+        study, worker_id="w1", interval_s=10.0, now=lambda: 1234.5
+    )
+    # Recorded after the reporter attached -> inside the delta window.
+    telemetry.count("executor.quarantine", 2)
+    telemetry.count("sampler.fallback.relative", 3)
+    telemetry.max_gauge("device.gp.ladder_rung.max", 4)
+    telemetry.set_gauge("batch_size", 8)  # ad-hoc gauge: stays process-local
+    telemetry.observe("phase.ask", 0.01)
+    telemetry.observe("scratch.histogram", 1.0)  # non-phase: stays local
+    snapshot = reporter.publish()
+
+    attrs = study.system_attrs
+    assert attrs[health.WORKER_ATTR_PREFIX + "w1"] == snapshot
+    assert snapshot["worker"] == "w1"
+    assert snapshot["last_seen_unix"] == 1234.5
+    assert snapshot["interval_s"] == 10.0
+    assert "final" not in snapshot  # a plain publish is not a clean exit
+    assert snapshot["counters"] == {
+        "executor.quarantine": 2,
+        "sampler.fallback.relative": 3,
+    }
+    # Gauges filtered to the device./jit./hbm. vocabularies (bounded).
+    assert snapshot["gauges"] == {"device.gp.ladder_rung.max": 4.0}
+    # Histograms filtered to the phase set.
+    assert set(snapshot["histograms"]) == {"phase.ask"}
+    json.dumps(snapshot)  # the attr must be JSON-able on every backend
+
+
+def test_reporter_snapshots_are_deltas_since_attach():
+    """A previous study's counters in the process-global registry must not
+    leak into this study's snapshot (they would poison its fleet rates):
+    the reporter baselines the registry when it attaches and publishes only
+    what moved since."""
+    telemetry.count("executor.quarantine", 24)  # a previous study's damage
+    telemetry.count("sampler.fallback.relative", 10)
+    telemetry.add_gauge("device.executor.quarantined.total", 24.0)
+    telemetry.max_gauge("device.gp.ladder_rung.max", 5.0)
+    telemetry.observe("phase.ask", 0.5)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    reporter = health.HealthReporter(study, worker_id="w1")
+    telemetry.count("executor.quarantine", 1)  # this study's own event
+    telemetry.add_gauge("device.executor.quarantined.total", 1.0)
+    snapshot = reporter.publish()
+    assert snapshot["counters"] == {"executor.quarantine": 1}
+    assert snapshot["gauges"] == {"device.executor.quarantined.total": 1.0}
+    # The untouched high-water gauge carries no new evidence: omitted.
+    assert "device.gp.ladder_rung.max" not in snapshot["gauges"]
+    assert snapshot["histograms"] == {}  # no phase work since attach
+
+
+def test_reporter_rate_limits_and_adapts_its_promise_on_injected_clock():
+    t = [0.0]
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    reporter = health.HealthReporter(
+        study, worker_id="w1", interval_s=10.0, clock=lambda: t[0]
+    )
+
+    def at(when: float) -> bool:
+        t[0] = when
+        return reporter.maybe_publish()
+
+    assert at(0.0) is True  # first call always publishes
+    assert at(1.0) is False  # inside the interval
+    assert at(9.9) is False  # still inside
+    assert at(10.0) is True  # interval elapsed
+    assert at(10.5) is False
+    assert at(25.0) is True
+    snap = study.system_attrs[health.WORKER_ATTR_PREFIX + "w1"]
+    assert snap["seq"] == 3  # one seq per actual publish
+    # Adaptive promise: the observed 15s gap (a slow trial) stretches the
+    # published interval so the liveness grace stretches with it — a 60s
+    # objective must not read as a dead worker.
+    assert snap["interval_s"] == 15.0
+    # ...and the promise is a ratchet (running max), not the latest gap:
+    # a fast trial after the slow one must not shrink the grace back and
+    # re-flag the next slow trial as dead.
+    assert at(35.5) is True  # a 10.5s gap — faster than the slow one
+    snap = study.system_attrs[health.WORKER_ATTR_PREFIX + "w1"]
+    assert snap["interval_s"] == 15.0  # still the slowest observed
+
+
+def test_exited_worker_is_not_reported_dead():
+    """flush() marks the terminal snapshot final: a cleanly-finished worker
+    reads 'exited' forever, never decaying into a CRITICAL worker.dead."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    reporter = health.HealthReporter(
+        study, worker_id="w1", interval_s=1.0, now=lambda: 1000.0
+    )
+    reporter.publish(final=True)
+    # A week later the snapshot is ancient — but it was a clean exit.
+    fleet = health.fleet_snapshot(study._storage, study._study_id,
+                                  now=1000.0 + 7 * 86400)
+    worker = fleet["workers"][0]
+    assert worker["exited"] is True and worker["alive"] is False
+    assert health.diagnose(fleet, [], MIN) == []
+
+
+def test_reporter_storage_blip_is_contained(caplog):
+    """A storage failure on the health attr write degrades to a warn_once,
+    never a study failure — diagnostics must not kill what they diagnose."""
+    import logging
+
+    class _BrokenAttrStorage(InMemoryStorage):
+        def set_study_system_attr(self, study_id, key, value):
+            if key.startswith(health.WORKER_ATTR_PREFIX):
+                raise RuntimeError("attr write down")
+            super().set_study_system_attr(study_id, key, value)
+
+    study = optuna_tpu.create_study(
+        storage=_BrokenAttrStorage(), sampler=RandomSampler(seed=0)
+    )
+    reporter = health.HealthReporter(study, worker_id="w1")
+    optuna_tpu.logging.enable_propagation()
+    try:
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu.health"):
+            assert reporter.publish() is None
+            assert reporter.publish() is None  # second failure: silent
+    finally:
+        optuna_tpu.logging.disable_propagation()
+    warnings = [r for r in caplog.records if "health snapshot" in r.message]
+    assert len(warnings) == 1
+
+
+# -------------------------------------------------------------- aggregator
+
+
+def test_fleet_merge_semantics():
+    """Counters sum; .max/.last gauges max; .total gauges sum; histograms
+    merge bucket-by-bucket; jit per-label totals sum."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    storage, study_id = study._storage, study._study_id
+    base_hist = {"count": 2, "sum": 0.5, "buckets": {"0.001": 1, "+Inf": 1}}
+    storage.set_study_system_attr(
+        study_id,
+        health.WORKER_ATTR_PREFIX + "a",
+        {
+            "worker": "a", "last_seen_unix": 1000.0, "interval_s": 15.0, "seq": 1,
+            "counters": {"executor.quarantine": 2, "storage.retry": 1},
+            "gauges": {
+                "device.gp.ladder_rung.max": 2.0,
+                "device.gp.best_acq.last": -1.0,
+                "device.executor.quarantined.total": 2.0,
+            },
+            "histograms": {"phase.ask": base_hist},
+            "jit": {"fused": {"compiles": 1, "compile_seconds": 0.5,
+                              "retraces_after_first": 0}},
+        },
+    )
+    storage.set_study_system_attr(
+        study_id,
+        health.WORKER_ATTR_PREFIX + "b",
+        {
+            "worker": "b", "last_seen_unix": 1010.0, "interval_s": 15.0, "seq": 4,
+            "counters": {"executor.quarantine": 3},
+            "gauges": {
+                "device.gp.ladder_rung.max": 5.0,
+                "device.gp.best_acq.last": -3.0,
+                "device.executor.quarantined.total": 1.0,
+            },
+            "histograms": {"phase.ask": {"count": 1, "sum": 0.25,
+                                         "buckets": {"0.001": 0, "+Inf": 1}}},
+            "jit": {"fused": {"compiles": 2, "compile_seconds": 1.0,
+                              "retraces_after_first": 1}},
+        },
+    )
+    fleet = health.fleet_snapshot(storage, study_id, now=1012.0)
+    assert fleet["n_workers"] == 2 and fleet["n_alive"] == 2
+    assert fleet["counters"] == {"executor.quarantine": 5, "storage.retry": 1}
+    assert fleet["gauges"]["device.gp.ladder_rung.max"] == 5.0  # max
+    assert fleet["gauges"]["device.gp.best_acq.last"] == -1.0  # max (point)
+    assert fleet["gauges"]["device.executor.quarantined.total"] == 3.0  # sum
+    merged = fleet["histograms"]["phase.ask"]
+    assert merged["count"] == 3
+    assert merged["sum"] == pytest.approx(0.75)
+    assert merged["buckets"] == {"0.001": 1, "+Inf": 2}
+    assert fleet["jit"]["fused"] == {
+        "compiles": 3, "compile_seconds": 1.5, "retraces_after_first": 1,
+    }
+
+
+def test_liveness_from_snapshot_age():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    storage, study_id = study._storage, study._study_id
+    for worker, last_seen in (("fresh", 990.0), ("stale", 900.0)):
+        storage.set_study_system_attr(
+            study_id,
+            health.WORKER_ATTR_PREFIX + worker,
+            {"worker": worker, "last_seen_unix": last_seen, "interval_s": 10.0,
+             "counters": {}, "gauges": {}, "histograms": {}, "jit": {}},
+        )
+    fleet = health.fleet_snapshot(storage, study_id, now=1000.0)
+    by_name = {w["worker"]: w for w in fleet["workers"]}
+    # grace = 2.5 x 10s: age 10 is alive, age 100 is dead.
+    assert by_name["fresh"]["alive"] is True
+    assert by_name["stale"]["alive"] is False
+    assert fleet["n_alive"] == 1
+
+
+def test_malformed_snapshot_attr_is_skipped():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.set_system_attr(health.WORKER_ATTR_PREFIX + "junk", "not-a-dict")
+    fleet = health.fleet_snapshot(study._storage, study._study_id)
+    assert fleet["n_workers"] == 0  # the doctor survives a corrupt attr
+
+
+# ------------------------------------------------------------- diagnostics
+
+
+def test_check_table_covers_exactly_the_vocabulary():
+    assert set(health._CHECK_FUNCS) == set(health.HEALTH_CHECKS)
+    # ...and so does the severity table the hot path's warn pass derives
+    # its CRITICAL-capable subset from.
+    assert set(health.CHECK_SEVERITIES) == set(health.HEALTH_CHECKS)
+    assert set(health.CHECK_SEVERITIES.values()) <= set(health.SEVERITIES)
+    assert set(health._CRITICAL_CAPABLE) == {
+        check
+        for check, severity in health.CHECK_SEVERITIES.items()
+        if severity == "CRITICAL"
+    }
+
+
+def test_finding_rejects_unknown_check_and_severity():
+    with pytest.raises(ValueError, match="unknown health check"):
+        health.HealthFinding(check="study.phantom", severity="WARNING", summary="x")
+    with pytest.raises(ValueError, match="unknown severity"):
+        health.HealthFinding(check="worker.dead", severity="LOUD", summary="x")
+
+
+def test_stagnation_fires_on_plateau_and_not_on_improvement():
+    window = health.STAGNATION_WINDOW
+    plateau = [_trial(i, 1.0 if i else 0.5) for i in range(window + 5)]
+    findings = health.diagnose(_fleet(), plateau, MIN)
+    assert [f.check for f in findings] == ["study.stagnation"]
+    assert findings[0].evidence["best_value"] == 0.5
+
+    improving = [_trial(i, 1.0 / (i + 1)) for i in range(window + 5)]
+    assert health.diagnose(_fleet(), improving, MIN) == []
+    # Below the window there is not enough evidence to call a plateau.
+    assert health.diagnose(_fleet(), plateau[: window - 1], MIN) == []
+    # Multi-objective: Pareto stagnation is out of scope, the check skips.
+    directions = [StudyDirection.MINIMIZE, StudyDirection.MINIMIZE]
+    assert health.diagnose(_fleet(), plateau, directions) == []
+
+
+def test_stagnation_respects_maximize_direction():
+    window = health.STAGNATION_WINDOW
+    # Values strictly increasing: stagnant for MINIMIZE, healthy for MAXIMIZE.
+    rising = [_trial(i, float(i)) for i in range(window + 5)]
+    assert [f.check for f in health.diagnose(_fleet(), rising, MIN)] == [
+        "study.stagnation"
+    ]
+    assert health.diagnose(_fleet(), rising, [StudyDirection.MAXIMIZE]) == []
+
+
+def test_fallback_storm_threshold():
+    trials = [_trial(i, 1.0) for i in range(12)]
+    quiet = _fleet(counters={"sampler.fallback.relative": 2})
+    assert health.diagnose(quiet, trials, MIN) == []
+    storm = _fleet(
+        counters={"sampler.fallback.relative": 4, "sampler.fallback.independent": 2}
+    )
+    findings = health.diagnose(storm, trials, MIN)
+    assert [f.check for f in findings] == ["sampler.fallback_storm"]
+    assert findings[0].severity == "CRITICAL"
+    assert findings[0].evidence["fallbacks"] == 6
+
+
+def test_duplicate_proposals_threshold():
+    point = {"x": 0.5}
+    dupes = [_trial(i, 1.0, params=dict(point)) for i in range(8)]
+    findings = health.diagnose(_fleet(), dupes, MIN)
+    assert [f.check for f in findings] == ["sampler.duplicate_proposals"]
+    assert findings[0].evidence["duplicates"] == 7
+    distinct = [_trial(i, 1.0) for i in range(8)]
+    assert health.diagnose(_fleet(), distinct, MIN) == []
+
+
+def test_quarantine_rate_counts_quarantines_and_reaps():
+    # Improving values so the stagnation check stays out of the picture.
+    trials = [_trial(i, 1.0 / (i + 1)) for i in range(20)]
+    fleet = _fleet(counters={"executor.quarantine": 2, "heartbeat.reap": 2})
+    findings = health.diagnose(fleet, trials, MIN)
+    assert [f.check for f in findings] == ["executor.quarantine_rate"]
+    assert findings[0].evidence == {
+        "quarantines": 2, "reaps": 2, "finished_trials": 20, "rate": 0.2,
+    }
+    below = _fleet(counters={"executor.quarantine": 1})
+    assert health.diagnose(below, trials, MIN) == []
+
+
+def test_dispatch_timeout_strikes():
+    assert health.diagnose(
+        _fleet(counters={"executor.dispatch_timeout": 1}), [], MIN
+    ) == []
+    findings = health.diagnose(
+        _fleet(counters={"executor.dispatch_timeout": 2}), [], MIN
+    )
+    assert [f.check for f in findings] == ["executor.dispatch_timeouts"]
+
+
+def test_retrace_churn_from_jit_totals():
+    quiet = _fleet(jit={"fused": {"compiles": 3, "retraces_after_first": 2}})
+    assert health.diagnose(quiet, [], MIN) == []
+    churn = _fleet(
+        jit={
+            "fused": {"compiles": 3, "retraces_after_first": 2},
+            "vectorized.guarded": {"compiles": 2, "retraces_after_first": 1},
+        }
+    )
+    findings = health.diagnose(churn, [], MIN)
+    assert [f.check for f in findings] == ["jit.retrace_churn"]
+    assert findings[0].evidence["labels"] == ["fused", "vectorized.guarded"]
+
+
+def test_ladder_escalation_gauge():
+    low = _fleet(gauges={"device.gp.ladder_rung.max": 2.0})
+    assert health.diagnose(low, [], MIN) == []
+    findings = health.diagnose(
+        _fleet(gauges={"device.gp.ladder_rung.max": 3.0}), [], MIN
+    )
+    assert [f.check for f in findings] == ["gp.ladder_escalation"]
+
+
+def test_dead_worker_finding_and_severity_ordering():
+    workers = [
+        {"worker": "a", "alive": True, "age_s": 1.0},
+        {"worker": "b", "alive": False, "age_s": 500.0},
+    ]
+    fleet = _fleet(
+        counters={"executor.dispatch_timeout": 5}, workers=workers
+    )
+    findings = health.diagnose(fleet, [], MIN)
+    # CRITICAL first, WARNING after — the doctor leads with what kills you.
+    assert [f.check for f in findings] == [
+        "worker.dead", "executor.dispatch_timeouts",
+    ]
+    assert findings[0].severity == "CRITICAL"
+    assert findings[0].evidence["dead_workers"] == ["b"]
+
+
+# ---------------------------------------------------------------- surfaces
+
+
+def test_study_health_report_shape_and_trial_counts():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=3)
+    report = study.health_report()
+    assert report["study"] == study.study_name
+    assert report["n_trials"] == 3 and report["n_complete"] == 3
+    assert report["checks_evaluated"] == sorted(health.HEALTH_CHECKS)
+    assert report["healthy"] is True and report["findings"] == []
+    assert report["workers"] == []  # reporter was never enabled
+    json.dumps(report)
+
+
+def test_doctor_cli_and_health_endpoint_serve_the_same_report(capsys):
+    """The acceptance surface contract: ``optuna-tpu doctor --endpoint`` and
+    a locally-computed ``health_report`` agree on everything but the
+    generation timestamp (and the ages derived from it)."""
+    study = optuna_tpu.create_study(
+        study_name="doc", sampler=RandomSampler(seed=0)
+    )
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=3)
+    from optuna_tpu.testing.fault_injection import plant_dead_worker
+
+    plant_dead_worker(study, worker_id="gone", age_s=900.0)
+    storage = study._storage
+    server = telemetry.serve_metrics(
+        0, health_source=lambda: health.storage_health_reports(storage)
+    )
+    try:
+        port = server.server_address[1]
+        assert cli_main(
+            ["doctor", "--study-name", "doc", "--format", "json",
+             "--endpoint", f"http://localhost:{port}"]
+        ) == 0
+        served = json.loads(capsys.readouterr().out)
+        local = health.health_report(storage, study._study_id, study_name="doc")
+
+        def _stable(report):
+            report = dict(report)
+            report.pop("generated_unix")
+            report["workers"] = [
+                {k: v for k, v in w.items() if k != "age_s"}
+                for w in report["workers"]
+            ]
+            report["findings"] = [
+                {k: v for k, v in f.items() if k != "evidence"}
+                for f in report["findings"]
+            ]
+            return report
+
+        assert _stable(served) == _stable(local)
+        assert [f["check"] for f in served["findings"]] == ["worker.dead"]
+
+        # The text rendering serves humans; same findings, same verdict.
+        assert cli_main(
+            ["doctor", "--study-name", "doc",
+             "--endpoint", f"http://localhost:{port}"]
+        ) == 0
+        text = capsys.readouterr().out
+        assert "worker.dead" in text and "CRITICAL" in text
+
+        # Unknown study: a loud usage error, not an empty report.
+        assert cli_main(
+            ["doctor", "--study-name", "nope",
+             "--endpoint", f"http://localhost:{port}"]
+        ) == 2
+    finally:
+        server.shutdown()
+
+
+def test_doctor_cli_local_storage(tmp_path, capsys):
+    url = f"sqlite:///{tmp_path}/doc.db"
+    study = optuna_tpu.create_study(
+        study_name="local", storage=url, sampler=RandomSampler(seed=0)
+    )
+    study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=2)
+    assert cli_main(
+        ["--storage", url, "doctor", "--study-name", "local", "-f", "json"]
+    ) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["study"] == "local" and report["healthy"] is True
+
+
+def test_health_endpoint_404_without_a_source():
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://localhost:{port}/health.json", timeout=10
+            )
+        assert err.value.code == 404
+    finally:
+        server.shutdown()
+
+
+def test_warn_once_fires_on_critical_finding(caplog):
+    """The optimize-loop contract: a CRITICAL finding surfaces in the
+    worker's own log exactly once per (study, check) while the reporter
+    publishes."""
+    import logging
+
+    from optuna_tpu.testing.fault_injection import plant_dead_worker
+
+    health.enable(interval_s=0.0)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    plant_dead_worker(study, worker_id="gone", age_s=900.0)
+    optuna_tpu.logging.enable_propagation()
+    try:
+        with caplog.at_level(logging.WARNING, logger="optuna_tpu.health"):
+            study.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=4)
+    finally:
+        optuna_tpu.logging.disable_propagation()
+    critical = [r for r in caplog.records if "worker.dead" in r.message]
+    assert len(critical) == 1  # once, not once per trial
+    assert "CRITICAL" in critical[0].message
+
+
+# ----------------------------------------------------------- trajectory CLI
+
+
+def _trajectory_file(tmp_path):
+    payload = {
+        "gate": {"max_regression_frac": 0.10},
+        "entries": [
+            {
+                "round": "r03", "captured": "2026-07-01T00:00:00",
+                "metric": "gp_e2e", "mode": "full", "platform": "tpu",
+                "value": 10.911, "git": {"sha": "abcdef0123456", "dirty": False},
+            },
+            {
+                "round": "r04", "captured": "2026-07-10T00:00:00",
+                "metric": "gp_e2e", "mode": "full", "platform": "tpu",
+                "value": 8.298, "regressed": True,
+                "steady_state_trials_per_sec": 9.1,
+                "device_stats": {"max_ladder_rung": 2, "fit_iterations": 120,
+                                 "quarantined": 1},
+                "git": {"sha": "123456789abcd", "dirty": True},
+            },
+            {
+                "round": "r05", "captured": "2026-07-20T00:00:00",
+                "metric": "tpe", "mode": "quick", "platform": "cpu",
+                "value": None, "partial": True,
+            },
+        ],
+    }
+    path = tmp_path / "BENCH_TRAJECTORY.json"
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_trajectory_cli_table_and_json(tmp_path, capsys):
+    path = _trajectory_file(tmp_path)
+    assert cli_main(["trajectory", "--path", path]) == 0
+    table = capsys.readouterr().out
+    assert "r03" in table and "10.911" in table
+    assert "REGRESSED" in table  # the r04 flag is loud
+    assert "rung=2 fit=120 quar=1" in table  # device_stats condensed
+    assert "123456789*" in table  # short sha + dirty marker
+    assert "partial" in table
+
+    assert cli_main(["trajectory", "--path", path, "-f", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert [e["round"] for e in payload["entries"]] == ["r03", "r04", "r05"]
+    assert payload["entries"][1]["device_stats"]["fit_iterations"] == 120
+
+    # --metric filters to one bench metric (the claw-back hunt's slice).
+    assert cli_main(
+        ["trajectory", "--path", path, "-f", "json", "--metric", "gp_e2e"]
+    ) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert [e["round"] for e in payload["entries"]] == ["r03", "r04"]
+
+
+def test_trajectory_cli_env_and_missing_path(tmp_path, capsys, monkeypatch):
+    path = _trajectory_file(tmp_path)
+    monkeypatch.setenv("OPTUNA_TPU_BENCH_TRAJECTORY_PATH", path)
+    assert cli_main(["trajectory", "-f", "json"]) == 0
+    assert json.loads(capsys.readouterr().out)["path"] == path
+
+    monkeypatch.setenv(
+        "OPTUNA_TPU_BENCH_TRAJECTORY_PATH", str(tmp_path / "absent.json")
+    )
+    monkeypatch.chdir(tmp_path)  # no BENCH_TRAJECTORY.json above tmp either
+    assert cli_main(["trajectory"]) == 2
+    assert "no BENCH_TRAJECTORY.json" in capsys.readouterr().err
+
+
+def test_trajectory_cli_renders_the_committed_ledger(capsys):
+    """The real committed file renders without error and carries the seeded
+    rounds — the surface the r03->r04 claw-back hunt actually reads."""
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo_root, "BENCH_TRAJECTORY.json")
+    assert cli_main(["trajectory", "--path", path, "-f", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    rounds = [e["round"] for e in payload["entries"]]
+    assert "r03" in rounds and "r04" in rounds
+
+
+# ------------------------------------------------------ concurrent scrapes
+
+
+def test_concurrent_scrapes_while_a_faulted_study_runs():
+    """Hammer /metrics, /metrics.json, /trace.json and /health.json from
+    threads while a faulted vectorized study runs: every response parses,
+    no torn renders, no handler exceptions, the registry lock holds."""
+    from optuna_tpu import flight
+    from optuna_tpu.parallel import optimize_vectorized
+    from optuna_tpu.samplers._resilience import GuardedSampler
+    from optuna_tpu.testing.fault_injection import (
+        FaultySampler,
+        FaultyVectorizedObjective,
+    )
+
+    saved_flight = flight.enabled()
+    flight.enable(flight.FlightRecorder())
+    health.enable(interval_s=0.0)
+    study = optuna_tpu.create_study(
+        sampler=GuardedSampler(
+            FaultySampler(RandomSampler(seed=0), nan_at={1, 3}, force_relative=True)
+        )
+    )
+    storage = study._storage
+    server = telemetry.serve_metrics(
+        0, health_source=lambda: health.storage_health_reports(storage)
+    )
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def scrape(path: str, parse_json: bool) -> None:
+        port = server.server_address[1]
+        try:
+            while not stop.is_set():
+                body = urllib.request.urlopen(
+                    f"http://localhost:{port}{path}", timeout=10
+                ).read().decode()
+                if parse_json:
+                    json.loads(body)
+                else:
+                    assert "# TYPE" in body or body == "\n"
+        except BaseException as err:  # pragma: no cover - asserted below
+            errors.append(err)
+
+    threads = [
+        threading.Thread(target=scrape, args=(path, parse_json), daemon=True)
+        for path, parse_json in (
+            ("/metrics", False),
+            ("/metrics.json", True),
+            ("/trace.json", True),
+            ("/health.json", True),
+        )
+        for _ in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        obj = FaultyVectorizedObjective(
+            lambda p: (p["x"] - 0.3) ** 2, SPACE, nan_at={0: (1,), 2: (0,)}
+        )
+        optimize_vectorized(study, obj, n_trials=16, batch_size=4)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        server.shutdown()
+        if not saved_flight:
+            flight.disable()
+    assert errors == []
+    # The faulted study's signals all made it through the scrape window's
+    # surfaces: the final snapshot carries them.
+    snap = telemetry.snapshot()
+    assert snap["counters"]["executor.quarantine"] == 2
+    assert snap["counters"]["sampler.fallback.relative"] == 2
+
+
+def test_study_with_attached_reporter_still_pickles():
+    """The reporter is per-process by identity (pid-embedding worker id, a
+    lock inside): pickling a study drops it; the unpickled copy mints a
+    fresh one on its first report."""
+    import pickle
+
+    health.enable(interval_s=0.0)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study.optimize(lambda t: t.suggest_float("x", 0, 1), n_trials=2)
+    assert "_health_reporter" in study.__dict__
+    clone = pickle.loads(pickle.dumps(study))
+    assert "_health_reporter" not in clone.__dict__
+
+
+# ------------------------------------------------------- disabled-path cost
+
+
+def test_disabled_maybe_report_allocates_no_per_trial_objects():
+    """The overhead contract: with the reporter off, the per-trial
+    maybe_report hook must not grow the heap — one module-global check,
+    no reporter construction, no snapshot building."""
+    health.disable()
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+
+    for _ in range(200):  # warm free lists / caches
+        health.maybe_report(study)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        health.maybe_report(study)
+        health.flush(study)
+    gc.collect()
+    after = sys.getallocatedblocks()
+    assert after - before < 500
+    assert "_health_reporter" not in study.__dict__  # nothing was built

@@ -1,0 +1,295 @@
+"""Study-doctor chaos acceptance (ISSUE 10): one multi-worker faulted study
+— NaN batch slots + pathological seeded history + storage blips + a dead
+worker — must yield a doctor report whose findings match the injected
+fault plan EXACTLY (stagnation / fallback storm / quarantine rate /
+liveness), the fault-free twin must report healthy with zero findings, and
+a disabled-reporter study must allocate nothing per trial.
+
+Per-check scenarios below the centerpiece give every entry of
+``HEALTH_CHECK_CHAOS_MATRIX`` its own fault (the chaos-matrix discipline
+graphlint rule OBS004 enforces on the vocabulary).
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import health, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import optimize_vectorized
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.samplers._resilience import GuardedSampler
+from optuna_tpu.storages import RetryPolicy
+from optuna_tpu.storages._in_memory import InMemoryStorage
+from optuna_tpu.storages._retry import RetryingStorage
+from optuna_tpu.testing.fault_injection import (
+    HEALTH_CHECK_CHAOS_MATRIX,
+    PATHOLOGICAL_HISTORY_PLANS,
+    FaultInjectorStorage,
+    FaultySampler,
+    FaultyVectorizedObjective,
+    HealthChaosPlan,
+    health_chaos_plan,
+    plant_dead_worker,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_health():
+    from optuna_tpu import flight
+
+    saved_registry = telemetry.get_registry()
+    saved_telemetry = telemetry.enabled()
+    saved_health = health.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    # jit totals are process-lifetime by design; an earlier test's retrace
+    # must not trip this suite's churn check.
+    flight.reset_jit_totals()
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_telemetry:
+        telemetry.disable()
+    if not saved_health:
+        health.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _never_improving(params):
+    # >= 1.0 always: the seeded constant-0.0 history stays the best forever.
+    return (params["x"] - 0.3) ** 2 + 1.0
+
+
+def _fast_retry() -> RetryPolicy:
+    return RetryPolicy(max_attempts=10, sleep=lambda _: None)
+
+
+def _build_study(plan: HealthChaosPlan, *, faulted: bool):
+    """The chaos study and its fault-free twin share every layer — retry
+    wrapper, guard wrapper, reporter, executor — and differ only in the
+    injected faults (the pathological seeded history is itself one of the
+    faults, so the twin runs without it)."""
+    injector = FaultInjectorStorage(
+        InMemoryStorage(),
+        plan.storage_fault_plan() if faulted else None,
+    )
+    storage = RetryingStorage(injector, _fast_retry(), retry_non_idempotent=True)
+    sampler = GuardedSampler(
+        FaultySampler(
+            RandomSampler(seed=0),
+            nan_at=set(plan.sampler_nan_at) if faulted else (),
+            force_relative=True,
+        )
+    )
+    study = optuna_tpu.create_study(storage=storage, sampler=sampler)
+    if faulted:
+        PATHOLOGICAL_HISTORY_PLANS[plan.seeded_history_plan].populate(
+            study, SPACE, seed=0
+        )
+    return study, injector
+
+
+def test_chaos_study_findings_match_the_plan_exactly():
+    """The centerpiece: NaN slots + pathological history + storage blips +
+    a dead worker in ONE study -> the doctor reports exactly the planned
+    findings, nothing more, nothing less — and every surface agrees."""
+    plan = health_chaos_plan()
+    health.enable(interval_s=0.0)  # publish at every batch boundary
+    study, injector = _build_study(plan, faulted=True)
+    plant_dead_worker(
+        study, worker_id=plan.dead_worker_id, age_s=plan.dead_worker_age_s
+    )
+    obj = FaultyVectorizedObjective(
+        _never_improving, SPACE, nan_at=dict(plan.nan_slots)
+    )
+    optimize_vectorized(
+        study, obj, n_trials=plan.n_trials, batch_size=plan.batch_size
+    )
+
+    # The storage blips really fired and were retried through to the report.
+    assert injector.faults_injected == sum(
+        len(v) for v in plan.storage_blip_schedule.values()
+    )
+    report = study.health_report()
+    assert not report["healthy"]
+    assert {f["check"] for f in report["findings"]} == set(plan.expected_findings)
+
+    by_check = {f["check"]: f for f in report["findings"]}
+    # Liveness: the planted worker is dead, the live reporter is alive.
+    assert by_check["worker.dead"]["severity"] == "CRITICAL"
+    assert by_check["worker.dead"]["evidence"]["dead_workers"] == [
+        plan.dead_worker_id
+    ]
+    workers = {w["worker"]: w for w in report["workers"]}
+    assert len(workers) == 2
+    # The surviving worker flushed a final snapshot when its run ended: it
+    # reads as a clean exit, not as alive — and never as dead.
+    live = next(w for name, w in workers.items() if name != plan.dead_worker_id)
+    assert live["exited"] is True
+    assert workers[plan.dead_worker_id]["exited"] is False
+
+    # Quarantine evidence equals the planned slot count exactly, through
+    # the reporter -> storage -> aggregator round trip.
+    assert by_check["executor.quarantine_rate"]["evidence"]["quarantines"] == (
+        plan.expected_quarantined
+    )
+    # Fallback storm: every scheduled NaN proposal degraded and was counted.
+    assert by_check["sampler.fallback_storm"]["evidence"]["fallbacks"] == len(
+        plan.sampler_nan_at
+    )
+    assert by_check["sampler.fallback_storm"]["severity"] == "CRITICAL"
+    # Stagnation: the seeded constant history stayed the best.
+    assert by_check["study.stagnation"]["evidence"]["best_value"] == 0.0
+
+    # The trial ledger survived the whole plan: quarantined slots FAILed,
+    # nothing stranded RUNNING.
+    states = [t.state for t in study.trials]
+    assert states.count(TrialState.RUNNING) == 0
+    assert states.count(TrialState.FAIL) == plan.expected_quarantined
+
+
+def test_fault_free_twin_reports_healthy():
+    """Identical layering, zero faults: zero findings, healthy verdict, one
+    live worker."""
+    plan = health_chaos_plan()
+    health.enable(interval_s=0.0)
+    study, injector = _build_study(plan, faulted=False)
+    optimize_vectorized(
+        study,
+        FaultyVectorizedObjective(_never_improving, SPACE),
+        n_trials=12,  # below the stagnation window: a short healthy run
+        batch_size=plan.batch_size,
+    )
+    assert injector.faults_injected == 0
+    report = study.health_report()
+    assert report["healthy"] is True
+    assert report["findings"] == []
+    assert len(report["workers"]) == 1
+    # The twin's run finished: its terminal flush marks a clean exit, which
+    # the doctor must never age into a worker.dead finding.
+    assert report["workers"][0]["exited"] is True
+    # The fleet view still carries the twin's phase work — healthy is
+    # "no findings", not "no data".
+    assert report["fleet"]["histograms"]["phase.ask"]["count"] >= 1
+
+
+def test_disabled_reporter_chaos_publishes_and_allocates_nothing():
+    """Faults with the reporter disabled: containment still works, no
+    worker attr is ever written, and the per-batch maybe_report hook stays
+    allocation-free — recording is opt-in, never load-bearing."""
+    health.disable()
+    plan = health_chaos_plan()
+    study, _ = _build_study(plan, faulted=True)
+    obj = FaultyVectorizedObjective(
+        _never_improving, SPACE, nan_at=dict(plan.nan_slots)
+    )
+    optimize_vectorized(
+        study, obj, n_trials=plan.n_trials, batch_size=plan.batch_size
+    )
+    assert not health.worker_snapshots(study._storage, study._study_id)
+    assert "_health_reporter" not in study.__dict__
+
+    # The hook itself: 10k disabled calls, bounded heap (the telemetry
+    # spine's zero-per-trial-allocation contract, extended to the doctor).
+    for _ in range(200):
+        health.maybe_report(study)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        health.maybe_report(study)
+    gc.collect()
+    assert sys.getallocatedblocks() - before < 500
+
+
+# ---------------------------------------------------- per-check scenarios
+#
+# The centerpiece covers stagnation / fallback storm / quarantine rate /
+# liveness end to end; the remaining matrix rows are exercised through the
+# published-snapshot channel (their signals are gauges/counters a real
+# worker would publish — the doctor's job is reading them, not minting
+# them).
+
+
+def _publish_snapshot(study, worker, **fields):
+    snapshot = {
+        "worker": worker,
+        "last_seen_unix": 1_000_000.0,
+        "interval_s": 15.0,
+        "counters": {},
+        "gauges": {},
+        "histograms": {},
+        "jit": {},
+    }
+    snapshot.update(fields)
+    study._storage.set_study_system_attr(
+        study._study_id, health.WORKER_ATTR_PREFIX + worker, snapshot
+    )
+
+
+def test_dispatch_timeout_strikes_flag_through_the_fleet_channel():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _publish_snapshot(
+        study, "w1",
+        counters={"executor.dispatch_timeout": health.DISPATCH_TIMEOUT_STRIKES},
+    )
+    report = health.health_report(
+        study._storage, study._study_id, now=1_000_000.0
+    )
+    assert [f["check"] for f in report["findings"]] == [
+        "executor.dispatch_timeouts"
+    ]
+
+
+def test_retrace_churn_flags_through_the_fleet_channel():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _publish_snapshot(
+        study, "w1",
+        jit={"vectorized.guarded": {
+            "compiles": 5, "compile_seconds": 2.0,
+            "retraces_after_first": health.RETRACE_CHURN_MIN,
+        }},
+    )
+    report = health.health_report(
+        study._storage, study._study_id, now=1_000_000.0
+    )
+    assert [f["check"] for f in report["findings"]] == ["jit.retrace_churn"]
+    assert report["findings"][0]["evidence"]["labels"] == ["vectorized.guarded"]
+
+
+def test_ladder_escalation_flags_through_the_fleet_channel():
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _publish_snapshot(
+        study, "w1",
+        gauges={"device.gp.ladder_rung.max": float(health.LADDER_RUNG_WARN)},
+    )
+    report = health.health_report(
+        study._storage, study._study_id, now=1_000_000.0
+    )
+    assert [f["check"] for f in report["findings"]] == ["gp.ladder_escalation"]
+
+
+def test_duplicate_proposals_flag_on_retry_clone_history():
+    """The retry-clones pathological plan is exactly the duplicate storm
+    the check hunts: pairwise-identical rows with lineage attrs."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    clones = PATHOLOGICAL_HISTORY_PLANS[4]
+    assert clones.name == "retry_clones"
+    clones.populate(study, SPACE, seed=0)
+    report = study.health_report()
+    assert [f["check"] for f in report["findings"]] == [
+        "sampler.duplicate_proposals"
+    ]
+    assert report["findings"][0]["evidence"]["duplicates"] == clones.n_trials // 2
+
+
+def test_chaos_matrix_names_every_check():
+    """Belt and braces beside OBS004's static check: the runtime matrix
+    covers the runtime vocabulary exactly, and this module plus
+    tests/test_health.py exercise every row."""
+    assert set(HEALTH_CHECK_CHAOS_MATRIX) == set(health.HEALTH_CHECKS)

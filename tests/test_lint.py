@@ -213,6 +213,69 @@ def test_obs003_gate_rejects_drift():
     assert all("gp.phantom_stat" in f.message for f in drifted)
 
 
+def test_obs004_registry_matches_runtime_sets():
+    """The canonical health-check registry equals the *runtime* values of
+    both hand-written copies (the lint compares them statically) — and the
+    doctor's rule table covers exactly the vocabulary."""
+    from optuna_tpu import health
+    from optuna_tpu.testing.fault_injection import HEALTH_CHECK_CHAOS_MATRIX
+
+    canonical = set(lint_registry.HEALTH_CHECK_REGISTRY)
+    assert set(health.HEALTH_CHECKS) == canonical
+    assert set(HEALTH_CHECK_CHAOS_MATRIX) == canonical
+    assert set(health._CHECK_FUNCS) == canonical
+
+
+def test_obs004_gate_rejects_drift():
+    """Point OBS004 at the real files with a registry containing a check the
+    code does not know: both copies must be reported as drifted — adding a
+    diagnostic check without a fault scenario proving it fires is a lint
+    failure (the STO001/EXE001/SMP001/OBS002/OBS003 discipline)."""
+    fat_registry = dict(lint_registry.HEALTH_CHECK_REGISTRY)
+    fat_registry["study.phantom_check"] = "made-up check to prove the gate is live"
+    config = Config(obs004_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.obs004_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "OBS004"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("study.phantom_check" in f.message for f in drifted)
+
+
+_OBS004_FIXTURE_REGISTRY = {
+    "study.stale": "no improvement over the window",
+    "worker.gone": "snapshot stale past its interval",
+}
+
+
+def _obs004_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        obs004_registry=_OBS004_FIXTURE_REGISTRY,
+        obs004_targets=(
+            (f"fixtures/lint/{tree}/checks_mod.py", "HEALTH_CHECKS", "doctor vocabulary"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "HEALTH_CHECK_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_obs004_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "obs004_pos")
+    result = run_lint([tree], _obs004_config("obs004_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "study.phantom_check" in by_file["checks_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_obs004_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "obs004_neg")
+    result = run_lint([tree], _obs004_config("obs004_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 _OBS003_FIXTURE_REGISTRY = {
     "gp.rung": "jitter escalations the factor needed",
     "exec.quarantined": "non-finite slots in one dispatch",

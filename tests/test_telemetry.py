@@ -293,6 +293,103 @@ def test_prometheus_rendering_shapes():
     assert counts == sorted(counts)
 
 
+_PROM_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>\S+)$"
+)
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_exposition(text: str) -> list[tuple[str, dict[str, str], float]]:
+    """A tiny exposition-format parser for round-trip assertions: every
+    sample line must match the grammar exactly (an unsanitized name or an
+    unescaped label value fails here, which is the point)."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        assert m is not None, f"line violates the exposition grammar: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            consumed = _PROM_LABEL.sub("", m.group("labels")).strip(", ")
+            assert not consumed, f"malformed labels in: {line!r}"
+            for name, raw in _PROM_LABEL.findall(m.group("labels")):
+                labels[name] = (
+                    raw.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+                )
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return samples
+
+
+def test_prometheus_dynamic_suffixes_become_escaped_labels():
+    """Exposition hardening (ISSUE 10 satellite): dotted fixed-vocabulary
+    names sanitize into the metric name; dynamic suffixes (sampler fallback
+    families, jit labels) become labels whose values round-trip through the
+    exposition escaping — including quotes, backslashes and newlines."""
+    nasty = 'relative:w"eird\\fam\nily'
+    telemetry.count("sampler.fallback." + nasty, 3)
+    telemetry.count("sampler.fallback.independent", 2)
+    telemetry.count("sampler.fallback")  # bare family: unlabeled series
+    telemetry.set_gauge("jit.compiles.vectorized.guarded", 4)
+    telemetry.set_gauge("jit.compile_seconds.vectorized.guarded", 1.25)
+    telemetry.set_gauge("device.gp.ladder_rung.max", 5)
+    telemetry.set_gauge("gauge.with.ünïcode", 1)  # must sanitize, not corrupt
+
+    samples = _parse_exposition(telemetry.render_prometheus())
+    by_key = {(name, tuple(sorted(labels.items()))): value
+              for name, labels, value in samples}
+    # The dynamic suffix became a label and unescaped back to the original.
+    assert by_key[
+        ("optuna_tpu_sampler_fallback_total", (("family", nasty),))
+    ] == 3
+    assert by_key[
+        ("optuna_tpu_sampler_fallback_total", (("family", "independent"),))
+    ] == 2
+    assert by_key[("optuna_tpu_sampler_fallback_total", ())] == 1
+    assert by_key[
+        ("optuna_tpu_jit_compiles", (("label", "vectorized.guarded"),))
+    ] == 4
+    assert by_key[
+        ("optuna_tpu_jit_compile_seconds", (("label", "vectorized.guarded"),))
+    ] == 1.25
+    # Fixed-vocabulary dotted names flatten into the metric name.
+    assert by_key[("optuna_tpu_device_gp_ladder_rung_max", ())] == 5
+    assert by_key[("optuna_tpu_gauge_with__n_code", ())] == 1
+
+
+def test_prometheus_round_trips_every_snapshot_value():
+    """Everything the snapshot holds survives the render -> parse round
+    trip with its exact value — no torn, duplicated or dropped series."""
+    telemetry.count("storage.retry", 7)
+    telemetry.count("sampler.fallback.relative", 3)
+    telemetry.set_gauge("hbm.peak_bytes", 123456.0)
+    registry = telemetry.get_registry()
+    registry.observe("phase.tell", 0.002)
+    registry.observe("phase.tell", 2.0)
+
+    samples = _parse_exposition(telemetry.render_prometheus())
+    names = [name for name, _, _ in samples]
+    assert len(names) == len(set((n, tuple(sorted(l.items())))
+                                 for n, l, _ in samples)), "duplicate series"
+    by_key = {(name, tuple(sorted(labels.items()))): value
+              for name, labels, value in samples}
+    assert by_key[("optuna_tpu_storage_retry_total", ())] == 7
+    assert by_key[
+        ("optuna_tpu_sampler_fallback_total", (("family", "relative"),))
+    ] == 3
+    assert by_key[("optuna_tpu_hbm_peak_bytes", ())] == 123456.0
+    assert by_key[("optuna_tpu_phase_tell_seconds_count", ())] == 2
+    assert by_key[("optuna_tpu_phase_tell_seconds_sum", ())] == pytest.approx(2.002)
+    buckets = [
+        (labels["le"], value)
+        for name, labels, value in samples
+        if name == "optuna_tpu_phase_tell_seconds_bucket"
+    ]
+    assert buckets[-1] == ("+Inf", 2)  # cumulative tail carries the count
+
+
 def test_serve_metrics_http_endpoint():
     telemetry.count("storage.retry", 7)
     server = telemetry.serve_metrics(0)  # port 0: bind any free port
