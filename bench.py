@@ -125,11 +125,22 @@ def _device_stats_breakdown() -> dict:
     from optuna_tpu import device_stats, telemetry
 
     gauges = device_stats.stat_gauges(telemetry.snapshot())
-    return {
+    block = {
         "max_ladder_rung": int(gauges.get("device.gp.ladder_rung.max", 0)),
         "fit_iterations": int(gauges.get("device.gp.fit_iterations.total", 0)),
         "quarantined": int(gauges.get("device.executor.quarantined.total", 0)),
     }
+    # Scan-loop counters (ISSUE 11), present only when the window ran the
+    # HBM-resident loop: which tell path ran (incremental vs full
+    # refactorization) and the in-graph quarantine/fill figures.
+    if "device.scan.rank1_updates.total" in gauges:
+        block["scan_rank1_updates"] = int(gauges["device.scan.rank1_updates.total"])
+        block["scan_refactorizations"] = int(
+            gauges.get("device.scan.refactorizations.total", 0)
+        )
+        block["scan_quarantined"] = int(gauges.get("device.scan.quarantined.total", 0))
+        block["scan_chunk_fill"] = int(gauges.get("device.scan.chunk_fill.last", 0))
+    return block
 
 
 def _phase_breakdown() -> dict:
@@ -228,6 +239,49 @@ def run_ours_gp_end_to_end(n_total: int, chain: int = 8) -> tuple[float, float]:
     t0 = time.time()
     study.optimize(hartmann20, n_trials=n_total)
     return time.time() - t0, study.best_value
+
+
+def run_ours_gp_scan(n_total: int, sync_every: int = 32) -> tuple[float, float]:
+    """The HBM-resident loop (parallel/scan_loop.py): the whole n-trial GP
+    study end-to-end with the ask/evaluate/tell cycle under lax.scan —
+    compiles included, amortized across runs by the persistent XLA cache
+    (the same philosophy as the gp headline)."""
+    import optuna_tpu
+    from optuna_tpu.distributions import FloatDistribution
+    from optuna_tpu.models.benchmarks import hartmann20_jax
+    from optuna_tpu.parallel import VectorizedObjective, optimize_scan
+
+    _silence()
+    space = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(20)}
+    obj = VectorizedObjective(fn=hartmann20_jax, search_space=space)
+    study = optuna_tpu.create_study()
+    _reset_phase_telemetry()
+    t0 = time.time()
+    optimize_scan(
+        study, obj, n_trials=n_total, sync_every=sync_every,
+        n_startup_trials=16, seed=0,
+    )
+    dt = time.time() - t0
+    return n_total / dt, study.best_value
+
+
+def run_ours_gp_per_trial(n_total: int) -> tuple[float, float]:
+    """The per-trial ask/tell path on the scan bench's exact GP config
+    (20D Hartmann, serial fused GPSampler, no ask-ahead chain) — the
+    denominator of the scan mode's vs_baseline ratio, run live on the same
+    box, end-to-end with compiles like the numerator."""
+    import optuna_tpu
+    from optuna_tpu.models.benchmarks import hartmann20
+    from optuna_tpu.samplers import GPSampler
+
+    _silence()
+    study = optuna_tpu.create_study(
+        sampler=GPSampler(seed=0, n_startup_trials=16)
+    )
+    t0 = time.time()
+    study.optimize(hartmann20, n_trials=n_total)
+    dt = time.time() - t0
+    return n_total / dt, study.best_value
 
 
 def run_ours_tpe(n_warmup: int, n_timed: int, objective=None) -> tuple[float, float]:
@@ -865,8 +919,16 @@ def main() -> None:
         ],
     )
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--loop",
+        default="ask_tell",
+        choices=["ask_tell", "scan"],
+        help="study-loop mode: the per-trial ask/tell path (default) or the "
+        "HBM-resident lax.scan loop (gp config only; its own trajectory "
+        "metric, so the scan loop gets a distinct gate baseline)",
+    )
     args = parser.parse_args()
-    watchdog.phase(f"run:{args.config}")
+    watchdog.phase(f"run:{args.config}:{args.loop}")
     watchdog.update(quick=bool(args.quick))
     provenance = "live"  # how vs_baseline's denominator was obtained
     extra: dict = {}
@@ -876,7 +938,30 @@ def main() -> None:
     # steady-state trials/s figure.
     n_timed = None
 
-    if args.config == "gp":
+    if args.loop == "scan":
+        if args.config != "gp":
+            parser.error("--loop=scan is only defined for --config gp")
+        # Acceptance geometry (ISSUE 11): scan-mode steady-state trials/s
+        # vs the per-trial ask/tell path on the SAME GP config at n=512
+        # (n=128 in quick mode), both end-to-end on this box.
+        n_total = 128 if args.quick else 512
+        _log(f"running ours (scan loop / 20D Hartmann, n={n_total} end-to-end, sync_every=32)...")
+        ours_rate, ours_best = run_ours_gp_scan(n_total)
+        n_timed = n_total
+        # Capture the scan window's breakdown NOW: the per-trial twin below
+        # is instrumented too (it is ours-side code), and letting the
+        # generic capture at the bottom run after it would fold the twin's
+        # phases/compiles into the scan entry.
+        extra["phases"] = _phase_breakdown()
+        extra["device_stats"] = _device_stats_breakdown()
+        extra["compile"] = _compile_breakdown()
+        _log(f"ours(scan): {ours_rate:.3f} trials/s (best {ours_best:.4f}); running per-trial twin...")
+        watchdog.update(value=round(ours_rate, 3))
+        watchdog.phase("baseline:gp_per_trial")
+        base = run_ours_gp_per_trial(n_total)
+        provenance = "live-ours-per-trial-path"
+        metric = "gp_scan_trials_per_sec_hartmann20d_end_to_end"
+    elif args.config == "gp":
         # Headline = BASELINE.json's own form: the WHOLE n=1000 study
         # end-to-end. A per-window ratio misleads both ways (shallow windows
         # under-count the reference's O(n^3) growth, mid-depth windows land
@@ -999,21 +1084,26 @@ def main() -> None:
         metric = "nsga2_trials_per_sec_zdt1"
 
     # Per-phase breakdown from the telemetry spans recorded over the timed
-    # window (ask / ask.fit / ask.propose / dispatch / tell / storage.op):
-    # the instrument that localizes a trials/s regression to the phase that
-    # paid for it (ROADMAP item 5 — the r03->r04 drop had no such signal).
-    extra["phases"] = _phase_breakdown()
+    # window (ask / ask.fit / ask.propose / dispatch / tell / storage.op /
+    # scan.chunk / scan.sync): the instrument that localizes a trials/s
+    # regression to the phase that paid for it (ROADMAP item 5 — the
+    # r03->r04 drop had no such signal). Configs whose baseline twin is
+    # itself instrumented ours-side code (--loop=scan) capture these at the
+    # end of their own timed window instead — skip, don't clobber.
+    if "phases" not in extra:
+        extra["phases"] = _phase_breakdown()
     # Device-stat block (ISSUE 9): what the dispatches did *inside* the
     # graph over the timed window — the on-device half the r03->r04
     # claw-back needs beside the host-side phase breakdown.
-    extra["device_stats"] = _device_stats_breakdown()
+    if "device_stats" not in extra:
+        extra["device_stats"] = _device_stats_breakdown()
     # Compile-cost split (ISSUE 8): the in-window jit compile gauges divide
     # the measured window into first-batch (compile-inclusive) and
     # steady-state throughput. `value` stays the end-to-end figure — it is
     # the committed-trajectory comparable — and `steady_state_trials_per_sec`
     # rides beside it so a compile-time regression and a loop-time
     # regression stop being indistinguishable.
-    compile_info = _compile_breakdown()
+    compile_info = extra.get("compile") or _compile_breakdown()
     extra["compile"] = compile_info
     if n_timed and ours_rate > 0 and compile_info["seconds"] > 0:
         window_wall = n_timed / ours_rate

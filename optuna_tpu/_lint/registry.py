@@ -133,6 +133,8 @@ TELEMETRY_PHASE_REGISTRY: dict[str, str] = {
     "dispatch": "objective execution (serial call or batched device dispatch)",
     "tell": "result commit + callbacks (study.tell / batch tell loop)",
     "storage.op": "one logical storage operation (retries + backoff included)",
+    "scan.chunk": "one HBM-resident scan-chunk dispatch (host side; the device run overlaps the previous chunk's sync)",
+    "scan.sync": "chunk-boundary result wait + storage sync of a scan chunk's trials",
 }
 
 #: The containment-counter families: canonical mirror of
@@ -201,6 +203,10 @@ DEVICE_STAT_REGISTRY: dict[str, str] = {
     "gp.proposal_fallback_coords": "proposal coordinates that took the per-coordinate isfinite fallback",
     "gp.best_acq": "best acquisition value the fused proposal search found",
     "executor.quarantined": "trials quarantined as FAIL in one batch dispatch, from the in-graph isfinite mask (0 under non_finite='clip': nothing is quarantined)",
+    "scan.rank1_updates": "scan-loop tells that took the O(n^2) incremental Cholesky row append",
+    "scan.refactorizations": "scan-loop tells whose pivot check fell back to a full jitter-ladder refactorization",
+    "scan.quarantined": "non-finite objective slots quarantined in-graph inside a scan chunk (told FAIL at sync, never ingested)",
+    "scan.chunk_fill": "real (ingested) trials the last scan chunk added to the HBM history",
 }
 
 #: The hand-maintained copies OBS003 cross-checks, as
@@ -272,6 +278,7 @@ DEVICE_MODULE_PATHS: tuple[str, ...] = (
     "optuna_tpu/samplers/_tpe/_kernels.py",
     "optuna_tpu/samplers/_resilience.py",
     "optuna_tpu/parallel/executor.py",
+    "optuna_tpu/parallel/scan_loop.py",
 )
 
 #: Reviewed host-boundary functions allowed to touch float64 inside device

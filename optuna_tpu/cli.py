@@ -416,6 +416,12 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
             parts.append(f"fit={stats['fit_iterations']}")
         if stats.get("quarantined") is not None:
             parts.append(f"quar={stats['quarantined']}")
+        # Scan-loop entries (bench --loop=scan) additionally condense which
+        # tell path ran: incremental row appends vs full refactorizations.
+        if stats.get("scan_rank1_updates") is not None:
+            parts.append(
+                f"r1={stats['scan_rank1_updates']}/rf={stats.get('scan_refactorizations', 0)}"
+            )
         return " ".join(parts)
 
     def _flags(entry: dict[str, Any]) -> str:

@@ -90,6 +90,10 @@ DEVICE_STATS: dict[str, str] = {
     "gp.proposal_fallback_coords": "proposal coordinates that took the per-coordinate isfinite fallback",
     "gp.best_acq": "best acquisition value the fused proposal search found",
     "executor.quarantined": "trials quarantined as FAIL in one batch dispatch, from the in-graph isfinite mask (0 under non_finite='clip': nothing is quarantined)",
+    "scan.rank1_updates": "scan-loop tells that took the O(n^2) incremental Cholesky row append",
+    "scan.refactorizations": "scan-loop tells whose pivot check fell back to a full jitter-ladder refactorization",
+    "scan.quarantined": "non-finite objective slots quarantined in-graph inside a scan chunk (told FAIL at sync, never ingested)",
+    "scan.chunk_fill": "real (ingested) trials the last scan chunk added to the HBM history",
 }
 
 #: How each stat aggregates across harvests within one recording window:
@@ -102,6 +106,10 @@ STAT_AGGREGATIONS: dict[str, str] = {
     "gp.proposal_fallback_coords": "total",
     "gp.best_acq": "last",
     "executor.quarantined": "total",
+    "scan.rank1_updates": "total",
+    "scan.refactorizations": "total",
+    "scan.quarantined": "total",
+    "scan.chunk_fill": "last",
 }
 
 _GAUGE_PREFIX = "device."

@@ -89,6 +89,14 @@ def hartmann20(trial) -> float:
     return float(-np.sum(_H6_ALPHA * np.exp(-inner)))
 
 
+def hartmann20_jax(params):
+    """Batched jittable Hartmann-20 (the VectorizedObjective convention:
+    ``{name: (B,)}`` -> ``(B,)``) — the scan-loop bench's in-graph twin of
+    :func:`hartmann20`. The 20D embedding's extra dims are inert, so this
+    is exactly the Hartmann6 kernel reading ``x0``..``x5``."""
+    return hartmann6_jax(params)
+
+
 # ------------------------------------------------------------- Rastrigin (nD)
 
 

@@ -13,7 +13,12 @@ This package adds the TPU-native tier on top:
   is the unit of failure, not just of dispatch;
 * :mod:`ici_journal` — a journal backend whose sync primitive is an XLA
   allgather over the mesh (ICI) instead of a POSIX file, so intra-slice
-  trial synchronization never leaves the interconnect.
+  trial synchronization never leaves the interconnect;
+* :mod:`scan_loop` — the HBM-resident study loop: trial history in
+  preallocated power-of-two device buckets, the whole ask -> evaluate ->
+  tell cycle as one ``lax.scan`` program per chunk with O(n^2) incremental
+  Cholesky tells, storage synced in chunks that overlap the next chunk's
+  device execution.
 """
 
 from optuna_tpu.parallel.executor import (
@@ -23,6 +28,7 @@ from optuna_tpu.parallel.executor import (
     ResilientBatchExecutor,
 )
 from optuna_tpu.parallel.ici_journal import IciJournalBackend
+from optuna_tpu.parallel.scan_loop import optimize_scan
 from optuna_tpu.parallel.vectorized import VectorizedObjective, optimize_vectorized
 
 __all__ = [
@@ -32,5 +38,6 @@ __all__ = [
     "NonFiniteObjectiveError",
     "ResilientBatchExecutor",
     "VectorizedObjective",
+    "optimize_scan",
     "optimize_vectorized",
 ]

@@ -301,7 +301,15 @@ class ResilientBatchExecutor:
         """One ask -> heartbeat(suggest + dispatch + tell) cycle; returns the
         batch width advanced."""
         study = self._study
-        if is_heartbeat_enabled(study._storage):
+        # One liveness check per batch: the fault-free fast path. When the
+        # storage has no heartbeat there is nothing to reap and nothing to
+        # beat, so the per-batch HeartbeatThread (and even its context
+        # manager) is never constructed — the clean path runs suggest +
+        # dispatch + tell directly, with zero extra dispatches and the same
+        # telemetry phase count as a bare dispatch (regression-tested in
+        # tests/test_executor_fastpath.py; ROADMAP item 5's refactor unlock).
+        heartbeat = is_heartbeat_enabled(study._storage)
+        if heartbeat:
             # Batch boundary reap: a dead peer's stranded batch is
             # failed + re-enqueued before we ask, so ask_batch below
             # claims the WAITING clones first.
@@ -319,20 +327,17 @@ class ResilientBatchExecutor:
             trials, proposals = self._ask_batch(b)
         ask_seconds = self._clock() - ask_t0
         try:
-            # Parameter suggestion runs *inside* the heartbeat
-            # (whose __enter__ records a synchronous first beat, so
-            # a worker killed mid-suggest still strands a reapable
-            # batch).
-            with get_batch_heartbeat_thread(
-                [t._trial_id for t in trials], study._storage
-            ):
-                ask_t0 = self._clock()
-                with _tracing.annotate(_TRACE_ASK), flight.span("ask"):
-                    self._prepare_batch(trials, proposals)
-                telemetry.observe_phase(
-                    "ask", ask_seconds + (self._clock() - ask_t0)
-                )
-                self._run_batch(trials)
+            if heartbeat:
+                # Parameter suggestion runs *inside* the heartbeat
+                # (whose __enter__ records a synchronous first beat, so
+                # a worker killed mid-suggest still strands a reapable
+                # batch).
+                with get_batch_heartbeat_thread(
+                    [t._trial_id for t in trials], study._storage
+                ):
+                    self._suggest_and_run(trials, proposals, ask_seconds)
+            else:
+                self._suggest_and_run(trials, proposals, ask_seconds)
         except Exception as err:  # graphlint: ignore[PY001] -- last-line containment sweep: whatever escaped between ask and tell must not leave trials RUNNING; the original error re-raises below. BaseException (worker death) punches through for heartbeat failover
             # Terminal batch failure: everything survivable was already
             # contained below this point, so an error landing here is about
@@ -371,6 +376,17 @@ class ResilientBatchExecutor:
         # check while the reporter is disabled).
         health.maybe_report(study)
         return len(trials)
+
+    def _suggest_and_run(
+        self, trials: list[Trial], proposals: list | None, ask_seconds: float
+    ) -> None:
+        """The per-batch suggest + dispatch + tell body, shared verbatim by
+        the heartbeat-covered and fault-free fast paths."""
+        ask_t0 = self._clock()
+        with _tracing.annotate(_TRACE_ASK), flight.span("ask"):
+            self._prepare_batch(trials, proposals)
+        telemetry.observe_phase("ask", ask_seconds + (self._clock() - ask_t0))
+        self._run_batch(trials)
 
     # ----------------------------------------------------------------- phases
 

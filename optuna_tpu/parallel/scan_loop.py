@@ -1,0 +1,709 @@
+"""HBM-resident study loop: ask -> evaluate -> tell entirely on device.
+
+The per-trial GP path pays one host round trip per suggestion — packing,
+dispatch, realize, storage tell — and a full O(n^3) Gram refactorization
+per fit. At the 10k-trial SNIPPETS target that host loop, not the device,
+is the bottleneck. This module restructures the hot loop so the study
+itself lives in HBM:
+
+* **Preallocated buckets** — trial history (X, y-scores, a finite mask and
+  the running best) lives in device buffers padded to power-of-two bucket
+  sizes; one compiled program serves a whole bucket, so the total compile
+  count over a study is bounded by ``log2(n_trials)`` (plus one cold-fit
+  variant and the startup evaluator).
+* **One program per chunk** — the ask -> evaluate -> tell cycle runs as a
+  single jitted program: a MAP kernel-param fit (multi-start L-BFGS, warm-
+  started from the previous chunk) and one chunk-boundary ladder-Cholesky
+  factorization up front, then ``sync_every`` iterations of a ``lax.scan``
+  whose body proposes by LogEI over an on-device Sobol pool, evaluates the
+  user's jittable objective in-graph, and tells by **incremental
+  factor update**: :func:`~optuna_tpu.samplers._resilience.
+  ladder_cholesky_rank1_update` appends the new observation's Cholesky row
+  in O(n^2) (one triangular solve) instead of refactorizing the O(n^3)
+  Gram, falling back in-graph — via the pivot's finiteness/positivity
+  verdict — to a full escalating-jitter refactorization when the history
+  turns rank-deficient (exact duplicates under a deterministic noise
+  floor). Which path ran rides out through the device-stats channel.
+* **Chunked, overlapped storage sync** — COMPLETE/FAIL trials reach
+  storage in ``sync_every``-sized chunks, and the sync of chunk *k*
+  overlaps the device execution of chunk *k+1* (jax dispatch is
+  asynchronous; the realize that blocks on chunk *k* happens after chunk
+  *k+1* is queued). Each synced trial is logically identical to the
+  per-trial path's: params set under its distributions, COMPLETE with the
+  value or FAIL with a ``fail_reason`` attr, callbacks fired, exactly
+  once.
+* **In-graph quarantine** — a non-finite objective value inside the scan
+  is never ingested: the carry's finite verdict skips the buffer write and
+  the factor update entirely (the history cursor does not advance), and
+  the slot is told FAIL at the next chunk sync.
+* **Observability without host syncs** — the scan carry threads a
+  fixed-shape device-stats struct (ladder rung, rank-1 update vs
+  refactorization counts, quarantined slots, chunk fill — the PR-9
+  convention) out as auxiliary outputs harvested once per chunk at the
+  host boundary, zero extra dispatches; the chunk dispatch and sync are
+  spanned as the ``scan.chunk`` / ``scan.sync`` telemetry phases.
+
+Scope (v1): single-objective studies, explicit search spaces of
+Float/Int/Categorical distributions, jittable objectives (the
+:class:`~optuna_tpu.parallel.vectorized.VectorizedObjective` contract with
+batch width 1 inside the scan). The study's sampler is bypassed — the GP
+proposal IS the loop. ``Study.stop()`` from a callback is honored at chunk
+boundaries; in-flight device work past the stop is discarded *before* its
+trials are created, so stopping never strands a RUNNING trial. The
+in-graph decode mirrors the host ``unnormalize_one`` — step snapping
+included — but runs in f32, so log-dim decodes can differ from the
+recorded f64 params in the last ulps (the same precision caveat as the
+fused per-trial path's device-side math).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Callable, Sequence
+
+import numpy as np
+
+from optuna_tpu import _tracing, device_stats, flight, health, telemetry
+from optuna_tpu.distributions import (
+    BaseDistribution,
+    CategoricalDistribution,
+    FloatDistribution,
+    IntDistribution,
+)
+from optuna_tpu.exceptions import UpdateFinishedTrialError
+from optuna_tpu.logging import get_logger
+from optuna_tpu.trial._state import TrialState
+from optuna_tpu.trial._trial import Trial
+
+if TYPE_CHECKING:
+    from optuna_tpu.parallel.vectorized import VectorizedObjective
+    from optuna_tpu.study.study import Study
+
+_logger = get_logger(__name__)
+
+# Phase names resolved once (the study-loop vocabulary, telemetry.PHASES).
+_TRACE_CHUNK = telemetry.trace_name("scan.chunk")
+_TRACE_SYNC = telemetry.trace_name("scan.sync")
+_TRACE_DISPATCH = telemetry.trace_name("dispatch")
+
+#: Kernel-param fit budgets: (n_starts, lbfgs_iters). The first chunk runs
+#: the cold multi-start; every later chunk refines 2 starts (default + the
+#: previous chunk's optimum) — the sampler's warm-fit discipline.
+_SCAN_COLD_FIT = (4, 48)
+_SCAN_WARM_FIT = (2, 16)
+_STABILIZING_NOISE = 1e-10
+
+#: Score-buffer clip bound. The per-trial path clips ±inf to the f32 max
+#: because it standardizes in f64 on the host; the scan loop standardizes
+#: IN-GRAPH in f32, where squaring an f32-max score overflows the variance
+#: to inf and zeroes (or NaNs) every standardized target — blinding the GP
+#: for the study's lifetime. 1e15 keeps n·(2·clip)² comfortably inside
+#: f32 range for any realistic history length while preserving the
+#: ordering a huge/±inf objective is meant to convey (storage still
+#: receives the unclipped value; only the GP's score buffer is bounded).
+_SCAN_SCORE_CLIP = 1e15
+
+
+def _make_decode(space) -> Callable[[Any], dict[str, Any]]:
+    """Device-side normalized -> internal-repr decode mirroring
+    ``SearchSpace.unnormalize_one`` (host) and ``_pack_params``
+    (vectorized.py): categorical dims become int32 choice indices, numeric
+    dims map through the (possibly log) bounds with step snapping. Built
+    once per program from static per-dim metadata so the traced body is
+    pure arithmetic."""
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp.search_space import ScaleType
+
+    specs = []
+    for i, name in enumerate(space.param_names):
+        dist = space._search_space[name]
+        scale = int(space.scale_types[i])
+        lo, hi = float(space.bounds[i][0]), float(space.bounds[i][1])
+        step = None
+        if isinstance(dist, IntDistribution):
+            step = float(dist.step)
+        elif isinstance(dist, FloatDistribution) and dist.step is not None:
+            step = float(dist.step)
+        low = None if isinstance(dist, CategoricalDistribution) else float(dist.low)
+        high = None if isinstance(dist, CategoricalDistribution) else float(dist.high)
+        specs.append((name, scale, lo, hi, step, low, high))
+
+    def decode(x):
+        cols: dict[str, Any] = {}
+        for i, (name, scale, lo, hi, step, low, high) in enumerate(specs):
+            col = x[:, i]
+            if scale == ScaleType.CATEGORICAL:
+                cols[name] = jnp.round(col).astype(jnp.int32)
+                continue
+            raw = lo + jnp.clip(col, 0.0, 1.0) * (hi - lo)
+            if scale == ScaleType.LOG:
+                raw = jnp.exp(raw)
+            if step is not None:
+                raw = low + step * jnp.round((raw - low) / step)
+            if low is not None and step is not None:
+                raw = jnp.clip(raw, low, high)
+            cols[name] = raw.astype(jnp.float32)
+        return cols
+
+    return decode
+
+
+def _single_objective_values(vals, batch: int):
+    """Normalize the objective's output to shape (batch,) — the scan loop
+    is single-objective by contract; a (B, 1) column is accepted."""
+    import jax.numpy as jnp
+
+    return jnp.reshape(vals, (batch,))
+
+
+def _device_space(objective: "VectorizedObjective", space, n_preliminary: int):
+    """The per-space device constants (Sobol pool, bounds, sweep tables),
+    cached on the objective beside its compiled programs so lifetime
+    follows the user object."""
+    key = ("scan_devspace", n_preliminary)
+    dev = objective._compiled_cache.get(key)
+    if dev is None:
+        from optuna_tpu.samplers._gp.sampler import _DeviceSpace
+
+        dev = _DeviceSpace(space, n_preliminary)
+        objective._compiled_cache[key] = dev
+    return dev
+
+
+def _startup_program(objective: "VectorizedObjective", space, batch: int):
+    """One-dispatch evaluator for the random-startup block: decode + the
+    user objective + the in-graph finite verdict over ``batch`` Sobol
+    points."""
+    key = ("scan_startup", batch)
+    cached = objective._compiled_cache.get(key)
+    if cached is not None:
+        return cached
+    import jax
+    import jax.numpy as jnp
+
+    decode = _make_decode(space)
+    fn = objective.fn
+
+    def eval_batch(x):
+        vals = _single_objective_values(fn(decode(x)), batch)
+        return vals, jnp.isfinite(vals)
+
+    compiled = jax.jit(eval_batch)  # graphlint: ignore[TPU002] -- memoized in the objective's compile cache: one wrapper per startup width for the objective's lifetime
+    compiled = flight.instrument_jit(compiled, "scan.startup")
+    objective._compiled_cache[key] = compiled
+    return compiled
+
+
+def _chunk_program(
+    objective: "VectorizedObjective",
+    space,
+    dev,
+    *,
+    chunk_len: int,
+    bucket: int,
+    n_starts: int,
+    fit_iters: int,
+    minimum_noise: float,
+    maximize: bool,
+    n_local_search: int,
+    lbfgs_iters: int,
+):
+    """Build (once per cache key) the fused chunk program: fit + chunk
+    factorization + ``chunk_len`` scanned ask/evaluate/tell steps. Memoized
+    on the objective's compile cache — same TPU002 discipline as
+    ``VectorizedObjective._memoized_jit``."""
+    cache_key = (
+        "scan_chunk", chunk_len, bucket, n_starts, fit_iters,
+        minimum_noise, maximize, n_local_search, lbfgs_iters,
+        # The program closes over the device space: a different candidate
+        # pool size must not silently reuse a program built for another.
+        int(dev.sobol_base.shape[0]),
+    )
+    cached = objective._compiled_cache.get(cache_key)
+    if cached is not None:
+        return cached
+
+    import jax
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp.acqf import LogEIData
+    from optuna_tpu.gp.fused import _fit_params, _maximize_logei, device_candidates
+    from optuna_tpu.gp.gp import _JITTER, GPState, _kernel_with_noise, matern52
+    from optuna_tpu.samplers._resilience import (
+        ladder_cholesky_rank1_update,
+        ladder_cholesky_with_rung,
+    )
+
+    decode = _make_decode(space)
+    fn = objective.fn
+    f32 = jnp.float32
+    noise_c = jnp.asarray(_STABILIZING_NOISE, f32)
+
+    def chunk_fn(starts, X, y, mask, n_real, key):
+        # y holds raw *scores* (direction-applied, clipped); standardize
+        # once per chunk with the chunk-start moments — the kernel fit
+        # below conditions on exactly this standardization, and the next
+        # chunk boundary re-centers, so within-chunk drift never compounds.
+        n_f = jnp.maximum(jnp.sum(mask), 1.0)
+        mu = jnp.sum(y * mask) / n_f
+        sd = jnp.sqrt(jnp.maximum(jnp.sum(mask * (y - mu) ** 2) / n_f, 0.0))
+        sd = jnp.where(sd > 1e-12, sd, 1.0)
+        y_std = jnp.where(mask > 0, (y - mu) / sd, 0.0)
+
+        raw, params, fit_n_iter = _fit_params(
+            starts, X, y_std, dev.cat_mask, mask, minimum_noise, fit_iters
+        )
+        # One full factorization per chunk (the kernel params just moved);
+        # every in-scan tell below is an incremental row append.
+        K = _kernel_with_noise(X, params, dev.cat_mask, mask)
+        L0, rung0 = ladder_cholesky_with_rung(K)
+        alpha0 = jax.scipy.linalg.cho_solve((L0, True), y_std)
+        any_real = jnp.sum(mask) > 0
+        best0 = jnp.where(
+            any_real,
+            jnp.max(jnp.where(mask > 0, y_std, -jnp.inf)),
+            jnp.asarray(0.0, f32),
+        )
+        idx = jnp.arange(bucket)
+
+        def step(carry, i):
+            X, y, y_std, mask, L, alpha, best, n, r1, rf, rung_max, quar = carry
+            state = GPState(params=params, X=X, y=y_std, mask=mask, L=L, alpha=alpha)
+            data = LogEIData(
+                state=state, cat_mask=dev.cat_mask, best=best,
+                stabilizing_noise=noise_c,
+            )
+            k_i = jax.random.fold_in(key, i)
+            k_cand, k_start = jax.random.split(k_i)
+            cand = device_candidates(
+                dev.sobol_base, k_cand, dev.cat_mask, dev.n_choices, dev.steps
+            )
+            # Recent incumbents join the pool (the fused path's warm-start
+            # block), gathered from the live buffer at the cursor.
+            inc_idx = jnp.clip(n - 1 - jnp.arange(4), 0, bucket - 1)
+            cand = jnp.concatenate([jnp.take(X, inc_idx, axis=0), cand], axis=0)
+            x_i, _v, _nf = _maximize_logei(
+                data, cand, k_start, dev.cont_mask, dev.lower, dev.upper,
+                dev.dim_onehot, dev.choice_grid, dev.choice_valid,
+                n_local_search=n_local_search, n_cycles=1,
+                lbfgs_iters=lbfgs_iters, has_sweep=dev.has_sweep,
+            )
+            val = _single_objective_values(fn(decode(x_i[None])), 1)[0]
+            finite = jnp.isfinite(val)
+            score = val if maximize else -val
+            score = jnp.clip(
+                jnp.where(finite, score, 0.0), -_SCAN_SCORE_CLIP, _SCAN_SCORE_CLIP
+            )
+            score_std = (score - mu) / sd
+
+            def _ingest():
+                X_new = X.at[n].set(x_i)
+                mask_new = mask.at[n].set(1.0)
+                y_new = y.at[n].set(score)
+                y_std_new = y_std.at[n].set(score_std)
+                # Row `n` of the extended kernel: cross-covariances against
+                # the buffer (slot n's old content is overwritten by the
+                # diagonal below) plus the noise-carrying self-covariance.
+                k_vec = matern52(x_i[None], X, params, dev.cat_mask)[0]
+                k_row = jnp.where(
+                    idx == n, params.scale + params.noise + _JITTER, k_vec
+                )
+                L_new, rung_i, refac = ladder_cholesky_rank1_update(
+                    L, k_row, n,
+                    lambda: _kernel_with_noise(
+                        X_new, params, dev.cat_mask, mask_new
+                    ),
+                )
+                alpha_new = jax.scipy.linalg.cho_solve((L_new, True), y_std_new)
+                one = jnp.asarray(1, jnp.int32)
+                return (
+                    X_new, y_new, y_std_new, mask_new, L_new, alpha_new,
+                    jnp.maximum(best, score_std), n + 1,
+                    r1 + (one - refac), rf + refac,
+                    jnp.maximum(rung_max, rung_i), quar,
+                )
+
+            def _quarantine():
+                # Never ingested: the buffers, factor and cursor are
+                # untouched — the slot only exists in the chunk outputs,
+                # where the sync tells it FAIL.
+                return (
+                    X, y, y_std, mask, L, alpha, best, n,
+                    r1, rf, rung_max, quar + jnp.asarray(1, jnp.int32),
+                )
+
+            carry = jax.lax.cond(finite, _ingest, _quarantine)
+            return carry, (x_i, val, finite)
+
+        zero = jnp.asarray(0, jnp.int32)
+        init = (X, y, y_std, mask, L0, alpha0, best0, n_real, zero, zero, zero, zero)
+        final, outs = jax.lax.scan(step, init, jnp.arange(chunk_len))
+        X_f, y_f, _ystd, mask_f, _L, _a, _b, n_f, r1, rf, rung_max, quar = final
+        xs, vals, finites = outs
+        # Fixed-shape device-stats struct (optuna_tpu.device_stats): scalar
+        # counters riding the dispatch that was running anyway — the rung
+        # channel records which tell path ran (update vs refactor).
+        stats = {
+            "gp.ladder_rung": jnp.maximum(rung0, rung_max),
+            "gp.fit_iterations": fit_n_iter,
+            "scan.rank1_updates": r1,
+            "scan.refactorizations": rf,
+            "scan.quarantined": quar,
+            "scan.chunk_fill": n_f - n_real,
+        }
+        return xs, vals, finites, X_f, y_f, mask_f, n_f, raw, stats
+
+    compiled = jax.jit(chunk_fn)  # graphlint: ignore[TPU002] -- memoized in the objective's compile cache: one wrapper per (bucket, chunk, fit-variant) for the objective's lifetime
+    compiled = flight.instrument_jit(compiled, "scan.chunk")
+    objective._compiled_cache[cache_key] = compiled
+    return compiled
+
+
+def _publish_chunk(stats) -> None:
+    """Chunk-boundary observability publish: one harvest per chunk. The
+    disabled hot path is a module-global check and allocates nothing per
+    trial (the stats struct already exists — it rode the dispatch); the
+    per-trial quarantine *counter* fires at the tell site in
+    :func:`_sync_results`, which also covers the startup block."""
+    if not telemetry.enabled() and not flight.enabled():
+        return
+    device_stats.harvest(stats)
+
+
+def _clip_scores(scores: np.ndarray) -> np.ndarray:
+    """Bound host-produced scores (history resume, startup block) to the
+    same in-f32-standardizable range as the in-graph tell path — ±inf and
+    1e308 objectives are storage-legal but must not overflow the chunk
+    program's f32 variance."""
+    return np.clip(scores, -_SCAN_SCORE_CLIP, _SCAN_SCORE_CLIP).astype(np.float32)
+
+
+def _validate_space(space_dict: dict[str, BaseDistribution]) -> None:
+    if not space_dict:
+        raise ValueError("optimize_scan needs a non-empty explicit search space.")
+    for name, dist in space_dict.items():
+        if not isinstance(
+            dist, (FloatDistribution, IntDistribution, CategoricalDistribution)
+        ):
+            raise ValueError(
+                f"optimize_scan supports Float/Int/Categorical distributions; "
+                f"param {name!r} has {type(dist).__name__}."
+            )
+
+
+def optimize_scan(
+    study: "Study",
+    objective: "VectorizedObjective",
+    n_trials: int,
+    *,
+    sync_every: int = 32,
+    n_startup_trials: int = 16,
+    seed: int | None = None,
+    deterministic_objective: bool = False,
+    callbacks: Sequence[Callable] | None = None,
+    n_preliminary_samples: int = 512,
+    n_local_search: int = 4,
+    lbfgs_iters: int = 16,
+) -> None:
+    """Run ``n_trials`` GP-BO trials with the ask/evaluate/tell cycle
+    resident in HBM (see the module docstring for the architecture).
+
+    ``sync_every`` sets both the scan-chunk length (trials advanced per
+    device program) and the storage-sync cadence; storage writes for chunk
+    *k* overlap the device execution of chunk *k+1*. ``n_startup_trials``
+    random (scrambled-Sobol) trials seed the GP in one vectorized dispatch
+    before the first chunk; a study that already holds COMPLETE trials over
+    this search space resumes from them. ``seed`` drives both the Sobol
+    startup and every in-graph proposal, so a fixed seed reproduces the
+    study bit-for-bit. Non-finite objective values are quarantined in-graph
+    (never ingested by the GP) and told FAIL at the chunk sync, matching
+    the per-trial executor's ``non_finite='fail'`` policy.
+    """
+    from optuna_tpu.study._study_direction import StudyDirection
+
+    if n_trials < 1:
+        raise ValueError(f"n_trials must be >= 1; got {n_trials}.")
+    if sync_every < 1:
+        raise ValueError(f"sync_every must be >= 1; got {sync_every}.")
+    if n_startup_trials < 1:
+        raise ValueError(f"n_startup_trials must be >= 1; got {n_startup_trials}.")
+    if len(study.directions) != 1:
+        raise ValueError("optimize_scan supports single-objective studies only.")
+    _validate_space(objective.search_space)
+
+    if study._thread_local.in_optimize_loop:
+        raise RuntimeError("Nested invocation of `optimize_scan` isn't allowed.")
+    study._stop_flag = False
+    study._thread_local.in_optimize_loop = True
+    health.attach(study)
+    try:
+        with _tracing.maybe_trace_from_env():
+            _run_scan(
+                study,
+                objective,
+                n_trials,
+                sync_every=sync_every,
+                n_startup_trials=n_startup_trials,
+                seed=seed,
+                minimum_noise=1e-7 if deterministic_objective else 1e-5,
+                callbacks=list(callbacks or ()),
+                n_preliminary_samples=n_preliminary_samples,
+                n_local_search=n_local_search,
+                lbfgs_iters=lbfgs_iters,
+                maximize=study.direction == StudyDirection.MAXIMIZE,
+            )
+    finally:
+        study._thread_local.in_optimize_loop = False
+        health.flush(study)
+
+
+def _run_scan(
+    study: "Study",
+    objective: "VectorizedObjective",
+    n_trials: int,
+    *,
+    sync_every: int,
+    n_startup_trials: int,
+    seed: int | None,
+    minimum_noise: float,
+    callbacks: list,
+    n_preliminary_samples: int,
+    n_local_search: int,
+    lbfgs_iters: int,
+    maximize: bool,
+) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp.gp import _bucket
+    from optuna_tpu.gp.search_space import SearchSpace
+
+    space_dict = objective.search_space
+    space = SearchSpace(space_dict)
+    d = space.dim
+    dev = _device_space(objective, space, n_preliminary_samples)
+    rng = np.random.RandomState(seed)
+
+    # Resume from any COMPLETE history over this space (the sampler's own
+    # convention), direction-applied and clipped to the f32-safe score.
+    prior = [
+        t
+        for t in study._get_trials(
+            deepcopy=False, states=(TrialState.COMPLETE,), use_cache=True
+        )
+        if all(p in t.params for p in space_dict)
+    ]
+    if prior:
+        X_hist = space.normalize([t.params for t in prior]).astype(np.float32)
+        vals = np.asarray([t.value for t in prior])
+        scores = _clip_scores(vals if maximize else -vals)
+    else:
+        X_hist = np.zeros((0, d), dtype=np.float32)
+        scores = np.zeros((0,), dtype=np.float32)
+
+    told = 0
+    # ---------------------------------------------------- random startup
+    n_startup = max(0, min(n_startup_trials - len(prior), n_trials))
+    if n_startup:
+        x0 = space.sample_normalized(
+            n_startup, seed=int(rng.randint(0, 2**31 - 1))
+        ).astype(np.float32)
+        startup = _startup_program(objective, space, n_startup)
+        with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"), \
+                flight.span("dispatch"):
+            vals0, fins0 = startup(jnp.asarray(x0))
+            vals0 = np.asarray(vals0)
+            fins0 = np.asarray(fins0)
+        _sync_results(study, space, space_dict, x0, vals0, fins0, callbacks)
+        told += n_startup
+        keep = fins0
+        if keep.any():
+            X_hist = np.concatenate([X_hist, x0[keep]])
+            scores = np.concatenate(
+                [scores, _clip_scores(vals0[keep] if maximize else -vals0[keep])]
+            )
+        if study._stop_flag or told >= n_trials:
+            return
+
+    # --------------------------------------------------- HBM bucket setup
+    n_hist = len(X_hist)
+    bucket = _bucket(n_hist + sync_every)
+    Xb = jnp.zeros((bucket, d), dtype=jnp.float32)
+    yb = jnp.zeros((bucket,), dtype=jnp.float32)
+    mb = jnp.zeros((bucket,), dtype=jnp.float32)
+    if n_hist:
+        Xb = Xb.at[:n_hist].set(X_hist)
+        yb = yb.at[:n_hist].set(scores)
+        mb = mb.at[:n_hist].set(1.0)
+    n_dev = jnp.asarray(n_hist, jnp.int32)
+    n_upper = n_hist  # host-side bound on the cursor (quarantines may lag it)
+    base_key = jax.random.PRNGKey(int(rng.randint(0, 2**31 - 1)))
+    default_start = np.zeros(d + 2, dtype=np.float32)
+    default_start[d + 1] = np.log(1e-2)
+    warm_raw = None  # previous chunk's fitted raw params (device array)
+    chunk_idx = 0
+    pending: tuple | None = None  # (xs, vals, finites, stats, n_tell)
+
+    remaining = n_trials - told
+    while remaining > 0 and not study._stop_flag:
+        if n_upper + sync_every > bucket:
+            # Bucket crossing: migrate the buffers to the next power-of-two
+            # capacity (one device-side copy; the old program is never
+            # reused at this size again).
+            grown = _bucket(n_upper + sync_every)
+            Xb = jnp.zeros((grown, d), dtype=jnp.float32).at[:bucket].set(Xb)
+            yb = jnp.zeros((grown,), dtype=jnp.float32).at[:bucket].set(yb)
+            mb = jnp.zeros((grown,), dtype=jnp.float32).at[:bucket].set(mb)
+            bucket = grown
+        if warm_raw is None:
+            n_starts, fit_iters = _SCAN_COLD_FIT
+            starts_np = [default_start]
+            while len(starts_np) < n_starts:
+                starts_np.append(
+                    (default_start + rng.normal(0, 1.0, size=d + 2)).astype(
+                        np.float32
+                    )
+                )
+            starts = jnp.asarray(np.stack(starts_np))
+        else:
+            n_starts, fit_iters = _SCAN_WARM_FIT
+            starts = jnp.stack([jnp.asarray(default_start), warm_raw])
+        program = _chunk_program(
+            objective, space, dev,
+            chunk_len=sync_every, bucket=bucket, n_starts=n_starts,
+            fit_iters=fit_iters, minimum_noise=minimum_noise,
+            maximize=maximize, n_local_search=n_local_search,
+            lbfgs_iters=lbfgs_iters,
+        )
+        key = jax.random.fold_in(base_key, chunk_idx)
+        chunk_idx += 1
+        # Dispatch chunk k+1, THEN sync chunk k: jax dispatch is
+        # asynchronous, so the storage writes below overlap the device
+        # executing this chunk. (The chunks are data-dependent — true
+        # device pipelining is impossible — but the host/storage work
+        # rides for free.)
+        with _tracing.annotate(_TRACE_CHUNK), telemetry.span("scan.chunk"), \
+                flight.span("scan.chunk"):
+            xs, vals, fins, Xb, yb, mb, n_dev, warm_raw, stats = program(
+                starts, Xb, yb, mb, n_dev, key
+            )
+        n_upper += sync_every
+        n_tell = min(sync_every, remaining)
+        remaining -= n_tell
+        if pending is not None:
+            _sync_chunk(study, space, space_dict, pending, callbacks)
+            if study._stop_flag:
+                # The just-dispatched chunk's trials were never created in
+                # storage — discarding the device work leaves nothing
+                # RUNNING and nothing told past the stop.
+                return
+        pending = (xs, vals, fins, stats, n_tell)
+
+    if pending is not None and not study._stop_flag:
+        _sync_chunk(study, space, space_dict, pending, callbacks)
+
+
+def _sync_chunk(study, space, space_dict, pending, callbacks) -> None:
+    """Realize one finished chunk (this is where the host blocks on the
+    device) and commit its trials; publish the chunk's device stats."""
+    xs, vals, fins, stats, n_tell = pending
+    with _tracing.annotate(_TRACE_SYNC), telemetry.span("scan.sync"), \
+            flight.span("scan.sync"):
+        xs_np = np.asarray(xs)
+        vals_np = np.asarray(vals)
+        fins_np = np.asarray(fins)
+        _publish_chunk(stats)
+        _sync_results(
+            study, space, space_dict,
+            xs_np[:n_tell], vals_np[:n_tell], fins_np[:n_tell], callbacks,
+        )
+
+
+def _sync_results(study, space, space_dict, xs, vals, fins, callbacks) -> None:
+    """Commit one chunk's results: create the trials (one storage batch),
+    pin each trial's params to the evaluated point, tell COMPLETE/FAIL —
+    the same logical end state the per-trial executor leaves. A mid-loop
+    error (or ``Study.stop()`` from a callback) fails the not-yet-told
+    remainder instead of stranding it RUNNING."""
+    if len(xs) == 0:
+        return
+    storage = study._storage
+    trial_ids = storage.create_new_trials(study._study_id, len(xs))
+    study._thread_local.cached_all_trials = None
+    trials = [Trial(study, tid) for tid in trial_ids]
+    i = 0
+    try:
+        for i, trial in enumerate(trials):
+            if study._stop_flag:
+                break
+            params = space.unnormalize_one(xs[i])
+            # Pin the evaluated point as the trial's relative proposal so
+            # _suggest records it under its distributions without touching
+            # the (bypassed) sampler — the executor's own mechanism.
+            trial.relative_search_space = space_dict
+            trial.relative_params = params
+            for name, dist in space_dict.items():
+                trial._suggest(name, dist)
+            if flight.enabled():
+                flight.trial_event("ask", trial.number)
+            if bool(fins[i]):
+                frozen = study.tell(trial, float(vals[i]))
+            else:
+                telemetry.count("executor.quarantine")
+                try:
+                    storage.set_trial_system_attr(
+                        trial._trial_id,
+                        "fail_reason",
+                        f"non-finite objective value {vals[i]!r} quarantined "
+                        "(scan loop, in-graph isfinite mask)",
+                    )
+                except Exception as err:  # graphlint: ignore[PY001] -- the reason attr is diagnostics; a blip on it must not skip the FAIL tell below
+                    _logger.warning(
+                        f"writing fail_reason for trial {trial.number} raised "
+                        f"{err!r}; failing the trial without it."
+                    )
+                frozen = study.tell(trial, state=TrialState.FAIL)
+                _logger.warning(
+                    f"Trial {trial.number} failed: non-finite objective value "
+                    f"{vals[i]!r} quarantined by the scan loop."
+                )
+            if flight.enabled():
+                flight.trial_event("tell", frozen.number, frozen.state.name)
+            for callback in callbacks:
+                callback(study, frozen)
+        else:
+            return
+        # Study.stop() mid-chunk: the rest of this chunk's already-created
+        # trials must not strand RUNNING (and must not COMPLETE past the
+        # budget) — quarantine them as FAIL, executor parity.
+        _fail_remaining(
+            study, trials[i:], "study stopped (Study.stop()) before this trial was told"
+        )
+    except Exception:  # graphlint: ignore[PY001] -- containment sweep: a storage blip mid-sync must not strand the chunk's already-created trials RUNNING; the original error re-raises after the sweep
+        _fail_remaining(
+            study, trials[i:], "scan chunk sync aborted before this trial was told"
+        )
+        raise
+    finally:
+        health.maybe_report(study)
+
+
+def _fail_remaining(study, trials, reason: str) -> None:
+    for trial in trials:
+        try:
+            try:
+                study._storage.set_trial_system_attr(
+                    trial._trial_id, "fail_reason", reason
+                )
+            except UpdateFinishedTrialError:
+                raise
+            except Exception:  # graphlint: ignore[PY001] -- diagnostics attr; the FAIL tell below is what matters
+                pass
+            study.tell(trial, state=TrialState.FAIL)
+        except UpdateFinishedTrialError:
+            continue
+        except Exception as err:  # graphlint: ignore[PY001] -- containment must visit every trial; a blip on one tell must not strand the rest RUNNING
+            _logger.warning(
+                f"failing trial {trial.number} raised {err!r}; continuing so "
+                "the rest of the chunk is not stranded RUNNING."
+            )

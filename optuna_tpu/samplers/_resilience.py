@@ -146,6 +146,78 @@ def ladder_cholesky(K, *, initial_jitter: float = _LADDER_INITIAL_JITTER):
     return L
 
 
+#: Relative pivot floor for the incremental update: below this fraction of
+#: the new row's own diagonal the Schur complement is numerically spent
+#: (f32 eps is ~1.2e-7; duplicates under a deterministic noise floor land
+#: here) and the factor falls back to a full jitter-ladder refactorization.
+_RANK1_PIVOT_RTOL = 1e-6
+
+
+def ladder_cholesky_rank1_update(L, k_row, slot, kernel_fn, *,
+                                 initial_jitter: float = _LADDER_INITIAL_JITTER):
+    """Extend a ladder-Cholesky factor by one observation in O(n^2) instead
+    of refactorizing the whole Gram in O(n^3) — the per-tell update the
+    HBM-resident scan loop (:mod:`optuna_tpu.parallel.scan_loop`) rides.
+
+    ``L`` is the (N, N) lower factor of the padded kernel whose rows
+    ``< slot`` are real observations (appends are in slot order, so every
+    row ``>= slot`` is padding). ``k_row`` is row ``slot`` of the extended
+    kernel — cross-covariances against the buffer plus the noise-carrying
+    diagonal at position ``slot``. Because a Cholesky factor's leading
+    block depends only on the leading block of the matrix, the append
+    touches exactly one row: one triangular solve for the off-diagonal
+    entries and one Schur-complement pivot for the diagonal. Padding rows
+    keep their (stale, decoupled) entries — their alpha contribution is
+    ~``1/PAD_NOISE`` and vanishes at the next chunk-boundary
+    refactorization.
+
+    The pivot is the update's health verdict, checked **in-graph**: a
+    non-finite or near-zero Schur complement (an exact-duplicate design row
+    under a deterministic noise floor — routine with retry clones) means
+    the incremental path would mint a singular factor, so a ``lax.cond``
+    falls back to a full :func:`ladder_cholesky_with_rung` refactorization
+    of ``kernel_fn()`` (built lazily: the O(n^2) kernel matrix is only
+    materialized on the fallback branch). No host sync either way.
+
+    Returns ``(L_new, rung, refactored)`` — ``rung`` is the jitter ladder's
+    escalation count (0 on the incremental path), ``refactored`` is an i32
+    0/1 flag. Both ride out as device stats (``scan.rank1_updates`` /
+    ``scan.refactorizations``) so the rung channel records which path ran.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = L.shape[-1]
+    idx = jnp.arange(n)
+    before = idx < slot
+    k_masked = jnp.where(before, k_row, 0.0)
+    l_off = jax.scipy.linalg.solve_triangular(L, k_masked, lower=True)
+    l_off = jnp.where(before, l_off, 0.0)
+    diag = jnp.take(k_row, slot)
+    pivot = diag - jnp.sum(l_off * l_off)
+    ok = (
+        jnp.all(jnp.isfinite(l_off))
+        & jnp.isfinite(pivot)
+        & (pivot > _RANK1_PIVOT_RTOL * jnp.abs(diag))
+    )
+
+    def _incremental():
+        new_row = jnp.where(
+            idx == slot, jnp.sqrt(jnp.maximum(pivot, 1e-30)), l_off
+        )
+        L_new = jnp.where((idx == slot)[:, None], new_row[None, :], L)
+        zero = jnp.asarray(0, jnp.int32)
+        return L_new, zero, zero
+
+    def _refactor():
+        L_new, rung = ladder_cholesky_with_rung(
+            kernel_fn(), initial_jitter=initial_jitter
+        )
+        return L_new, rung, jnp.asarray(1, jnp.int32)
+
+    return jax.lax.cond(ok, _incremental, _refactor)
+
+
 def clip_objective_values(values: np.ndarray) -> np.ndarray:
     """Clip ``±inf`` (and beyond-float32 magnitudes like ``1e308``) to the
     float32 extremes so a mean/std standardization stays finite end to end.

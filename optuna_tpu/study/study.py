@@ -227,6 +227,27 @@ class Study:
                 show_progress_bar=show_progress_bar,
             )
 
+    def optimize_scan(
+        self,
+        objective: Any,
+        n_trials: int,
+        **kwargs: Any,
+    ) -> None:
+        """Run ``n_trials`` GP-BO trials with the whole ask -> evaluate ->
+        tell cycle resident in HBM (see
+        :func:`optuna_tpu.parallel.scan_loop.optimize_scan`): history lives
+        in preallocated power-of-two device buckets, each ``sync_every``
+        trials advance as one jitted ``lax.scan`` program (incremental
+        O(n^2) Cholesky tells, in-graph non-finite quarantine), and
+        COMPLETE/FAIL trials sync to storage in chunks that overlap the
+        next chunk's device execution. ``objective`` is a
+        :class:`~optuna_tpu.parallel.vectorized.VectorizedObjective`
+        (jittable fn + explicit search space); the study's sampler is
+        bypassed — the in-graph GP proposal is the loop."""
+        from optuna_tpu.parallel.scan_loop import optimize_scan
+
+        optimize_scan(self, objective, n_trials, **kwargs)
+
     def ask(self, fixed_distributions: dict[str, BaseDistribution] | None = None) -> Trial:
         """Create a new (or claim a WAITING) trial (reference ``study.py:527``)."""
         if not self._thread_local.in_optimize_loop and is_heartbeat_enabled(self._storage):

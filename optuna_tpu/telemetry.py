@@ -87,6 +87,8 @@ PHASES: dict[str, str] = {
     "dispatch": "objective execution (serial call or batched device dispatch)",
     "tell": "result commit + callbacks (study.tell / batch tell loop)",
     "storage.op": "one logical storage operation (retries + backoff included)",
+    "scan.chunk": "one HBM-resident scan-chunk dispatch (host side; the device run overlaps the previous chunk's sync)",
+    "scan.sync": "chunk-boundary result wait + storage sync of a scan chunk's trials",
 }
 
 #: The containment-counter vocabulary: one entry per event family the

@@ -229,6 +229,14 @@ DEVICE_STAT_CHAOS_MATRIX: dict[str, str] = {
     "gp.best_acq": "run a fused GP ask; the reported best acquisition value is finite",
     "executor.quarantined": "inject NaN at scheduled batch slots; the harvested total equals the "
     "plan's slot count exactly, the fault-free twin reports 0",
+    "scan.rank1_updates": "run a fault-free scan study on a well-conditioned objective; updates "
+    "equal the ingested tells and refactorizations stay 0 after warm-up",
+    "scan.refactorizations": "append an exact-duplicate design row under a deterministic noise "
+    "floor; the in-graph pivot check falls back to the full ladder refactorization",
+    "scan.quarantined": "inject NaN objective slots inside a scan chunk; the harvested total "
+    "equals the plan's slot count, each slot told FAIL at sync, the fault-free twin reports 0",
+    "scan.chunk_fill": "fault-free scan chunk; the fill equals the chunk length (quarantined "
+    "chunks fill short by exactly the quarantined count)",
 }
 
 

@@ -1,0 +1,88 @@
+"""Executor fault-free fast path (ROADMAP item 5's refactor unlock): with
+heartbeats disabled, ``_run_one_batch`` must not construct the per-batch
+``HeartbeatThread`` (or even its context manager) and must add zero extra
+dispatches — the telemetry phase count per batch is identical to a direct
+dispatch."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import VectorizedObjective, optimize_vectorized
+from optuna_tpu.storages import _heartbeat
+from optuna_tpu.trial._state import TrialState
+
+optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+
+
+def _objective():
+    import jax.numpy as jnp
+
+    return VectorizedObjective(
+        fn=lambda p: (p["x"] - 0.3) ** 2 + jnp.zeros_like(p["x"]),
+        search_space={"x": FloatDistribution(0.0, 1.0)},
+    )
+
+
+class _Spy:
+    """Records every HeartbeatThread construction (init is enough — the
+    contract is that the clean path never even builds the object)."""
+
+    def __init__(self, monkeypatch):
+        self.constructed = 0
+        original = _heartbeat.HeartbeatThread.__init__
+
+        def spying_init(hb_self, trial_id, heartbeat):
+            self.constructed += 1
+            return original(hb_self, trial_id, heartbeat)
+
+        monkeypatch.setattr(_heartbeat.HeartbeatThread, "__init__", spying_init)
+
+
+def test_no_heartbeat_thread_on_heartbeat_less_storage(monkeypatch):
+    spy = _Spy(monkeypatch)
+    study = optuna_tpu.create_study()  # InMemoryStorage: no heartbeat
+    optimize_vectorized(study, _objective(), n_trials=12, batch_size=4)
+    assert spy.constructed == 0
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+
+def test_heartbeat_storage_still_gets_the_batch_thread(monkeypatch, tmp_path):
+    from optuna_tpu.storages import RDBStorage
+
+    spy = _Spy(monkeypatch)
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/hb.db", heartbeat_interval=60, grace_period=120
+    )
+    study = optuna_tpu.create_study(storage=storage)
+    optimize_vectorized(study, _objective(), n_trials=8, batch_size=4)
+    assert spy.constructed == 2  # one shared thread per batch
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+
+def test_clean_path_phase_count_matches_direct_dispatch():
+    """Zero extra dispatches on the fault-free fast path: each batch records
+    exactly one ask, one dispatch, one tell phase observation — the same
+    count a direct dispatch of the batch would produce, with no
+    heartbeat-induced extras."""
+    telemetry.disable()
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    try:
+        study = optuna_tpu.create_study()
+        optimize_vectorized(study, _objective(), n_trials=12, batch_size=4)
+        phases = telemetry.phase_totals()
+        n_batches = 3
+        assert phases["ask"]["count"] == n_batches
+        assert phases["dispatch"]["count"] == n_batches
+        assert phases["tell"]["count"] == n_batches
+        # No containment fired on the clean path.
+        registry = telemetry.get_registry()
+        for family in ("executor.quarantine", "executor.bisection", "heartbeat.reap"):
+            assert registry.counter_value(family) == 0
+    finally:
+        telemetry.disable()

@@ -1,0 +1,519 @@
+"""HBM-resident scan loop (parallel/scan_loop.py): end-to-end equivalence
+with the per-trial path's storage contract, in-graph quarantine chaos,
+O(n^2) incremental-tell evidence through the device-stats channel, bounded
+compile counts across bucket crossings, and the disabled-observability
+zero-allocation contract."""
+
+from __future__ import annotations
+
+import gc
+import sys
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu.distributions import (
+    CategoricalDistribution,
+    FloatDistribution,
+    IntDistribution,
+)
+from optuna_tpu.models.benchmarks import hartmann6_jax
+from optuna_tpu.parallel import VectorizedObjective, optimize_scan
+from optuna_tpu.trial._state import TrialState
+
+optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+
+SPACE6 = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(6)}
+
+
+def _hartmann_objective():
+    return VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6))
+
+
+@pytest.fixture(autouse=True)
+def _observability_off():
+    telemetry.disable()
+    flight.disable()
+    yield
+    telemetry.disable()
+    flight.disable()
+
+
+# --------------------------------------------------------------- contract
+
+
+def _assert_per_trial_path_state(study, n_trials, space):
+    """The end-to-end equivalence contract: a scan-mode study leaves
+    storage in the per-trial path's logical state — every trial terminal
+    exactly once, COMPLETE with params under its distributions and a
+    finite value, FAIL with a fail_reason system attr."""
+    trials = study.trials
+    assert len(trials) == n_trials
+    assert [t.number for t in trials] == list(range(n_trials))
+    for t in trials:
+        assert t.state in (TrialState.COMPLETE, TrialState.FAIL)
+        assert set(t.params) == set(space)
+        assert t.distributions == space
+        for name, dist in space.items():
+            assert dist._contains(dist.to_internal_repr(t.params[name]))
+        if t.state == TrialState.COMPLETE:
+            assert t.value is not None and np.isfinite(t.value)
+        else:
+            assert "fail_reason" in t.system_attrs
+
+
+def test_scan_study_matches_per_trial_storage_contract_in_memory():
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=30, sync_every=8,
+        n_startup_trials=8, seed=0,
+    )
+    _assert_per_trial_path_state(study, 30, SPACE6)
+    assert study.best_value < -1.0  # the GP actually optimizes
+
+
+def test_scan_study_contract_on_rdb(tmp_path):
+    from optuna_tpu.storages import RDBStorage
+
+    storage = RDBStorage(f"sqlite:///{tmp_path}/scan.db")
+    study = optuna_tpu.create_study(storage=storage)
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=14, sync_every=6,
+        n_startup_trials=6, seed=0,
+    )
+    _assert_per_trial_path_state(study, 14, SPACE6)
+    # The logical state survives a reload through the storage.
+    reloaded = optuna_tpu.load_study(
+        study_name=study.study_name, storage=storage
+    )
+    _assert_per_trial_path_state(reloaded, 14, SPACE6)
+
+
+def test_scan_study_contract_on_journal(tmp_path):
+    from optuna_tpu.storages import JournalFileBackend, JournalStorage
+
+    storage = JournalStorage(JournalFileBackend(str(tmp_path / "scan.log")))
+    study = optuna_tpu.create_study(storage=storage)
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=14, sync_every=6,
+        n_startup_trials=6, seed=0,
+    )
+    _assert_per_trial_path_state(study, 14, SPACE6)
+    replay = optuna_tpu.load_study(
+        study_name=study.study_name,
+        storage=JournalStorage(JournalFileBackend(str(tmp_path / "scan.log"))),
+    )
+    _assert_per_trial_path_state(replay, 14, SPACE6)
+
+
+def test_mixed_space_decodes_in_graph_and_records_valid_params():
+    import jax.numpy as jnp
+
+    space = {
+        "lr": FloatDistribution(1e-3, 1.0, log=True),
+        "width": IntDistribution(4, 64),
+        "act": CategoricalDistribution(["relu", "tanh", "gelu"]),
+    }
+
+    def fn(params):
+        # Internal reprs: lr float, width float of int value, act int32 index.
+        return (
+            (jnp.log(params["lr"]) + 3.0) ** 2
+            + (params["width"] - 32.0) ** 2 / 100.0
+            + params["act"].astype(jnp.float32)
+        )
+
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, VectorizedObjective(fn=fn, search_space=space),
+        n_trials=20, sync_every=6, n_startup_trials=6, seed=0,
+    )
+    _assert_per_trial_path_state(study, 20, space)
+    for t in study.trials:
+        assert isinstance(t.params["width"], int)
+        assert t.params["act"] in ("relu", "tanh", "gelu")
+        assert 1e-3 <= t.params["lr"] <= 1.0
+
+
+def test_fixed_seed_is_bit_identical():
+    bests, param_sets = [], []
+    for _ in range(2):
+        study = optuna_tpu.create_study()
+        optimize_scan(
+            study, _hartmann_objective(), n_trials=26, sync_every=8,
+            n_startup_trials=8, seed=11,
+        )
+        bests.append(study.best_value)
+        param_sets.append([t.params for t in study.trials])
+    assert bests[0] == bests[1]
+    assert param_sets[0] == param_sets[1]
+
+
+def test_resumes_from_existing_complete_history():
+    study = optuna_tpu.create_study()
+    obj = _hartmann_objective()
+    optimize_scan(study, obj, n_trials=12, sync_every=6, n_startup_trials=8, seed=0)
+    optimize_scan(study, obj, n_trials=10, sync_every=5, n_startup_trials=8, seed=1)
+    # The second run found >= 8 prior COMPLETE trials, so it runs no random
+    # startup block at all — every new trial is a GP proposal.
+    _assert_per_trial_path_state(study, 22, SPACE6)
+
+
+def test_study_optimize_scan_method_delegates():
+    study = optuna_tpu.create_study()
+    study.optimize_scan(
+        _hartmann_objective(), 12, sync_every=6, n_startup_trials=6, seed=0
+    )
+    _assert_per_trial_path_state(study, 12, SPACE6)
+
+
+def test_stop_via_callback_leaves_no_running_trials():
+    stop_after = 10
+
+    def cb(study, frozen):
+        if frozen.number + 1 >= stop_after:
+            study.stop()
+
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=40, sync_every=8,
+        n_startup_trials=8, seed=0, callbacks=[cb],
+    )
+    states = Counter(t.state for t in study.trials)
+    assert states.get(TrialState.RUNNING, 0) == 0
+    # Never told past the budget implied by the stop: the chunk in flight
+    # when the stop fired is quarantined/discarded, not completed.
+    assert states[TrialState.COMPLETE] <= stop_after + 8
+    assert len(study.trials) < 40
+
+
+def test_validation_errors():
+    obj = _hartmann_objective()
+    study = optuna_tpu.create_study()
+    with pytest.raises(ValueError, match="n_trials"):
+        optimize_scan(study, obj, 0)
+    with pytest.raises(ValueError, match="sync_every"):
+        optimize_scan(study, obj, 4, sync_every=0)
+    multi = optuna_tpu.create_study(directions=["minimize", "minimize"])
+    with pytest.raises(ValueError, match="single-objective"):
+        optimize_scan(multi, obj, 4)
+    with pytest.raises(ValueError, match="non-empty"):
+        optimize_scan(study, VectorizedObjective(fn=lambda p: 0.0, search_space={}), 4)
+
+
+def test_nested_invocation_raises():
+    study = optuna_tpu.create_study()
+    seen = []
+
+    def cb(s, frozen):
+        if not seen:
+            seen.append(True)
+            with pytest.raises(RuntimeError, match="Nested"):
+                optimize_scan(s, _hartmann_objective(), 4, n_startup_trials=1)
+
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=6, sync_every=3,
+        n_startup_trials=3, seed=0, callbacks=[cb],
+    )
+    assert seen
+
+
+# ------------------------------------------------------------------ chaos
+
+
+def _poison_objective(threshold: float = 0.5):
+    """NaN whenever x0 < threshold — a poison *region*, so quarantines
+    recur across chunks."""
+    import jax.numpy as jnp
+
+    def fn(params):
+        vals = hartmann6_jax(params)
+        return jnp.where(params["x0"] < threshold, jnp.nan, vals)
+
+    return VectorizedObjective(fn=fn, search_space=dict(SPACE6))
+
+
+def test_nan_slots_quarantined_in_graph_and_told_fail():
+    """The scan-chaos satellite: NaN objective slots are quarantined by the
+    in-graph isfinite verdict, told FAIL at the chunk sync, and never
+    ingested by the GP fit — asserted through the device-stats channel and
+    the storage's terminal states."""
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _poison_objective(), n_trials=32, sync_every=8,
+        n_startup_trials=8, seed=3,
+    )
+    trials = study.trials
+    assert len(trials) == 32
+    states = Counter(t.state for t in trials)
+    assert states.get(TrialState.RUNNING, 0) == 0
+    n_fail = states.get(TrialState.FAIL, 0)
+    assert n_fail > 0  # the poison region was hit
+    # Device channel == storage truth == containment counter, exactly.
+    gauges = device_stats.stat_gauges()
+    scan_quar = int(gauges.get("device.scan.quarantined.total", 0))
+    startup_fails = sum(
+        1 for t in trials[:8] if t.state == TrialState.FAIL
+    )
+    assert scan_quar == n_fail - startup_fails
+    assert telemetry.get_registry().counter_value("executor.quarantine") == n_fail
+    # Quarantined slots were never ingested: every scan chunk's fill is
+    # its tell count minus its quarantines (the cursor skipped them), and
+    # no COMPLETE trial carries a non-finite value.
+    n_updates = int(gauges.get("device.scan.rank1_updates.total", 0))
+    n_refac = int(gauges.get("device.scan.refactorizations.total", 0))
+    assert n_updates + n_refac == states[TrialState.COMPLETE] - (8 - startup_fails)
+    for t in trials:
+        if t.state == TrialState.COMPLETE:
+            assert np.isfinite(t.value)
+        else:
+            assert "fail_reason" in t.system_attrs
+            assert "quarantined" in t.system_attrs["fail_reason"]
+
+
+def test_huge_and_inf_history_does_not_blind_the_gp():
+    """Review regression (f32 in-graph standardization): resuming from a
+    history carrying ±inf / 1e308 objectives — storage-legal, and exactly
+    what clip_objective_values defends elsewhere — must not overflow the
+    chunk program's f32 variance (sd=inf would zero every standardized
+    target and blind the GP for the study's lifetime). The scan bounds its
+    score buffer to an f32-squarable range instead."""
+    from optuna_tpu.trial._frozen import create_trial
+
+    study = optuna_tpu.create_study()
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        value = (float("inf"), 1e308, 1.0)[i % 3]
+        study.add_trial(
+            create_trial(
+                state=TrialState.COMPLETE,
+                params={k: float(v) for k, v in zip(SPACE6, rng.uniform(0, 1, 6))},
+                distributions=dict(SPACE6),
+                values=[value],
+            )
+        )
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=16, sync_every=8,
+        n_startup_trials=8, seed=0,
+    )
+    trials = study.trials
+    assert len(trials) == 26
+    new = trials[10:]
+    # No quarantine storm: the poisoned standardization would NaN every
+    # proposal and FAIL all 16; with the clip the GP stays live.
+    assert all(t.state == TrialState.COMPLETE for t in new)
+    assert all(np.isfinite(t.value) for t in new)
+    assert min(t.value for t in new) < 0.0  # still actually optimizing
+    gauges = device_stats.stat_gauges()
+    assert int(gauges.get("device.scan.quarantined.total", 0)) == 0
+
+
+def test_second_run_with_different_candidate_pool_rebuilds_the_program():
+    """Review regression: the chunk-program cache key must include the
+    candidate-pool size — a second run with a different
+    n_preliminary_samples must not silently reuse a program closed over
+    the old Sobol pool."""
+    obj = _hartmann_objective()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, obj, n_trials=10, sync_every=5, n_startup_trials=5, seed=0,
+        n_preliminary_samples=128,
+    )
+    study2 = optuna_tpu.create_study()
+    optimize_scan(
+        study2, obj, n_trials=10, sync_every=5, n_startup_trials=5, seed=0,
+        n_preliminary_samples=256,
+    )
+    pools = {
+        k[-1]
+        for k in obj._compiled_cache
+        if isinstance(k, tuple) and k[0] == "scan_chunk"
+    }
+    assert pools == {128, 256}
+
+
+def test_fault_free_twin_is_deterministic_and_containment_free():
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=24, sync_every=8,
+        n_startup_trials=8, seed=3,
+    )
+    assert telemetry.get_registry().counter_value("executor.quarantine") == 0
+    gauges = device_stats.stat_gauges()
+    assert gauges.get("device.scan.quarantined.total", 0) == 0
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+
+# ----------------------------------------------------- incremental-tell O(n)
+
+
+def test_zero_full_refactorizations_after_warmup_on_well_conditioned_history():
+    """The O(n)-per-tell acceptance evidence: on a well-conditioned history
+    every in-scan tell takes the incremental row append — the full
+    refactorization counter stays at zero across the whole study (the only
+    full factorizations are the one-per-chunk boundary refits, which are
+    not counted: they are the amortized O(n^3/sync_every) part)."""
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=56, sync_every=8,
+        n_startup_trials=8, seed=1,
+    )
+    gauges = device_stats.stat_gauges()
+    assert int(gauges["device.scan.refactorizations.total"]) == 0
+    assert int(gauges["device.scan.rank1_updates.total"]) == 48
+    assert int(gauges["device.scan.chunk_fill.last"]) == 8
+
+
+def test_compile_count_bounded_by_bucket_crossings():
+    """One compiled program per (bucket, fit-variant): a study spanning
+    several power-of-two buckets compiles at most 1 cold + one warm program
+    per bucket + the startup evaluator — log2(n_trials)-bounded, not
+    O(n_trials)."""
+    obj = _hartmann_objective()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, obj, n_trials=72, sync_every=8, n_startup_trials=8, seed=0
+    )
+    chunk_programs = [
+        k for k in obj._compiled_cache if isinstance(k, tuple) and k[0] == "scan_chunk"
+    ]
+    # Buckets visited: 16 -> 32 -> 64 -> 128; one cold program (first chunk)
+    # plus warm variants.
+    assert 1 <= len(chunk_programs) <= 1 + 4
+    buckets = sorted({k[2] for k in chunk_programs})
+    assert all(b & (b - 1) == 0 for b in buckets)  # powers of two
+    assert len(
+        [k for k in obj._compiled_cache if isinstance(k, tuple) and k[0] == "scan_startup"]
+    ) == 1
+
+
+# ------------------------------------------------------------ observability
+
+
+def test_scan_phases_recorded_on_the_shared_vocabulary():
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=24, sync_every=8,
+        n_startup_trials=8, seed=0,
+    )
+    phases = telemetry.phase_totals()
+    assert phases["scan.chunk"]["count"] == 2
+    assert phases["scan.sync"]["count"] == 2
+    assert phases["dispatch"]["count"] == 1  # the startup evaluator
+    assert "scan.chunk" in telemetry.PHASES and "scan.sync" in telemetry.PHASES
+
+
+def test_flight_records_scan_trial_lifecycle():
+    flight.enable(flight.FlightRecorder(capacity=8192))  # fresh ring: no residue
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _hartmann_objective(), n_trials=12, sync_every=6,
+        n_startup_trials=6, seed=0,
+    )
+    evs = flight.events()
+    trial_events = [e for e in evs if e.kind == "trial"]
+    asks = [e for e in trial_events if e.name == "ask"]
+    tells = [e for e in trial_events if e.name == "tell"]
+    assert len(asks) == 12 and len(tells) == 12
+    span_names = {e.name for e in evs if e.kind == "phase"}
+    assert {"scan.chunk", "scan.sync"} <= span_names
+
+
+def test_disabled_observability_adds_zero_per_trial_allocations():
+    """The disabled-observability contract, scan-mode edition (the 10k-trial
+    bounded-heap pattern from tests/test_device_stats.py): with telemetry
+    and flight off, the chunk-boundary publish path allocates nothing."""
+    from optuna_tpu.parallel.scan_loop import _publish_chunk
+
+    telemetry.disable()
+    flight.disable()
+    stats = {
+        "gp.ladder_rung": 0,
+        "gp.fit_iterations": 12,
+        "scan.rank1_updates": 8,
+        "scan.refactorizations": 0,
+        "scan.quarantined": 0,
+        "scan.chunk_fill": 8,
+    }
+    for _ in range(200):  # warm free lists / caches
+        _publish_chunk(stats)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        _publish_chunk(stats)
+    gc.collect()
+    after = sys.getallocatedblocks()
+    assert after - before < 500
+
+
+def test_disabled_run_records_nothing_but_still_quarantines():
+    telemetry.reset()  # clear residue from earlier recording tests
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _poison_objective(), n_trials=16, sync_every=8,
+        n_startup_trials=8, seed=3,
+    )
+    telemetry.enable(telemetry.get_registry())
+    assert device_stats.stat_gauges() == {}
+    states = Counter(t.state for t in study.trials)
+    assert states.get(TrialState.FAIL, 0) > 0
+    assert states.get(TrialState.RUNNING, 0) == 0
+
+
+# ------------------------------------------------------------------- perf
+
+
+@pytest.mark.slow
+def test_scan_mode_beats_per_trial_path_steady_state():
+    """Perf-evidence regression guard (the full ≥5x-at-n=512 figure is the
+    bench's --loop=scan job; this is the fast canary at a CI-safe size):
+    scan-mode wall per trial must beat the fused per-trial ask/tell path
+    on the same GP config by a healthy margin once both are warm."""
+    import time
+
+    from optuna_tpu.samplers import GPSampler
+
+    n = 160
+    obj = _hartmann_objective()
+    study_scan = optuna_tpu.create_study()
+    # Warm the compile caches outside the timed window.
+    optimize_scan(study_scan, obj, n_trials=n, sync_every=16, n_startup_trials=16, seed=0)
+    study_scan2 = optuna_tpu.create_study()
+    t0 = time.perf_counter()
+    optimize_scan(study_scan2, obj, n_trials=n, sync_every=16, n_startup_trials=16, seed=1)
+    scan_dt = time.perf_counter() - t0
+
+    def objective(trial):
+        params = {f"x{i}": trial.suggest_float(f"x{i}", 0.0, 1.0) for i in range(6)}
+        import jax.numpy as jnp
+
+        return float(
+            hartmann6_jax({k: jnp.asarray([v], jnp.float32) for k, v in params.items()})[0]
+        )
+
+    study_serial = optuna_tpu.create_study(
+        sampler=GPSampler(seed=0, n_startup_trials=16)
+    )
+    study_serial.optimize(objective, n_trials=n)  # warm
+    study_serial2 = optuna_tpu.create_study(
+        sampler=GPSampler(seed=1, n_startup_trials=16)
+    )
+    t0 = time.perf_counter()
+    study_serial2.optimize(objective, n_trials=n)
+    serial_dt = time.perf_counter() - t0
+    assert scan_dt * 2.0 < serial_dt, (
+        f"scan {n / scan_dt:.1f} trials/s vs per-trial {n / serial_dt:.1f}"
+    )
