@@ -140,6 +140,17 @@ def _device_stats_breakdown() -> dict:
         )
         block["scan_quarantined"] = int(gauges.get("device.scan.quarantined.total", 0))
         block["scan_chunk_fill"] = int(gauges.get("device.scan.chunk_fill.last", 0))
+    # Sharded-loop counters (ISSUE 12), present only when the window ran the
+    # pod-mesh loop: per-shard dispatch width plus the per-shard containment
+    # evidence (quarantined slots, shard groups re-dispatched in isolation).
+    if "device.shard.width.last" in gauges:
+        block["shard_width"] = int(gauges["device.shard.width.last"])
+        block["shard_quarantined"] = int(
+            gauges.get("device.shard.quarantined.total", 0)
+        )
+        block["shard_contained_groups"] = int(
+            gauges.get("device.shard.contained_groups.total", 0)
+        )
     return block
 
 
@@ -433,6 +444,118 @@ def run_ours_mlp_vectorized(
     # JSON, so the numbers are read as estimates, not telemetry.
     util["util_provenance"] = "probe-extrapolated-estimate"
     return n_timed / dt, study.best_value, util
+
+
+_SHARDED_MESH_SHAPE = {"trials": 4, "model": 2}
+
+
+def _force_cpu_mesh(n: int) -> None:
+    """The sharded bench needs an ``n``-device mesh; the axon tunnel exposes
+    one TPU chip, so the committed sharded baseline runs on the forced CPU
+    mesh (``--xla_force_host_platform_device_count``), exactly the
+    acceptance geometry. Must run before the first device call — XLA parses
+    the flag at backend init."""
+    import jax
+
+    if f"--xla_force_host_platform_device_count={n}" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        )
+    for name, value in (("jax_platforms", "cpu"), ("jax_num_cpu_devices", n)):
+        try:
+            jax.config.update(name, value)
+        except (RuntimeError, AttributeError):
+            # Backend already initialized, or this jax lacks the option (the
+            # XLA flag spelling above covers it) — run on what exists.
+            pass
+
+
+def _sharded_mlp_objective():
+    """The MULTICHIP dry-run promoted: the shared MLP problem as a
+    :class:`~optuna_tpu.parallel.sharded.ShardedObjective` whose hidden
+    dimension is split over the ``model`` axis by partition rules, trials
+    vmapped over the ``trials`` axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from optuna_tpu.distributions import FloatDistribution
+    from optuna_tpu.parallel import ShardedObjective
+
+    x_np, yl_np, init = _mlp_problem()
+    x = jnp.asarray(x_np)
+    yl = jnp.asarray(yl_np)
+    n_out = init["w2"].shape[1]
+    onehot = jnp.eye(n_out, dtype=jnp.float32)[yl]
+
+    def cross_entropy(logits):
+        logits = logits - logits.max(axis=1, keepdims=True)
+        lse = jnp.log(jnp.exp(logits).sum(axis=1))
+        return jnp.mean(lse - jnp.sum(logits * onehot, axis=1))
+
+    def train_one(m, lr, scale):
+        p = {k: v * scale for k, v in m.items()}
+
+        def forward(p):
+            h = jnp.maximum(x @ p["w1"] + p["b1"], 0.0)
+            return h @ p["w2"] + p["b2"]
+
+        def step(p, _):
+            loss, grads = jax.value_and_grad(lambda q: cross_entropy(forward(q)))(p)
+            return {k: v - lr * grads[k] for k, v in p.items()}, loss
+
+        p, _losses = jax.lax.scan(step, p, None, length=_MLP_SGD_STEPS)
+        return cross_entropy(forward(p))
+
+    def fn(params, m):
+        return jax.vmap(train_one, in_axes=(None, 0, 0))(
+            m, params["lr"], params["init_scale"]
+        )
+
+    return ShardedObjective(
+        fn,
+        {
+            "lr": FloatDistribution(1e-3, 1.0, log=True),
+            "init_scale": FloatDistribution(0.3, 3.0),
+        },
+        model=init,
+        partition_rules=[
+            ("w1", P(None, "model")),  # (784, hidden): hidden split across chips
+            ("b1", P("model")),
+            ("w2", P("model", None)),  # (hidden, 10)
+            (".*", P()),  # b2 and anything else replicates
+        ],
+    )
+
+
+def run_ours_mlp_sharded(
+    n_warmup: int, n_timed: int, batch_size: int = 256
+) -> tuple[float, float]:
+    """``--loop=sharded``: the MULTICHIP_r05 dry-run as a committed bench —
+    the sharded MLP study on the 2-D ``{'trials': 4, 'model': 2}`` mesh,
+    batch-asked and executed through ``optimize_sharded`` (per-shard
+    containment live, trial sync through the normal storage path)."""
+    import optuna_tpu
+    from optuna_tpu.parallel import build_study_mesh, optimize_sharded
+    from optuna_tpu.samplers import TPESampler
+
+    _silence()
+    mesh = build_study_mesh(_SHARDED_MESH_SHAPE)
+    obj = _sharded_mlp_objective()
+    study = optuna_tpu.create_study(
+        sampler=TPESampler(
+            seed=0, multivariate=True, constant_liar=True, n_startup_trials=10
+        )
+    )
+    optimize_sharded(study, obj, n_trials=n_warmup, batch_size=batch_size, mesh=mesh)
+    _reset_phase_telemetry()
+    t0 = time.time()
+    optimize_sharded(study, obj, n_trials=n_timed, batch_size=batch_size, mesh=mesh)
+    dt = time.time() - t0
+    return n_timed / dt, study.best_value
 
 
 def run_ours_nsga2(n_warmup: int, n_timed: int, objective=None, hv_ref=(1.1, 10.0)) -> tuple[float, float]:
@@ -922,10 +1045,13 @@ def main() -> None:
     parser.add_argument(
         "--loop",
         default="ask_tell",
-        choices=["ask_tell", "scan"],
-        help="study-loop mode: the per-trial ask/tell path (default) or the "
-        "HBM-resident lax.scan loop (gp config only; its own trajectory "
-        "metric, so the scan loop gets a distinct gate baseline)",
+        choices=["ask_tell", "scan", "sharded"],
+        help="study-loop mode: the per-trial ask/tell path (default), the "
+        "HBM-resident lax.scan loop (gp config only), or the pod-mesh "
+        "sharded loop (the MULTICHIP dry-run promoted: sharded MLP trials "
+        "on a {'trials': 4, 'model': 2} CPU mesh) — scan and sharded each "
+        "carry their own trajectory metric, so each path gets a distinct "
+        "gate baseline",
     )
     args = parser.parse_args()
     watchdog.phase(f"run:{args.config}:{args.loop}")
@@ -938,7 +1064,43 @@ def main() -> None:
     # steady-state trials/s figure.
     n_timed = None
 
-    if args.loop == "scan":
+    if args.loop == "sharded":
+        if args.config not in ("gp", "mlp"):
+            parser.error(
+                "--loop=sharded runs the sharded MLP mesh study (default or "
+                "--config mlp)"
+            )
+        # Acceptance geometry (ISSUE 12): the MULTICHIP_r05 mesh on 8 forced
+        # CPU devices; throughput vs the live unsharded vectorized twin on
+        # the same MLP config.
+        _force_cpu_mesh(8)
+        n_warm, n_timed = (256, 512) if args.quick else (256, 2048)
+        mesh_note = "x".join(str(v) for v in _SHARDED_MESH_SHAPE.values())
+        _log(
+            f"running ours (sharded loop / MLP-256, mesh {_SHARDED_MESH_SHAPE}, "
+            f"n={n_timed} timed)..."
+        )
+        ours_rate, ours_best = run_ours_mlp_sharded(n_warm, n_timed)
+        # Capture the sharded window's breakdown NOW: the unsharded twin
+        # below is instrumented ours-side code too (same policy as
+        # --loop=scan's capture ordering).
+        extra["phases"] = _phase_breakdown()
+        extra["device_stats"] = _device_stats_breakdown()
+        extra["compile"] = _compile_breakdown()
+        extra["mesh"] = dict(_SHARDED_MESH_SHAPE)
+        _log(
+            f"ours(sharded): {ours_rate:.3f} trials/s (best {ours_best:.4f}); "
+            "running unsharded vectorized twin..."
+        )
+        watchdog.update(value=round(ours_rate, 3))
+        watchdog.phase("baseline:mlp_unsharded")
+        base_rate, base_best, _util = run_ours_mlp_vectorized(
+            n_warm, n_timed, batch_size=256
+        )
+        base = (base_rate, base_best)
+        provenance = "live-ours-unsharded-vectorized-path"
+        metric = f"sharded_mlp256_trials_per_sec_mesh{mesh_note}"
+    elif args.loop == "scan":
         if args.config != "gp":
             parser.error("--loop=scan is only defined for --config gp")
         # Acceptance geometry (ISSUE 11): scan-mode steady-state trials/s

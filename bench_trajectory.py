@@ -167,6 +167,8 @@ def append_entry(
         entry["compile"] = result["compile"]
     if result.get("device_stats"):
         entry["device_stats"] = result["device_stats"]
+    if result.get("mesh"):
+        entry["mesh"] = result["mesh"]
     if result.get("steady_state_trials_per_sec") is not None:
         entry["steady_state_trials_per_sec"] = result["steady_state_trials_per_sec"]
     provenance = git_provenance()

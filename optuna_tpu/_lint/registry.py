@@ -135,6 +135,7 @@ TELEMETRY_PHASE_REGISTRY: dict[str, str] = {
     "storage.op": "one logical storage operation (retries + backoff included)",
     "scan.chunk": "one HBM-resident scan-chunk dispatch (host side; the device run overlaps the previous chunk's sync)",
     "scan.sync": "chunk-boundary result wait + storage sync of a scan chunk's trials",
+    "shard.exchange": "one pod-wide ICI-journal exchange point at a sharded batch boundary",
 }
 
 #: The containment-counter families: canonical mirror of
@@ -207,6 +208,9 @@ DEVICE_STAT_REGISTRY: dict[str, str] = {
     "scan.refactorizations": "scan-loop tells whose pivot check fell back to a full jitter-ladder refactorization",
     "scan.quarantined": "non-finite objective slots quarantined in-graph inside a scan chunk (told FAIL at sync, never ingested)",
     "scan.chunk_fill": "real (ingested) trials the last scan chunk added to the HBM history",
+    "shard.width": "per-shard slot rows of the last sharded dispatch (batch padded to a trials-shard multiple)",
+    "shard.quarantined": "trials quarantined as FAIL across one sharded dispatch, from the in-graph isfinite mask",
+    "shard.contained_groups": "shard groups re-dispatched in isolation after a failed sharded dispatch (per-shard containment)",
 }
 
 #: The hand-maintained copies OBS003 cross-checks, as
@@ -242,6 +246,7 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "jit.retrace_churn": "jit wrappers keep retracing after their first compile (runtime TPU002)",
     "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
     "worker.dead": "a worker's health snapshot went stale past its report interval",
+    "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
 }
 
 #: The hand-maintained copies OBS004 cross-checks, as
@@ -279,6 +284,7 @@ DEVICE_MODULE_PATHS: tuple[str, ...] = (
     "optuna_tpu/samplers/_resilience.py",
     "optuna_tpu/parallel/executor.py",
     "optuna_tpu/parallel/scan_loop.py",
+    "optuna_tpu/parallel/sharded.py",
 )
 
 #: Reviewed host-boundary functions allowed to touch float64 inside device

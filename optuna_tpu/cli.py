@@ -407,9 +407,16 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
 
     def _device(entry: dict[str, Any]) -> str:
         stats = entry.get("device_stats") or {}
-        if not stats:
+        mesh = entry.get("mesh") or {}
+        if not stats and not mesh:
             return ""
         parts = []
+        if mesh:
+            # Sharded-loop entries (bench --loop=sharded) lead with the mesh
+            # geometry the number was captured on.
+            parts.append(
+                "mesh=" + "x".join(str(mesh[axis]) for axis in sorted(mesh, reverse=True))
+            )
         if stats.get("max_ladder_rung") is not None:
             parts.append(f"rung={stats['max_ladder_rung']}")
         if stats.get("fit_iterations") is not None:

@@ -94,6 +94,9 @@ DEVICE_STATS: dict[str, str] = {
     "scan.refactorizations": "scan-loop tells whose pivot check fell back to a full jitter-ladder refactorization",
     "scan.quarantined": "non-finite objective slots quarantined in-graph inside a scan chunk (told FAIL at sync, never ingested)",
     "scan.chunk_fill": "real (ingested) trials the last scan chunk added to the HBM history",
+    "shard.width": "per-shard slot rows of the last sharded dispatch (batch padded to a trials-shard multiple)",
+    "shard.quarantined": "trials quarantined as FAIL across one sharded dispatch, from the in-graph isfinite mask",
+    "shard.contained_groups": "shard groups re-dispatched in isolation after a failed sharded dispatch (per-shard containment)",
 }
 
 #: How each stat aggregates across harvests within one recording window:
@@ -110,6 +113,9 @@ STAT_AGGREGATIONS: dict[str, str] = {
     "scan.refactorizations": "total",
     "scan.quarantined": "total",
     "scan.chunk_fill": "last",
+    "shard.width": "last",
+    "shard.quarantined": "total",
+    "shard.contained_groups": "total",
 }
 
 _GAUGE_PREFIX = "device."

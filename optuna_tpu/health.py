@@ -108,6 +108,7 @@ HEALTH_CHECKS: dict[str, str] = {
     "jit.retrace_churn": "jit wrappers keep retracing after their first compile (runtime TPU002)",
     "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
     "worker.dead": "a worker's health snapshot went stale past its report interval",
+    "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
 }
 
 #: Finding severities, mildest first. CRITICAL findings are additionally
@@ -128,6 +129,7 @@ CHECK_SEVERITIES: dict[str, str] = {
     "jit.retrace_churn": "WARNING",
     "gp.ladder_escalation": "WARNING",
     "worker.dead": "CRITICAL",
+    "shard.imbalance": "WARNING",
 }
 
 #: Study system-attr namespace the reporter publishes under; one attr per
@@ -158,11 +160,14 @@ RETRACE_CHURN_MIN = 3  # retraces-after-first across all jit labels
 LADDER_RUNG_WARN = 3  # device.gp.ladder_rung.max at or above this escalates
 DUPLICATE_RATE = 0.25  # exact-duplicate completed trials per completed trial
 DUPLICATE_MIN = 4
+SHARD_IMBALANCE_FACTOR = 2.0  # a shard this far below the median is lagging
+SHARD_IMBALANCE_MIN_TRIALS = 8  # ...once the BEST shard has done this much
 
-#: Gauge prefixes a worker snapshot carries (bounded: the device-stat and
-#: jit-label vocabularies are small by construction; everything else —
-#: ad-hoc gauges like ``batch_size`` — stays process-local).
-_SNAPSHOT_GAUGE_PREFIXES = ("device.", "jit.", "hbm.")
+#: Gauge prefixes a worker snapshot carries (bounded: the device-stat,
+#: jit-label and mesh-coordinate vocabularies are small by construction;
+#: everything else — ad-hoc gauges like ``batch_size`` — stays
+#: process-local).
+_SNAPSHOT_GAUGE_PREFIXES = ("device.", "jit.", "hbm.", "shard.")
 _PHASE_HISTOGRAM_PREFIX = "phase."
 
 
@@ -444,12 +449,22 @@ def disable() -> None:
     _enabled = False
 
 
-def _reporter_for(study: "Study") -> HealthReporter:
+#: Sentinel marking a study whose reporting is suppressed (see
+#: :func:`suppress`): distinct from "no reporter yet" so the lazy hooks
+#: don't resurrect one.
+_SUPPRESSED = object()
+
+
+def _reporter_for(
+    study: "Study", worker_id: str | None = None
+) -> HealthReporter | None:
     reporter = study.__dict__.get("_health_reporter")
+    if reporter is _SUPPRESSED:
+        return None
     if reporter is None:
         reporter = HealthReporter(
             study,
-            worker_id=_worker_id,
+            worker_id=worker_id if worker_id is not None else _worker_id,
             interval_s=_interval_s,
             clock=_clock,
             now=_now,
@@ -458,16 +473,30 @@ def _reporter_for(study: "Study") -> HealthReporter:
     return reporter
 
 
-def attach(study: "Study") -> None:
+def suppress(study: "Study") -> None:
+    """Mark ``study`` so :func:`maybe_report`/:func:`flush` publish nothing
+    for it even while the reporter is globally enabled. For loops whose
+    storage-write sequence must stay deterministic across hosts — the
+    pod's ICI-journal lockstep run, where a wall-clock rate-limited health
+    publish on one host would desynchronize the pod-wide exchange count.
+    Undo by clearing ``study.__dict__['_health_reporter']`` (the sharded
+    loop restores the previous state itself)."""
+    study.__dict__["_health_reporter"] = _SUPPRESSED
+
+
+def attach(study: "Study", *, worker_id: str | None = None) -> None:
     """Attach a reporter to ``study`` now (no publish yet): called at every
     optimize loop's entry so the delta baseline is captured *before* the
     run records anything — counters a previous study left in the
     process-global registry must not leak into this study's snapshots. A
     no-op while disabled; idempotent (an existing reporter keeps its
-    baseline)."""
+    baseline and its id). ``worker_id`` overrides the default
+    ``<host>-<pid>`` identity for loops whose worker has a richer address —
+    the sharded loop passes ``<host>-<pid>-t<i>m<j>`` so the fleet table
+    maps onto mesh coordinates."""
     if not _enabled:
         return
-    _reporter_for(study)
+    _reporter_for(study, worker_id=worker_id)
 
 
 def maybe_report(study: "Study") -> None:
@@ -476,7 +505,8 @@ def maybe_report(study: "Study") -> None:
     no-op (one module-global check, zero allocations) while disabled."""
     if not _enabled:
         return
-    if _reporter_for(study).maybe_publish():
+    reporter = _reporter_for(study)
+    if reporter is not None and reporter.maybe_publish():
         _warn_critical_findings(study)
 
 
@@ -487,7 +517,9 @@ def flush(study: "Study") -> None:
     best-effort like every reporter write."""
     if not _enabled:
         return
-    _reporter_for(study).publish(final=True)
+    reporter = _reporter_for(study)
+    if reporter is not None:
+        reporter.publish(final=True)
 
 
 #: The checks whose findings can be CRITICAL (derived from the severity
@@ -889,6 +921,58 @@ def _check_worker_dead(
     )
 
 
+def _check_shard_imbalance(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    """The sharded executor publishes per-shard throughput as
+    ``shard.trials.t<k>.total`` gauges (one per trials-axis coordinate);
+    a shard whose evaluated-trial count sits a factor below the mesh median
+    is dragging the whole lockstep batch loop — SPMD waits for its slowest
+    shard, so one cold chip taxes every trial."""
+    prefix, suffix = "shard.trials.", ".total"
+    counts: dict[str, float] = {}
+    for name, value in fleet["gauges"].items():
+        if name.startswith(prefix) and name.endswith(suffix):
+            counts[name[len(prefix) : -len(suffix)]] = float(value)
+    if len(counts) < 2:
+        return None
+    import statistics
+
+    # Evidence floor on the BEST shard, not the median: with a majority of
+    # shards dead (the worst imbalance case) the median itself is ~0, and
+    # a median-gated check would go silent exactly when it matters most.
+    if max(counts.values()) < SHARD_IMBALANCE_MIN_TRIALS:
+        return None  # too little evidence: startup skew is not imbalance
+    median = statistics.median(counts.values())
+    lagging = {
+        coord: count
+        for coord, count in counts.items()
+        if count * SHARD_IMBALANCE_FACTOR <= median
+    }
+    if not lagging:
+        return None
+    return HealthFinding(
+        check="shard.imbalance",
+        severity=CHECK_SEVERITIES["shard.imbalance"],
+        summary=(
+            f"{len(lagging)} of {len(counts)} trial shards at >= "
+            f"{SHARD_IMBALANCE_FACTOR:g}x below the mesh median throughput "
+            f"({median:g} trials): {', '.join(sorted(lagging))}"
+        ),
+        evidence={
+            "shard_trials": {k: counts[k] for k in sorted(counts)},
+            "median": median,
+            "lagging_shards": sorted(lagging),
+        },
+        remediation=(
+            "SPMD runs at the slowest shard's pace: check the lagging "
+            "coordinate's host/chip (thermal throttling, a contended "
+            "tunnel), and whether its slots absorb the quarantines "
+            "(fail_reason attrs say which trials they were)"
+        ),
+    )
+
+
 #: The rule table: one function per check id, keyed exactly by
 #: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py`` — a check in
 #: the vocabulary without a rule, or vice versa, is a test failure).
@@ -901,6 +985,7 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "jit.retrace_churn": _check_retrace_churn,
     "gp.ladder_escalation": _check_ladder_escalation,
     "worker.dead": _check_worker_dead,
+    "shard.imbalance": _check_shard_imbalance,
 }
 
 _SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}

@@ -18,7 +18,11 @@ This package adds the TPU-native tier on top:
   preallocated power-of-two device buckets, the whole ask -> evaluate ->
   tell cycle as one ``lax.scan`` program per chunk with O(n^2) incremental
   Cholesky tells, storage synced in chunks that overlap the next chunk's
-  device execution.
+  device execution;
+* :mod:`sharded` — pod-scale execution on a 2-D ``{'trials', 'model'}``
+  mesh: the trial batch data-parallel over ``trials``, the user model
+  tensor-parallel over ``model`` via regex partition rules, per-shard
+  containment, and lockstep pod trial sync over the ICI journal.
 """
 
 from optuna_tpu.parallel.executor import (
@@ -29,6 +33,16 @@ from optuna_tpu.parallel.executor import (
 )
 from optuna_tpu.parallel.ici_journal import IciJournalBackend
 from optuna_tpu.parallel.scan_loop import optimize_scan
+from optuna_tpu.parallel.sharded import (
+    PodFollowerStorage,
+    ShardedBatchExecutor,
+    ShardedObjective,
+    build_study_mesh,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    mesh_worker_id,
+    optimize_sharded,
+)
 from optuna_tpu.parallel.vectorized import VectorizedObjective, optimize_vectorized
 
 __all__ = [
@@ -36,8 +50,16 @@ __all__ = [
     "IciJournalBackend",
     "NON_FINITE_POLICIES",
     "NonFiniteObjectiveError",
+    "PodFollowerStorage",
     "ResilientBatchExecutor",
+    "ShardedBatchExecutor",
+    "ShardedObjective",
     "VectorizedObjective",
+    "build_study_mesh",
+    "make_shard_and_gather_fns",
+    "match_partition_rules",
+    "mesh_worker_id",
     "optimize_scan",
+    "optimize_sharded",
     "optimize_vectorized",
 ]

@@ -153,11 +153,14 @@ def build_non_finite_guard(fn: Callable, *, clip: bool) -> Callable:
     are additionally passed through ``jnp.nan_to_num`` on device (NaN→0,
     ±Inf→finite extremes) while ``finite`` still reports the *raw* mask so
     callers can log what was clipped.
+
+    Extra positional arguments (the sharded loop's model pytree) pass
+    through to ``fn`` untouched.
     """
     import jax.numpy as jnp
 
-    def _guard(params):
-        values = fn(params)
+    def _guard(params, *extra):
+        values = fn(params, *extra)
         finite = jnp.isfinite(values)
         if finite.ndim > 1:
             finite = finite.all(axis=-1)
@@ -712,7 +715,7 @@ class ResilientBatchExecutor:
                 f"dispatch of {b} trials raised {err!r}; bisecting to isolate "
                 "the poison trial(s)."
             )
-            self._run_halves(trials, b // 2)
+            self._run_splits(self._split_for_bisection(trials))
             return
         self._fail_trials(trials, f"batch dispatch raised: {err!r}")
         if self._bisect:
@@ -733,16 +736,32 @@ class ResilientBatchExecutor:
             return
         raise err
 
+    def _split_for_bisection(self, trials: list[Trial]) -> list[list[Trial]]:
+        """How a failed (non-OOM) dispatch is split for containment. The
+        base policy is binary bisection; the sharded executor overrides this
+        to split along shard-group boundaries first, so a poison trial FAILs
+        its shard's slots while every other shard's trials are salvaged in
+        one re-dispatch each instead of O(log B) blind halvings."""
+        mid = len(trials) // 2
+        return [trials[:mid], trials[mid:]]
+
     def _run_halves(self, trials: list[Trial], mid: int) -> None:
-        """Recurse into both halves of a failed dispatch, guaranteeing the
-        second half is contained even when the first half's containment
+        """The OOM-halving split: fixed midpoint (the width is the fault,
+        not any particular trial)."""
+        self._run_splits([trials[:mid], trials[mid:]])
+
+    def _run_splits(self, groups: list[list[Trial]]) -> None:
+        """Recurse into every group of a failed dispatch, guaranteeing the
+        later groups are contained even when an earlier group's containment
         re-raises (an unshrinkable OOM, a ``non_finite='raise'`` quarantine):
         every trial must hold a terminal state before any error escapes."""
         errors: list[Exception] = []
-        for half in (trials[:mid], trials[mid:]):
+        for group in groups:
+            if not group:
+                continue
             try:
-                self._run_batch(half)
-            except Exception as err:  # graphlint: ignore[PY001] -- deferred re-raise: the first half's error must not strand the second half RUNNING; the earliest error re-raises below once both halves hold terminal states
+                self._run_batch(group)
+            except Exception as err:  # graphlint: ignore[PY001] -- deferred re-raise: an early group's error must not strand the later groups RUNNING; the earliest error re-raises below once every group holds terminal states
                 errors.append(err)
         if errors:
             raise errors[0]

@@ -13,6 +13,14 @@ points in lockstep, which is exactly the execution model of
 :func:`optuna_tpu.parallel.vectorized.optimize_vectorized`-style batch loops.
 Single-host it degrades to a plain in-memory journal whose exchange is a
 no-op gather, so the same study code runs from laptop to pod.
+
+:mod:`optuna_tpu.parallel.sharded` makes the lockstep contract executable
+pod-wide: process 0 leads the appends (each ``append_logs`` = one
+collective), every other host's writes are mirrored as paced empty
+``exchange()`` calls by ``PodFollowerStorage``, and one barrier exchange
+closes each sharded batch (the ``shard.exchange`` telemetry phase) —
+see ARCHITECTURE.md "Pod-scale execution" for the exchange-point
+semantics.
 """
 
 from __future__ import annotations

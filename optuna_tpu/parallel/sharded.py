@@ -1,0 +1,703 @@
+"""Pod-scale sharded study execution: trials x model on one 2-D mesh.
+
+``optimize_vectorized`` shards the trial batch over a 1-D mesh;
+``optimize_scan`` makes one chip's inner loop fast. This module is the scale
+axis joining them (ROADMAP item 1): a first-class API for the MULTICHIP
+dry-run's layout — a 2-D :class:`jax.sharding.Mesh` whose ``trials`` axis
+carries the batch (data parallelism over trials) and whose ``model`` axis
+carries the user's model pytree (tensor parallelism inside each trial), so
+a v5e-64 pod runs ``trials x model`` = 64 chips of work per dispatch.
+
+* **Partition rules** (:func:`match_partition_rules` /
+  :func:`make_shard_and_gather_fns`): the user's model pytree gets its
+  :class:`~jax.sharding.PartitionSpec` per leaf by first-match regex over
+  ``/``-joined leaf names — scalars replicate automatically, and an
+  unmatched non-scalar leaf is a loud error, never a silent replication
+  that OOMs one chip at pod scale.
+* **Per-shard containment** (:class:`ShardedBatchExecutor`): every
+  containment layer of the
+  :class:`~optuna_tpu.parallel.executor.ResilientBatchExecutor` operates at
+  shard granularity. The in-graph isfinite mask already quarantines per
+  slot; a *crashing* dispatch is split along shard-group boundaries first
+  (the slots each ``trials``-shard owned), so a poison trial FAILs its
+  shard's slots while every other shard's trials are salvaged in one
+  re-dispatch each — SPMD cannot dispatch to a mesh subset, but it can
+  re-dispatch one shard's trials over the whole mesh. OOM halving floors
+  at one row per trial shard; heartbeat reap and retry-clone re-enqueue
+  are inherited unchanged.
+* **Pod trial sync over ICI** (:class:`PodFollowerStorage`): a study backed
+  by ``JournalStorage(IciJournalBackend())`` syncs trials through the
+  allgather exchange instead of an RDB. The lockstep contract the backend
+  documents is made executable: process 0 is the *leader* (its storage
+  writes each ride one exchange); every other process runs the same loop
+  with its writes mirrored — each write call paces one (empty) exchange
+  and derives its result from the leader's op in the merged journal — and
+  one barrier exchange closes every batch (the ``shard.exchange`` phase).
+  Single-host this degrades to no-op gathers, so the same study code runs
+  from laptop to pod.
+* **Observability**: ``shard.width`` / ``shard.quarantined`` /
+  ``shard.contained_groups`` device stats (registry-synced, OBS003),
+  per-shard throughput gauges ``shard.trials.t<k>.total`` feeding the
+  doctor's ``shard.imbalance`` check (OBS004), and shard-aware health
+  worker ids ``<host>-<pid>-t<i>m<j>`` so the doctor's fleet table maps
+  onto mesh coordinates.
+
+Degenerate contract: a single-host ``{'trials': n_devices, 'model': 1}``
+mesh runs trial-for-trial identically to ``optimize_vectorized`` on the
+same seeded study (tested in ``tests/test_sharded.py``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+from optuna_tpu import _tracing, device_stats, flight, health, telemetry
+from optuna_tpu.logging import get_logger
+from optuna_tpu.parallel.executor import ResilientBatchExecutor, build_non_finite_guard
+from optuna_tpu.parallel.ici_journal import IciJournalBackend
+from optuna_tpu.parallel.vectorized import VectorizedObjective
+from optuna_tpu.storages._base import BaseStorage, _ForwardingStorage
+from optuna_tpu.storages.journal._storage import JournalStorage
+from optuna_tpu.trial._state import TrialState
+
+if TYPE_CHECKING:
+    import jax
+
+    from optuna_tpu.distributions import BaseDistribution
+    from optuna_tpu.storages._retry import RetryPolicy
+    from optuna_tpu.study.study import Study
+    from optuna_tpu.trial._trial import Trial
+
+_logger = get_logger(__name__)
+
+_TRACE_EXCHANGE = telemetry.trace_name("shard.exchange")
+
+#: The two mesh axes the sharded study loop understands: ``trials`` carries
+#: the batch, ``model`` carries whatever tensor parallelism the user's
+#: partition rules express.
+MESH_AXES: tuple[str, str] = ("trials", "model")
+
+
+# ------------------------------------------------------------ partition rules
+
+
+def _leaf_name(path: tuple) -> str:
+    """``/``-joined human name for a pytree leaf path (dict keys, attr
+    names, sequence indices), the namespace the regex rules match over."""
+    parts: list[str] = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            value = getattr(entry, attr, None)
+            if value is not None:
+                parts.append(str(value))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def match_partition_rules(
+    rules: Sequence[tuple[str, Any]], tree: Any
+) -> Any:
+    """A pytree of :class:`~jax.sharding.PartitionSpec` for ``tree``: each
+    leaf takes the spec of the first ``(regex, spec)`` rule whose pattern
+    ``re.search``-matches its ``/``-joined name. Scalar leaves (0-d or
+    single-element) replicate without consulting the rules, and a
+    non-scalar leaf no rule matches raises — at pod scale a silently
+    replicated tensor is an OOM on every chip, so "no rule" must be loud.
+    """
+    import jax
+
+    compiled = [(re.compile(pattern), spec) for pattern, spec in rules]
+
+    def spec_for(path: tuple, leaf: Any):
+        from jax.sharding import PartitionSpec
+
+        name = _leaf_name(path)
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return PartitionSpec()  # scalars replicate
+        for pattern, spec in compiled:
+            if pattern.search(name) is not None:
+                return spec
+        raise ValueError(
+            f"no partition rule matched model leaf {name!r} (shape {shape}); "
+            "add a rule (regex, PartitionSpec) covering it — every non-scalar "
+            "model leaf must state its sharding explicitly."
+        )
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def make_shard_and_gather_fns(
+    mesh: "jax.sharding.Mesh", partition_specs: Any
+) -> tuple[Any, Any]:
+    """Pytrees of per-leaf shard / gather callables from a pytree of
+    partition specs: ``shard_fn(leaf)`` device-puts the leaf with its
+    :class:`~jax.sharding.NamedSharding` over ``mesh``; ``gather_fn(leaf)``
+    pulls the leaf back to one full host array. On a multi-process mesh a
+    sharded leaf spans non-addressable devices, so the gather reshards it
+    to replicated first — a **collective**: every host must call the
+    gather fns together, the same lockstep discipline as every other pod
+    collective here."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    is_spec = lambda x: isinstance(x, PartitionSpec)  # noqa: E731
+
+    def make_shard_fn(spec):
+        sharding = NamedSharding(mesh, spec)
+        return lambda leaf: jax.device_put(leaf, sharding)
+
+    def make_gather_fn(spec):
+        def gather(leaf):
+            if getattr(leaf, "is_fully_addressable", True):
+                return np.asarray(jax.device_get(leaf))
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.global_array_to_host_local_array(
+                    leaf, mesh, PartitionSpec()
+                )
+            )
+
+        return gather
+
+    shard_fns = jax.tree_util.tree_map(make_shard_fn, partition_specs, is_leaf=is_spec)
+    gather_fns = jax.tree_util.tree_map(make_gather_fn, partition_specs, is_leaf=is_spec)
+    return shard_fns, gather_fns
+
+
+def build_study_mesh(
+    mesh_shape: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[Any] | None = None,
+) -> "jax.sharding.Mesh":
+    """The study's 2-D ``(trials, model)`` mesh. ``mesh_shape`` maps axis
+    name to size (missing axes default to 1; ``None`` means every available
+    device on the ``trials`` axis); the first ``trials x model`` devices
+    are used, and asking for more than exist is an error, not a wrap."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if mesh_shape is None:
+        mesh_shape = {"trials": len(devices), "model": 1}
+    unknown = set(mesh_shape) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; the sharded study loop "
+            f"understands exactly {MESH_AXES}."
+        )
+    n_trials_axis = int(mesh_shape.get("trials", 1))
+    n_model_axis = int(mesh_shape.get("model", 1))
+    if n_trials_axis < 1 or n_model_axis < 1:
+        raise ValueError(f"mesh axis sizes must be >= 1; got {dict(mesh_shape)}.")
+    need = n_trials_axis * n_model_axis
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {{'trials': {n_trials_axis}, 'model': {n_model_axis}}} needs "
+            f"{need} devices; only {len(devices)} available."
+        )
+    grid = np.array(devices[:need], dtype=object).reshape(n_trials_axis, n_model_axis)
+    return Mesh(grid, axis_names=MESH_AXES)
+
+
+def mesh_worker_id(mesh: "jax.sharding.Mesh") -> str:
+    """``<host>-<pid>-t<i>m<j>``: the default health worker id extended with
+    this process's mesh coordinates (its first addressable device's position
+    along the ``trials``/``model`` axes), so the doctor's fleet table — and a
+    ``worker.dead`` finding after a host dies — maps onto the mesh."""
+    import jax
+
+    from optuna_tpu.health import default_worker_id
+
+    process = jax.process_index()
+    local = [
+        d for d in mesh.devices.flat if getattr(d, "process_index", 0) == process
+    ]
+    anchor = local[0] if local else mesh.devices.flat[0]
+    position = np.argwhere(mesh.devices == anchor)
+    coords = [int(x) for x in position[0]] if len(position) else [0] * mesh.devices.ndim
+    suffix = "".join(
+        f"{axis[0]}{coords[k]}" for k, axis in enumerate(mesh.axis_names)
+    )
+    return f"{default_worker_id()}-{suffix}"
+
+
+# ----------------------------------------------------------- sharded objective
+
+
+class ShardedObjective(VectorizedObjective):
+    """A batched objective that additionally takes a model pytree sharded
+    over the mesh's ``model`` axis.
+
+    ``fn`` maps ``({name: (B,) array}, model)`` to values of shape ``(B,)``
+    (or ``(B, n_objectives)``); ``model`` is any pytree and
+    ``partition_rules`` is a sequence of ``(regex, PartitionSpec)`` pairs
+    resolved per leaf by :func:`match_partition_rules` (scalars replicate,
+    unmatched leaves raise). The model is device-put once per mesh and
+    passed to the jitted program as an argument — sharded where the rules
+    say, never baked into the executable as a constant.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[dict[str, Any], Any], Any],
+        search_space: "dict[str, BaseDistribution]",
+        *,
+        model: Any,
+        partition_rules: Sequence[tuple[str, Any]] = (),
+    ) -> None:
+        super().__init__(fn, search_space)
+        self.model = model
+        self.partition_rules = tuple(partition_rules)
+
+    def sharded_model(self, mesh: "jax.sharding.Mesh") -> tuple[Any, Any]:
+        """``(device model, partition specs)`` for ``mesh`` — placed once
+        and cached beside the compiled programs, so repeated optimize calls
+        never re-transfer the model."""
+        import jax
+        from jax.sharding import PartitionSpec
+
+        key = ("sharded_model", mesh)
+        cached = self._compiled_cache.get(key)
+        if cached is None:
+            specs = match_partition_rules(self.partition_rules, self.model)
+            shard_fns, _ = make_shard_and_gather_fns(mesh, specs)
+            placed = jax.tree_util.tree_map(
+                lambda shard_fn, leaf: shard_fn(leaf), shard_fns, self.model
+            )
+            cached = (placed, specs)
+            self._compiled_cache[key] = cached
+        return cached
+
+    def gathered_model(self, mesh: "jax.sharding.Mesh") -> Any:
+        """The device model pulled back to host arrays (the
+        ``make_shard_and_gather_fns`` round trip), for checkpoint/debug."""
+        import jax
+
+        placed, specs = self.sharded_model(mesh)
+        _, gather_fns = make_shard_and_gather_fns(mesh, specs)
+        return jax.tree_util.tree_map(
+            lambda gather_fn, leaf: gather_fn(leaf), gather_fns, placed
+        )
+
+    def guarded(self, mesh, batch_axis: str = "trials", non_finite: str = "fail"):
+        """The executor-facing wrapper: ``(values, finite_mask)`` with the
+        mask in-graph, the batch sharded along ``batch_axis`` and the model
+        along its rules. Memoized per (mesh, axis, policy) like the base
+        class; the returned callable binds the device-resident model so the
+        executor's ``guarded(args)`` contract is unchanged."""
+        if mesh is None:
+            raise ValueError(
+                "ShardedObjective needs a mesh: the model's partition rules "
+                "have no meaning without one (use VectorizedObjective for "
+                "mesh-less batching)."
+            )
+        clip = non_finite == "clip"
+        key = (mesh, batch_axis, "sharded_guarded", clip)
+        cached = self._compiled_cache.get(key)
+        if cached is not None:
+            return cached
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        model, specs = self.sharded_model(mesh)
+        batch_shard = NamedSharding(mesh, PartitionSpec(batch_axis))
+        model_shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        guard = build_non_finite_guard(self.fn, clip=clip)
+        compiled = jax.jit(  # graphlint: ignore[TPU002] -- memoized above: one wrapper per cache key for this objective's lifetime, not per call
+            guard,
+            in_shardings=(
+                {name: batch_shard for name in self.search_space},
+                model_shardings,
+            ),
+            out_shardings=(batch_shard, batch_shard),
+        )
+        compiled = flight.instrument_jit(compiled, "sharded.guarded")
+
+        def bound(args: dict) -> Any:
+            return compiled(args, model)
+
+        self._compiled_cache[key] = bound
+        return bound
+
+
+# ------------------------------------------------------------- pod trial sync
+
+
+def _ici_journal_storage(storage: "BaseStorage") -> JournalStorage | None:
+    """The :class:`JournalStorage`-over-:class:`IciJournalBackend` behind
+    ``storage`` (unwrapping forwarding decorators like ``RetryingStorage``),
+    or None when the study is not ICI-journal-backed."""
+    seen = 0
+    while isinstance(storage, _ForwardingStorage) and seen < 8:
+        storage = storage._backend
+        seen += 1
+    if isinstance(storage, JournalStorage) and isinstance(
+        storage._backend, IciJournalBackend
+    ):
+        return storage
+    return None
+
+
+def _ici_backend(storage: "BaseStorage") -> IciJournalBackend | None:
+    journal = _ici_journal_storage(storage)
+    return None if journal is None else journal._backend
+
+
+#: The storage writes :class:`PodFollowerStorage` mirrors — exactly the
+#: journal's op surface: each is one leader-side ``append_logs`` and
+#: therefore one collective the follower must pace.
+_POD_WRITE_METHODS: frozenset[str] = frozenset(
+    {
+        "create_new_study",
+        "delete_study",
+        "set_study_user_attr",
+        "set_study_system_attr",
+        "create_new_trial",
+        "create_new_trials",
+        "set_trial_param",
+        "set_trial_state_values",
+        "set_trial_intermediate_value",
+        "set_trial_user_attr",
+        "set_trial_system_attr",
+    }
+)
+
+
+class PodFollowerStorage(_ForwardingStorage):
+    """The non-leader face of the pod's lockstep trial sync.
+
+    On a pod, every host runs the same ``optimize_sharded`` loop (XLA
+    collectives require it), but only process 0 — the *leader* — may append
+    journal ops: a create replayed once per host would mint one trial per
+    host. This wrapper makes the follower's loop collective-count-identical
+    to the leader's without double-writing: every write call pops one
+    (empty) ``exchange()`` — pacing the collective the leader's
+    ``append_logs`` runs — then derives its return value from the leader's
+    op, now in the merged journal (the create's trial ids are the journal's
+    newest; a claim CAS reads the claimed trial's post-merge state). Reads
+    pass through to the merged replay state, identical on every host.
+
+    The contract this rests on (and the reason it needs no consensus): the
+    follower runs the *same deterministic loop* as the leader — same seeded
+    sampler over the same merged history, same batch shapes — so its k-th
+    write call corresponds to the leader's k-th append. Host-asymmetric
+    faults (a crash or extra diagnostic write on one host only) break that
+    correspondence and surface as a collective mismatch/timeout, never as
+    silent divergence; nondeterministic writers (the wall-clock-rate-limited
+    health reporter) are therefore suppressed for pod runs by
+    :func:`optimize_sharded`. Tested in lockstep threads over the
+    FakePodBus and in the real 2-process allgather smoke
+    (``tests/test_ici_multihost.py``).
+    """
+
+    def __init__(self, storage: "BaseStorage") -> None:
+        # Accept exactly what _PodSync.detect accepts: the journal may sit
+        # under forwarding decorators (RetryingStorage, fault injectors) —
+        # reads keep flowing through the full original chain, while the
+        # mirror targets the unwrapped journal's replay state directly.
+        journal = _ici_journal_storage(storage)
+        if journal is None:
+            raise ValueError(
+                "PodFollowerStorage wraps a (possibly decorated) "
+                "JournalStorage over an IciJournalBackend; got "
+                f"{type(storage).__name__}."
+            )
+        super().__init__(storage)
+        self._journal = journal
+        self._ici = journal._backend
+
+    def _forward(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        if method not in _POD_WRITE_METHODS:
+            return super()._forward(method, *args, **kwargs)
+        if method == "create_new_trials":
+            n = kwargs.get("n", args[1] if len(args) > 1 else 0)
+            if n <= 0:
+                # The leader's zero-width create early-returns without an
+                # append — there is no collective to pace, and an unpaired
+                # exchange here would leave this host one round ahead.
+                return []
+        # One collective per mirrored write: the leader's append lands in
+        # the merged journal during this exchange.
+        self._ici.exchange()
+        with self._journal._thread_lock:
+            self._journal._sync()
+            return self._derive(method, args, kwargs)
+
+    def _derive(self, method: str, args: tuple, kwargs: dict) -> Any:
+        replay = self._journal._replay
+        if method == "create_new_study":
+            return replay.next_study_id - 1
+        if method == "create_new_trial":
+            return replay.next_trial_id - 1
+        if method == "create_new_trials":
+            n = kwargs.get("n", args[1] if len(args) > 1 else 0)
+            return list(range(replay.next_trial_id - n, replay.next_trial_id))
+        if method == "set_trial_state_values":
+            state = kwargs.get("state", args[1] if len(args) > 1 else None)
+            if state == TrialState.RUNNING:
+                # Claim CAS: under the single-writer contract the leader's
+                # claim is the only contender, so the merged state says
+                # whether it won.
+                trial = replay._trial(args[0])
+                return trial is not None and trial.state == TrialState.RUNNING
+            return True
+        return None
+
+
+class _PodSync:
+    """Batch-boundary exchange points for an ICI-journal study: one barrier
+    collective closes every batch, so lockstep hosts align per batch (the
+    documented exchange-point semantics) and the journal's round counter
+    advances together pod-wide."""
+
+    def __init__(self, backend: IciJournalBackend) -> None:
+        self._backend = backend
+
+    @staticmethod
+    def detect(study: "Study") -> "_PodSync | None":
+        backend = _ici_backend(study._storage)
+        return None if backend is None else _PodSync(backend)
+
+    def barrier(self) -> None:
+        with _tracing.annotate(_TRACE_EXCHANGE), telemetry.span("shard.exchange"), \
+                flight.span("shard.exchange"):
+            self._backend.exchange()
+
+
+# ------------------------------------------------------------------- executor
+
+
+class ShardedBatchExecutor(ResilientBatchExecutor):
+    """The :class:`ResilientBatchExecutor` with shard-granular containment
+    and pod exchange points.
+
+    Differences from the base class, each scoped so the degenerate
+    ``{'trials': n, 'model': 1}`` mesh stays trial-for-trial identical to
+    ``optimize_vectorized``:
+
+    * padding and the OOM-halving floor follow the **trials-axis shard
+      count** (the batch dim is sharded over ``trials`` only; one row per
+      shard is the minimum SPMD-valid width), not the raw device count;
+    * a failed dispatch splits along **shard-group boundaries** first
+      (see :meth:`_split_for_bisection`) — binary bisection takes over only
+      inside a single shard's slots;
+    * per-dispatch ``shard.*`` device stats and per-shard throughput gauges
+      (``shard.trials.t<k>.total``) feed the doctor's ``shard.imbalance``
+      check;
+    * with a :class:`_PodSync` attached, one barrier exchange closes every
+      batch.
+    """
+
+    def __init__(
+        self,
+        study: "Study",
+        objective: "VectorizedObjective",
+        *,
+        mesh: "jax.sharding.Mesh",
+        batch_axis: str = "trials",
+        pod: _PodSync | None = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(study, objective, mesh=mesh, batch_axis=batch_axis, **kwargs)
+        self._n_shards = int(mesh.shape[batch_axis])
+        # The base class floors/pads to the full device count; the batch dim
+        # is sharded over `trials` only, so the SPMD-valid unit is one row
+        # per trial shard.
+        self._n_dev = self._n_shards
+        self._pod = pod
+        # slot ownership of the current top-level batch: trial_id -> shard
+        # index, so bisected/halved re-dispatches still attribute their
+        # throughput and quarantines to the right shard.
+        self._shard_of: dict[int, int] = {}
+
+    # ------------------------------------------------------------- sharding
+
+    def _rows_per_shard(self, b: int) -> int:
+        """Slot rows each trials-shard owns for a ``b``-wide batch (after
+        the SPMD padding ``_eval`` applies)."""
+        return max(1, -(-b // self._n_shards))
+
+    def _shard_groups(self, trials: Sequence["Trial"]) -> list[list["Trial"]]:
+        """The batch partitioned into the slot groups each trials-shard
+        owns: contiguous rows, matching ``NamedSharding(P('trials'))``'s
+        row layout."""
+        rows = self._rows_per_shard(len(trials))
+        return [
+            list(trials[k * rows : (k + 1) * rows])
+            for k in range(self._n_shards)
+            if trials[k * rows : (k + 1) * rows]
+        ]
+
+    def _split_for_bisection(self, trials: list["Trial"]) -> list[list["Trial"]]:
+        groups = self._shard_groups(trials)
+        if len(groups) > 1:
+            # Per-shard containment: the poison trial FAILs inside its own
+            # shard group's re-dispatch; every other shard's slots are
+            # salvaged whole.
+            if device_stats.enabled():
+                device_stats.harvest({"shard.contained_groups": len(groups)})
+            _logger.warning(
+                f"splitting the failed dispatch along its {len(groups)} "
+                "shard groups (per-shard containment)."
+            )
+            return groups
+        return super()._split_for_bisection(trials)
+
+    # ---------------------------------------------------------------- phases
+
+    def _suggest_and_run(self, trials, proposals, ask_seconds: float) -> None:
+        # Fresh slot ownership per top-level batch: the dict stays bounded
+        # by one batch and sub-dispatch attribution can't leak across
+        # batches.
+        rows = self._rows_per_shard(len(trials))
+        self._shard_of = {
+            trial._trial_id: i // rows for i, trial in enumerate(trials)
+        }
+        super()._suggest_and_run(trials, proposals, ask_seconds)
+
+    def _eval(self, trials):
+        values, finite = super()._eval(trials)
+        b = len(trials)
+        # Under 'clip' nothing is quarantined — every trial COMPLETEs with
+        # nan_to_num values — so the stat must stay 0 to agree with the
+        # trials' terminal states (the base executor.quarantined contract).
+        clip = self._non_finite == "clip"
+        if device_stats.enabled():
+            device_stats.harvest(
+                {
+                    "shard.width": self._rows_per_shard(b),
+                    "shard.quarantined": (
+                        0 if clip else int(b - np.count_nonzero(finite[:b]))
+                    ),
+                }
+            )
+        if telemetry.enabled():
+            # Seed every shard that owned slots in this dispatch with 0, so
+            # a shard whose slots are ALL quarantined still registers its
+            # throughput gauge — a 0-throughput shard is exactly what the
+            # doctor's shard.imbalance check must be able to see.
+            per_shard: dict[int, int] = {
+                self._shard_of.get(t._trial_id, 0): 0 for t in trials
+            }
+            for i, trial in enumerate(trials):
+                if clip or bool(finite[i]):
+                    shard = self._shard_of.get(trial._trial_id, 0)
+                    per_shard[shard] += 1
+            for shard, n_ok in per_shard.items():
+                telemetry.add_gauge(f"shard.trials.t{shard}.total", float(n_ok))
+        return values, finite
+
+    def _run_one_batch(self, remaining: int) -> int:
+        advanced = super()._run_one_batch(remaining)
+        if self._pod is not None:
+            # The documented exchange point: one pod-wide collective closes
+            # every batch, aligning lockstep hosts and flushing the round.
+            self._pod.barrier()
+        return advanced
+
+
+# ------------------------------------------------------------------ front door
+
+
+def optimize_sharded(
+    study: "Study",
+    objective: "VectorizedObjective",
+    n_trials: int,
+    *,
+    mesh: "jax.sharding.Mesh | None" = None,
+    mesh_shape: Mapping[str, int] | None = None,
+    batch_size: int | None = None,
+    batch_axis: str = "trials",
+    callbacks: Sequence[Callable] | None = None,
+    non_finite: str = "fail",
+    fallback: str | None = None,
+    bisect_on_error: bool = True,
+    retry_policy: "RetryPolicy | None" = None,
+    dispatch_deadline_s: float | None = None,
+) -> None:
+    """Run ``n_trials`` across a 2-D ``{'trials', 'model'}`` mesh,
+    fault-tolerantly, with pod-internal trial sync over the ICI journal.
+
+    ``mesh`` (or ``mesh_shape``, handed to :func:`build_study_mesh`) lays
+    out the pod: the packed trial batch is sharded along ``batch_axis`` and
+    a :class:`ShardedObjective`'s model pytree along its partition rules
+    (a plain :class:`~optuna_tpu.parallel.vectorized.VectorizedObjective`
+    simply replicates across the ``model`` axis). Containment knobs
+    (``non_finite``, ``fallback``, ``bisect_on_error``, ``retry_policy``,
+    ``dispatch_deadline_s``) mean exactly what they mean for
+    :func:`~optuna_tpu.parallel.vectorized.optimize_vectorized`, operating
+    at shard granularity (see :class:`ShardedBatchExecutor`).
+
+    On a multi-process pod with an ICI-journal storage, process 0 leads the
+    storage writes and every other process's writes are mirrored through
+    :class:`PodFollowerStorage` for the duration of the run; all hosts
+    reach one barrier exchange per batch. Single-process, both mechanisms
+    degrade to no-ops and the run is trial-for-trial identical to
+    ``optimize_vectorized`` on the same seeded study.
+    """
+    import jax
+
+    if mesh is None:
+        mesh = build_study_mesh(mesh_shape)
+    if batch_axis not in mesh.axis_names:
+        raise ValueError(
+            f"batch_axis {batch_axis!r} is not a mesh axis {mesh.axis_names}."
+        )
+    pod = _PodSync.detect(study)
+    multiprocess_pod = pod is not None and jax.process_count() > 1
+    follower = (
+        multiprocess_pod
+        and jax.process_index() != 0
+        and not isinstance(study._storage, PodFollowerStorage)
+    )
+    original_storage = study._storage
+    prior_reporter = study.__dict__.get("_health_reporter")
+    if follower:
+        study._storage = PodFollowerStorage(original_storage)
+    try:
+        if multiprocess_pod:
+            # Health publishes are wall-clock rate-limited and per-worker:
+            # an extra append on one host would desynchronize the pod-wide
+            # exchange count (every collective must pair). Reporting is
+            # suppressed for the run on every host — the doctor rides
+            # heartbeat-capable storages on multi-process pods.
+            health.suppress(study)
+        else:
+            # Shard-aware worker identity for the doctor's fleet table (a
+            # no-op unless the health reporter is enabled; an
+            # already-attached reporter keeps its id).
+            health.attach(study, worker_id=mesh_worker_id(mesh))
+        ShardedBatchExecutor(
+            study,
+            objective,
+            mesh=mesh,
+            batch_axis=batch_axis,
+            pod=pod,
+            batch_size=batch_size,
+            callbacks=callbacks,
+            non_finite=non_finite,
+            fallback=fallback,
+            bisect_on_error=bisect_on_error,
+            retry_policy=retry_policy,
+            dispatch_deadline_s=dispatch_deadline_s,
+        ).run(n_trials)
+    finally:
+        study._storage = original_storage
+        if multiprocess_pod:
+            # Run-scoped suppression: restore whatever reporter state the
+            # study had before (absent or a live reporter).
+            if prior_reporter is None:
+                study.__dict__.pop("_health_reporter", None)
+            else:
+                study.__dict__["_health_reporter"] = prior_reporter

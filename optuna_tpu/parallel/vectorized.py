@@ -14,7 +14,12 @@ objective uses internally rides the remaining axes.
 
 This module owns the *objective* side (packing, compilation caching); the
 fault-tolerant dispatch loop lives in :mod:`optuna_tpu.parallel.executor`,
-which ``optimize_vectorized`` delegates to.
+which ``optimize_vectorized`` delegates to. The pod-scale tier —
+a 2-D ``{'trials', 'model'}`` mesh with a partition-ruled model pytree,
+per-shard containment and ICI-journal trial sync — is
+:mod:`optuna_tpu.parallel.sharded`; its :class:`~optuna_tpu.parallel.
+sharded.ShardedObjective` extends :class:`VectorizedObjective`, and the
+degenerate 1-D mesh is contract-identical to this loop.
 """
 
 from __future__ import annotations

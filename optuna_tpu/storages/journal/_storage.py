@@ -66,7 +66,16 @@ class _StudyState:
 
 
 class _ReplayResult:
-    """The deterministic state machine every worker replays."""
+    """The deterministic state machine every worker replays.
+
+    Allocation order is part of the replay contract: ``next_study_id`` /
+    ``next_trial_id`` advance monotonically in merged-log order, so any
+    worker that has replayed an op stream can derive what ids a peer's
+    creates were assigned without having issued them. The pod's lockstep
+    follower (:class:`optuna_tpu.parallel.sharded.PodFollowerStorage`)
+    leans on exactly this: it mirrors the leader's writes by syncing the
+    merged journal and reading the newest ids/states off this replay state.
+    """
 
     def __init__(self) -> None:
         self.log_number_read = 0

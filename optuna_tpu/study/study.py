@@ -248,6 +248,26 @@ class Study:
 
         optimize_scan(self, objective, n_trials, **kwargs)
 
+    def optimize_sharded(
+        self,
+        objective: Any,
+        n_trials: int,
+        **kwargs: Any,
+    ) -> None:
+        """Run ``n_trials`` across a 2-D ``{'trials', 'model'}`` mesh (see
+        :func:`optuna_tpu.parallel.sharded.optimize_sharded`): the trial
+        batch shards along the ``trials`` axis, a
+        :class:`~optuna_tpu.parallel.sharded.ShardedObjective`'s model
+        pytree along its regex partition rules on the ``model`` axis, with
+        the ResilientBatchExecutor's containment operating per shard and
+        pod-internal trial sync riding the ICI-journal allgather exchange.
+        The degenerate ``{'trials': n_devices, 'model': 1}`` mesh is
+        trial-for-trial identical to :func:`~optuna_tpu.parallel.
+        vectorized.optimize_vectorized` on the same seeded study."""
+        from optuna_tpu.parallel.sharded import optimize_sharded
+
+        optimize_sharded(self, objective, n_trials, **kwargs)
+
     def ask(self, fixed_distributions: dict[str, BaseDistribution] | None = None) -> Trial:
         """Create a new (or claim a WAITING) trial (reference ``study.py:527``)."""
         if not self._thread_local.in_optimize_loop and is_heartbeat_enabled(self._storage):

@@ -89,6 +89,7 @@ PHASES: dict[str, str] = {
     "storage.op": "one logical storage operation (retries + backoff included)",
     "scan.chunk": "one HBM-resident scan-chunk dispatch (host side; the device run overlaps the previous chunk's sync)",
     "scan.sync": "chunk-boundary result wait + storage sync of a scan chunk's trials",
+    "shard.exchange": "one pod-wide ICI-journal exchange point at a sharded batch boundary",
 }
 
 #: The containment-counter vocabulary: one entry per event family the

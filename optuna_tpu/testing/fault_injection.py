@@ -31,6 +31,12 @@ the faults they claim to absorb. This module provides:
   pins a rank-deficient Gram, scheduled NaN batch slots, and the exact
   stats the in-graph channel must report (:data:`DEVICE_STAT_CHAOS_MATRIX`
   is the matrix, synced by graphlint rule OBS003).
+* Pod chaos (:mod:`optuna_tpu.parallel.sharded` is the layer under test):
+  :class:`FakePodBus` coordinates N in-process ICI-journal backends as
+  lockstep 'hosts' (the multi-host seam without a pod), and
+  :class:`ShardChaosPlan` / :func:`shard_chaos_plan` names the NaN slots on
+  one trials-shard, the killed host's mesh-coordinate worker id, and the
+  exact doctor findings the sharded acceptance test asserts.
 * Study-doctor chaos (:mod:`optuna_tpu.health` is the layer under test):
   :class:`HealthChaosPlan` / :func:`health_chaos_plan` combines NaN batch
   slots, a pathological seeded history, storage blips and a dead worker
@@ -237,6 +243,11 @@ DEVICE_STAT_CHAOS_MATRIX: dict[str, str] = {
     "equals the plan's slot count, each slot told FAIL at sync, the fault-free twin reports 0",
     "scan.chunk_fill": "fault-free scan chunk; the fill equals the chunk length (quarantined "
     "chunks fill short by exactly the quarantined count)",
+    "shard.width": "fault-free sharded batch; the stat equals ceil(B / trials-shards) exactly",
+    "shard.quarantined": "inject NaN at slots owned by one shard; the harvested total equals "
+    "the plan's slot count, the fault-free twin reports 0",
+    "shard.contained_groups": "inject a one-dispatch poison crash into a multi-shard batch; "
+    "per-shard containment re-dispatches every shard group and the count equals the group count",
 }
 
 
@@ -318,6 +329,8 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "the gauge alone flags",
     "worker.dead": "plant a stale worker snapshot (plant_dead_worker — what a SIGKILL'd "
     "worker leaves); liveness derives dead from snapshot age vs interval",
+    "shard.imbalance": "publish shard.trials.<coord> throughput gauges with one shard >= 2x "
+    "below the mesh median; the lagging coordinate is named, the balanced twin stays clean",
 }
 
 
@@ -406,6 +419,138 @@ def plant_dead_worker(
         study._study_id, WORKER_ATTR_PREFIX + worker_id, snapshot
     )
     return snapshot
+
+
+# ------------------------------------------------------------- pod-bus chaos
+
+
+class FakePodBus:
+    """Lockstep allgather across N in-process 'hosts' (threads) — the
+    multi-host seam of :class:`~optuna_tpu.parallel.ici_journal.
+    IciJournalBackend` driven without a pod.
+
+    Gathers rendezvous at a barrier exactly like a pod collective: every
+    worker must reach ``exchange()`` the same number of times or the round
+    times out — the same discipline real XLA collectives impose. Promoted
+    from the multihost test suite into the chaos kit so pod-scale scenarios
+    (``optimize_sharded``'s leader/follower lockstep, a host dying
+    mid-study) are first-class injectable faults, not test-local plumbing.
+    """
+
+    def __init__(self, n_workers: int, buffer_bytes: int = 1 << 16) -> None:
+        from optuna_tpu.parallel.ici_journal import IciJournalBackend
+
+        self.n = n_workers
+        self.workers = [
+            IciJournalBackend(buffer_bytes=buffer_bytes) for _ in range(n_workers)
+        ]
+        self._slots: list["np.ndarray | None"] = [None] * n_workers
+        self._barrier = threading.Barrier(n_workers, timeout=30)
+        for idx, worker in enumerate(self.workers):
+            worker._allgather = self._make_gather(idx)  # type: ignore[method-assign]
+
+    def _make_gather(self, idx: int):
+        def gather(buf: "np.ndarray") -> "np.ndarray":
+            self._slots[idx] = buf
+            self._barrier.wait()  # all buffers staged
+            out = np.stack([s for s in self._slots])  # process_index order
+            self._barrier.wait()  # all workers copied out before reuse
+            return out
+
+        return gather
+
+    def lockstep(self, *fns) -> list:
+        """Run one callable per worker concurrently; re-raise any failure
+        (aborting the barrier so no peer hangs on a dead partner)."""
+        assert len(fns) == self.n
+        results: list = [None] * self.n
+        errors: list = [None] * self.n
+
+        def run(i: int) -> None:
+            try:
+                results[i] = fns[i]()
+            except BaseException as e:  # graphlint: ignore[PY001] -- lockstep trampoline: a worker death (BaseException by design) must abort the barrier so peers fail fast instead of hanging; every error re-raises on the driving thread below
+                errors[i] = e
+                self._barrier.abort()
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(self.n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Prefer the ROOT fault: an abort makes the bystanders fail with
+        # BrokenBarrierError, and re-raising a bystander's symptom would
+        # mask the injected fault under test whenever the failing worker
+        # has a higher index.
+        for e in errors:
+            if e is not None and not isinstance(e, threading.BrokenBarrierError):
+                raise e
+        for e in errors:
+            if e is not None:
+                raise e
+        return results
+
+    def step(self, per_worker_logs: list[list[dict]]) -> None:
+        """One exchange round: every worker appends its ops and reaches the
+        collective together."""
+
+        def work(worker, logs):
+            worker._pending.extend(logs)
+            worker.exchange()
+
+        self.lockstep(*[
+            (lambda w=w, logs=logs: work(w, logs))
+            for w, logs in zip(self.workers, per_worker_logs)
+        ])
+
+
+@dataclass(frozen=True)
+class ShardChaosPlan:
+    """One deterministic pod-scale chaos scenario for ``optimize_sharded``:
+    NaN slots owned by one trials-shard, a worker SIGKILL'd mid-dispatch
+    (its stale health snapshot planted under a mesh-coordinate worker id),
+    and the exact doctor findings + containment outcome the acceptance test
+    asserts (``tests/test_sharded.py``) — the executable form of the
+    FakePodBus row in :data:`HEALTH_CHECK_CHAOS_MATRIX` and the ``shard.*``
+    rows in :data:`DEVICE_STAT_CHAOS_MATRIX`.
+
+    Geometry: a ``{'trials': 4, 'model': 2}`` mesh (the MULTICHIP_r05
+    dry-run shape) with ``batch_size`` = 8 — two slot rows per shard, so
+    ``nan_slots`` (0, 1) both land on shard t0 and the other three shards'
+    slots stay clean.
+    """
+
+    mesh_trials: int = 4
+    mesh_model: int = 2
+    batch_size: int = 8
+    n_trials: int = 24
+    nan_slots: Mapping[int, Sequence[int]] = field(
+        default_factory=lambda: {0: (0, 1)}
+    )
+    # The LAST batch's dispatch: by then every trial of the budget has been
+    # created and suggested, so the survivor's drain (reaped clones + NaN
+    # retries, fixed_params pinned) re-runs the complete fault-free draw
+    # sequence — the acceptance test's exactly-once-per-healthy-trial
+    # equality needs no fresh post-death draws.
+    kill_dispatch: int = 2
+    dead_worker_coord: str = "t0m0"
+    dead_worker_age_s: float = 3600.0
+    expected_findings: tuple[str, ...] = ("worker.dead",)
+
+    @property
+    def expected_quarantined(self) -> int:
+        return sum(len(slots) for slots in self.nan_slots.values())
+
+    @property
+    def dead_worker_id(self) -> str:
+        return f"chaos-deadhost-0-{self.dead_worker_coord}"
+
+
+def shard_chaos_plan() -> ShardChaosPlan:
+    """The default :class:`ShardChaosPlan` the sharded chaos suite runs —
+    two NaN slots on shard t0 of a 4x2 mesh, one killed host at mesh
+    coordinate t0m0."""
+    return ShardChaosPlan()
 
 
 # ----------------------------------------------------- device-dispatch chaos

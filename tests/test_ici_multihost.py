@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 
 import numpy as np
 import pytest
@@ -21,70 +20,7 @@ import pytest
 import optuna_tpu
 from optuna_tpu.parallel import IciJournalBackend
 from optuna_tpu.storages.journal import JournalStorage
-
-
-class FakePodBus:
-    """Lockstep allgather across N in-process 'hosts' (threads).
-
-    Gathers rendezvous at a barrier exactly like a pod collective: every
-    worker must reach ``exchange()`` the same number of times or the round
-    times out — the same discipline real XLA collectives impose."""
-
-    def __init__(self, n_workers: int, buffer_bytes: int = 1 << 16) -> None:
-        self.n = n_workers
-        self.workers = [
-            IciJournalBackend(buffer_bytes=buffer_bytes) for _ in range(n_workers)
-        ]
-        self._slots: list[np.ndarray | None] = [None] * n_workers
-        self._barrier = threading.Barrier(n_workers, timeout=30)
-        for idx, w in enumerate(self.workers):
-            w._allgather = self._make_gather(idx)  # type: ignore[method-assign]
-
-    def _make_gather(self, idx: int):
-        def gather(buf: np.ndarray) -> np.ndarray:
-            self._slots[idx] = buf
-            self._barrier.wait()  # all buffers staged
-            out = np.stack([s for s in self._slots])  # process_index order
-            self._barrier.wait()  # all workers copied out before reuse
-            return out
-
-        return gather
-
-    def lockstep(self, *fns) -> list:
-        """Run one callable per worker concurrently; re-raise any failure."""
-        assert len(fns) == self.n
-        results: list = [None] * self.n
-        errors: list = [None] * self.n
-
-        def run(i):
-            try:
-                results[i] = fns[i]()
-            except BaseException as e:  # noqa: BLE001 — surfaced below
-                errors[i] = e
-                self._barrier.abort()
-
-        threads = [threading.Thread(target=run, args=(i,)) for i in range(self.n)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        for e in errors:
-            if e is not None:
-                raise e
-        return results
-
-    def step(self, per_worker_logs: list[list[dict]]) -> None:
-        """One exchange round: every worker appends its ops and reaches the
-        collective together."""
-
-        def work(w, logs):
-            w._pending.extend(logs)
-            w.exchange()
-
-        self.lockstep(*[
-            (lambda w=w, logs=logs: work(w, logs))
-            for w, logs in zip(self.workers, per_worker_logs)
-        ])
+from optuna_tpu.testing.fault_injection import FakePodBus
 
 
 def test_all_workers_derive_identical_log():
@@ -262,3 +198,108 @@ def test_real_two_process_allgather_exchange(tmp_path):
     assert len(merged0) == 4
     # Deterministic (round, process_index, seq) order.
     assert [(l["proc"], l["seq"]) for l in merged0] == [(0, 0), (1, 0), (0, 1), (1, 1)]
+
+
+_SHARDED_SMOKE_WORKER = """\
+import json, os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+pid = int(sys.argv[1])
+port = int(sys.argv[2])
+jax.distributed.initialize('localhost:%d' % port, num_processes=2, process_id=pid)
+
+import numpy as np
+import optuna_tpu
+from jax.sharding import Mesh
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import VectorizedObjective, optimize_sharded
+from optuna_tpu.parallel.ici_journal import IciJournalBackend
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.storages.journal import JournalStorage
+
+backend = IciJournalBackend()
+storage = JournalStorage(backend)
+MIN = optuna_tpu.study.StudyDirection.MINIMIZE
+# Lockstep study creation: the leader appends (one exchange), the follower
+# paces the collective with an empty exchange and loads by name.
+if pid == 0:
+    storage.create_new_study([MIN], study_name='pod-smoke')
+else:
+    backend.exchange()
+study = optuna_tpu.load_study(
+    study_name='pod-smoke', storage=storage, sampler=RandomSampler(seed=5)
+)
+# A process-local 1x1 mesh: the smoke exercises the REAL process_allgather
+# trial sync, not cross-process SPMD (each host evaluates its copy of the
+# batch; the journal keeps them identical).
+mesh = Mesh(
+    np.array(jax.local_devices()[:1], dtype=object).reshape(1, 1),
+    axis_names=('trials', 'model'),
+)
+space = {'x': FloatDistribution(0.0, 1.0)}
+objective = VectorizedObjective(lambda p: (p['x'] - 0.3) ** 2, space)
+# process_index() != 0 auto-wraps this host's writes in PodFollowerStorage.
+optimize_sharded(study, objective, n_trials=6, batch_size=3, mesh=mesh)
+trials = [
+    {'number': t.number, 'state': t.state.name, 'x': t.params['x'], 'value': t.value}
+    for t in storage.get_all_trials(study._study_id)
+]
+print('TRIALS ' + json.dumps(trials))
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("OPTUNA_TPU_SKIP_MULTIHOST") == "1",
+    reason="real multi-process allgather smoke disabled by OPTUNA_TPU_SKIP_MULTIHOST=1",
+)
+def test_real_two_process_optimize_sharded_smoke(tmp_path):
+    """Two real ``jax.distributed`` CPU processes run the SAME
+    ``optimize_sharded`` loop over one study synced through the REAL
+    ``process_allgather`` exchange: process 0 leads the journal writes,
+    process 1's writes are auto-mirrored by ``PodFollowerStorage``, and
+    both must derive the identical COMPLETE trial set — the 2-process CI
+    form of the pod trial-sync contract (the FakePodBus lockstep test in
+    tests/test_sharded.py carries it where this runtime lacks multiprocess
+    CPU collectives)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "sharded_worker.py"
+    worker.write_text(_SHARDED_SMOKE_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon sitecustomize out
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    results = [p.communicate(timeout=180) for p in procs]
+    if any(
+        "Multiprocess computations aren't implemented" in err
+        for _out, err in results
+    ):
+        pytest.skip("this jax runtime lacks multiprocess CPU collectives")
+    outs = []
+    for p, (out, err) in zip(procs, results):
+        assert p.returncode == 0, err[-2000:]
+        outs.append(next(l for l in out.splitlines() if l.startswith("TRIALS ")))
+    trials0 = json.loads(outs[0][len("TRIALS "):])
+    trials1 = json.loads(outs[1][len("TRIALS "):])
+    assert trials0 == trials1  # identical merged study on both hosts
+    assert len(trials0) == 6
+    assert all(t["state"] == "COMPLETE" for t in trials0)
+    # Exactly once: the leader's six creates, no follower double-writes.
+    assert sorted(t["number"] for t in trials0) == list(range(6))

@@ -1,0 +1,790 @@
+"""Pod-scale sharded execution suite (ISSUE 12).
+
+Covers the ``optimize_sharded`` contract end to end:
+
+* partition-rule matching (first-match regex, scalar auto-replication, loud
+  unmatched-leaf error) and the shard/gather round trip;
+* the degenerate-mesh acceptance: ``{'trials': n_devices, 'model': 1}`` is
+  trial-for-trial identical to ``optimize_vectorized`` on the same seeded
+  study, on in-memory AND ICI-journal storages;
+* per-shard containment: a poison trial FAILs its shard's slots while every
+  other shard's trials are salvaged in one re-dispatch each; NaN slots
+  quarantine per slot; the ``shard.*`` device stats report the plan;
+* the mesh-path heartbeat reap: a SIGKILL'd worker's batch is reaped by a
+  survivor, retry clones re-enqueue with lineage intact, and the study
+  converges exactly to the fault-free run;
+* the FakePodBus chaos acceptance: NaN slots on one shard + a killed host
+  in ONE study — the doctor reports ``worker.dead`` for the mesh
+  coordinate, the shard's trials re-enqueue, every healthy trial COMPLETEs
+  exactly once, zero RUNNING, and the fault-free twin is containment-free;
+* the ``shard.imbalance`` doctor check and shard-aware worker ids;
+* leader/follower lockstep trial sync over the FakePodBus (the single-host
+  executable form of the pod's ICI-journal exchange contract).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import health, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import (
+    PodFollowerStorage,
+    ShardedObjective,
+    VectorizedObjective,
+    build_study_mesh,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    mesh_worker_id,
+    optimize_sharded,
+    optimize_vectorized,
+)
+from optuna_tpu.samplers import RandomSampler, TPESampler
+from optuna_tpu.storages import RetryFailedTrialCallback
+from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
+from optuna_tpu.storages._heartbeat import fail_stale_trials
+from optuna_tpu.storages._rdb.storage import RDBStorage
+from optuna_tpu.storages.journal import JournalStorage
+from optuna_tpu.testing.fault_injection import (
+    FakePodBus,
+    FaultyVectorizedObjective,
+    SimulatedWorkerDeath,
+    plant_dead_worker,
+    shard_chaos_plan,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+
+def _quad(params):
+    return (params["x"] - 0.3) ** 2
+
+
+def _states(study):
+    return {s: sum(t.state == s for t in study.trials) for s in TrialState}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.disable()
+
+
+# ---------------------------------------------------------- partition rules
+
+
+def test_match_partition_rules_first_match_and_scalars():
+    from jax.sharding import PartitionSpec as P
+
+    tree = {
+        "encoder": {"w1": np.zeros((4, 8)), "bias": np.zeros(8)},
+        "head": np.zeros((8, 2)),
+        "temperature": np.float32(1.0),
+    }
+    specs = match_partition_rules(
+        [
+            ("encoder/w1", P(None, "model")),
+            ("bias", P("model")),
+            (".*", P()),  # everything else replicates explicitly
+        ],
+        tree,
+    )
+    assert specs["encoder"]["w1"] == P(None, "model")
+    assert specs["encoder"]["bias"] == P("model")
+    assert specs["head"] == P()
+    # Scalars replicate before any rule is consulted.
+    assert specs["temperature"] == P()
+
+
+def test_match_partition_rules_unmatched_leaf_is_loud():
+    from jax.sharding import PartitionSpec as P
+
+    with pytest.raises(ValueError, match="no partition rule matched.*head"):
+        match_partition_rules([("encoder", P("model"))], {"head": np.zeros((4, 4))})
+
+
+def test_shard_and_gather_round_trip():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_study_mesh({"trials": 4, "model": 2})
+    tree = {"w": np.arange(32, dtype=np.float32).reshape(4, 8), "s": np.float32(3.0)}
+    specs = match_partition_rules([("w", P(None, "model"))], tree)
+    shard_fns, gather_fns = make_shard_and_gather_fns(mesh, specs)
+    import jax
+
+    placed = jax.tree_util.tree_map(lambda f, x: f(x), shard_fns, tree)
+    back = jax.tree_util.tree_map(lambda f, x: f(x), gather_fns, placed)
+    np.testing.assert_array_equal(back["w"], tree["w"])
+    assert float(back["s"]) == 3.0
+
+
+def test_build_study_mesh_validates():
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        build_study_mesh({"trials": 2, "layers": 2})
+    with pytest.raises(ValueError, match="needs 64 devices"):
+        build_study_mesh({"trials": 32, "model": 2})
+    mesh = build_study_mesh({"trials": 4, "model": 2})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"trials": 4, "model": 2}
+    # Default: every device on the trials axis.
+    import jax
+
+    default = build_study_mesh()
+    assert default.shape["trials"] == len(jax.devices())
+    assert default.shape["model"] == 1
+
+
+def test_mesh_worker_id_carries_mesh_coordinates():
+    mesh = build_study_mesh({"trials": 4, "model": 2})
+    worker = mesh_worker_id(mesh)
+    assert worker.endswith("-t0m0")
+    assert worker.startswith(health.default_worker_id())
+
+
+# ----------------------------------------------------- degenerate-mesh parity
+
+
+@pytest.mark.parametrize("storage_kind", ["in_memory", "ici_journal"])
+def test_degenerate_mesh_matches_optimize_vectorized(storage_kind):
+    """ISSUE 12 acceptance: a single-host ``{'trials': n_devices,
+    'model': 1}`` run is logically identical to ``optimize_vectorized`` on
+    the same seeded study — same trial states, params and best value — on
+    in-memory and ICI-journal storages alike."""
+    import jax
+
+    from optuna_tpu.parallel import IciJournalBackend
+
+    def make_study(seed):
+        storage = (
+            None if storage_kind == "in_memory" else JournalStorage(IciJournalBackend())
+        )
+        return optuna_tpu.create_study(storage=storage, sampler=TPESampler(seed=seed))
+
+    reference = make_study(11)
+    optimize_vectorized(
+        reference, VectorizedObjective(_quad, SPACE), n_trials=20, batch_size=8
+    )
+    sharded = make_study(11)
+    optimize_sharded(
+        sharded,
+        VectorizedObjective(_quad, SPACE),
+        n_trials=20,
+        batch_size=8,
+        mesh_shape={"trials": len(jax.devices()), "model": 1},
+    )
+    ref_trials, sh_trials = reference.trials, sharded.trials
+    assert len(ref_trials) == len(sh_trials) == 20
+    for a, b in zip(ref_trials, sh_trials):
+        assert a.params == b.params
+        assert a.state == b.state
+        assert a.values == b.values
+    assert reference.best_value == sharded.best_value
+
+
+# ------------------------------------------------------------- sharded model
+
+
+def _mlp_model_and_fn():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    model = {
+        "w1": rng.normal(0, 0.1, (8, 16)).astype(np.float32),
+        "b1": np.zeros(16, np.float32),
+        "w2": rng.normal(0, 0.1, (16, 4)).astype(np.float32),
+        "temperature": np.float32(1.0),
+    }
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+
+    def fn(params, m):
+        def one(lr, scale):
+            h = jnp.maximum(x @ (m["w1"] * scale) + m["b1"], 0.0)
+            out = h @ m["w2"] / m["temperature"]
+            return jnp.mean(out**2) * lr
+
+        return jax.vmap(one)(params["lr"], params["scale"])
+
+    return model, fn
+
+
+def test_sharded_objective_runs_model_axis():
+    from jax.sharding import PartitionSpec as P
+
+    model, fn = _mlp_model_and_fn()
+    space = {
+        "lr": FloatDistribution(0.01, 1.0, log=True),
+        "scale": FloatDistribution(0.5, 2.0),
+    }
+    obj = ShardedObjective(
+        fn,
+        space,
+        model=model,
+        partition_rules=[
+            ("w1", P(None, "model")),
+            ("b1", P("model")),
+            ("w2", P("model", None)),
+        ],
+    )
+    mesh = build_study_mesh({"trials": 4, "model": 2})
+    study = optuna_tpu.create_study(sampler=TPESampler(seed=3))
+    optimize_sharded(study, obj, n_trials=16, batch_size=8, mesh=mesh)
+    assert _states(study)[TrialState.COMPLETE] == 16
+    assert all(np.isfinite(t.value) for t in study.trials)
+    # The gather fns round-trip the placed model bit-exactly.
+    gathered = obj.gathered_model(mesh)
+    np.testing.assert_array_equal(gathered["w1"], model["w1"])
+
+
+def test_sharded_objective_without_mesh_is_rejected():
+    model, fn = _mlp_model_and_fn()
+    obj = ShardedObjective(fn, SPACE, model=model, partition_rules=[(".*", None)])
+    with pytest.raises(ValueError, match="needs a mesh"):
+        obj.guarded(None, "trials")
+
+
+# ------------------------------------------------------ per-shard containment
+
+
+def test_transient_crash_splits_along_shard_groups():
+    """A crashing multi-shard dispatch is split into its shard groups — one
+    re-dispatch per shard, not O(log B) blind halvings — and the whole
+    batch is salvaged when the fault was transient."""
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_at=(0,))
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=1))
+    optimize_sharded(
+        study, obj, n_trials=8, batch_size=8, mesh_shape={"trials": 4, "model": 1}
+    )
+    assert _states(study)[TrialState.COMPLETE] == 8
+    # One full-width dispatch, then exactly one re-dispatch per shard group
+    # (each 2-trial group padded to the 4-shard SPMD multiple).
+    assert obj.dispatch_widths == [8, 4, 4, 4, 4]
+    snap = telemetry.snapshot()
+    assert snap["counters"]["executor.bisection"] == 1
+    assert snap["gauges"]["device.shard.contained_groups.total"] == 4.0
+
+
+def test_poison_trial_fails_only_its_shard_slots():
+    """A persistent poison follows its trial through the shard split: the
+    poison shard's slots FAIL, every other shard's trials COMPLETE."""
+    poison = {"count": 0}
+
+    def raise_when(host):
+        hit = bool((host["x"] > 0.97).any())
+        poison["count"] += hit
+        return hit
+
+    # Pin one trial into the poison region via enqueue so the predicate has
+    # a deterministic victim.
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=2))
+    study.enqueue_trial({"x": 0.99})
+    obj = FaultyVectorizedObjective(_quad, SPACE, raise_when=raise_when)
+    optimize_sharded(
+        study, obj, n_trials=8, batch_size=8, mesh_shape={"trials": 4, "model": 1}
+    )
+    states = _states(study)
+    assert states[TrialState.RUNNING] == 0
+    assert states[TrialState.FAIL] >= 1
+    failed = [t for t in study.trials if t.state == TrialState.FAIL]
+    # Only the poison shard's slots failed; with in-group bisection the
+    # blast radius is the poison trial's own slot pair at most.
+    assert all(t.params["x"] > 0.97 or len(failed) <= 2 for t in failed)
+    complete = [t for t in study.trials if t.state == TrialState.COMPLETE]
+    assert all(t.params["x"] <= 0.97 for t in complete)
+    assert len(complete) >= 6
+
+
+def test_nan_slots_quarantine_per_slot_and_report_shard_stats():
+    plan = shard_chaos_plan()
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at=dict(plan.nan_slots))
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=4))
+    optimize_sharded(
+        study,
+        obj,
+        n_trials=plan.batch_size,
+        batch_size=plan.batch_size,
+        mesh_shape={"trials": plan.mesh_trials, "model": 1},
+    )
+    states = _states(study)
+    assert states[TrialState.FAIL] == plan.expected_quarantined
+    assert states[TrialState.COMPLETE] == plan.batch_size - plan.expected_quarantined
+    snap = telemetry.snapshot()
+    # The shard.* device stats report the plan exactly (DEVICE_STAT_CHAOS_MATRIX
+    # rows): width = ceil(B / trials-shards), quarantined = the NaN slots.
+    assert snap["gauges"]["device.shard.width.last"] == pytest.approx(
+        plan.batch_size / plan.mesh_trials
+    )
+    assert snap["gauges"]["device.shard.quarantined.total"] == float(
+        plan.expected_quarantined
+    )
+    assert snap["counters"]["executor.quarantine"] == plan.expected_quarantined
+    # Both NaN slots were owned by shard t0: its throughput gauge is short
+    # by exactly the quarantined count.
+    assert snap["gauges"].get("shard.trials.t0.total", 0.0) == 0.0
+    assert snap["gauges"]["shard.trials.t1.total"] == 2.0
+
+
+def test_clip_policy_quarantines_nothing_and_counts_full_throughput():
+    """Under ``non_finite='clip'`` every trial COMPLETEs with nan_to_num
+    values: shard.quarantined must stay 0 (agreeing with the terminal
+    states, the executor.quarantined contract) and the clipped trials
+    still count toward their shard's throughput gauge — a NaN-prone
+    parameter region must not read as a lagging chip."""
+    plan = shard_chaos_plan()
+    obj = FaultyVectorizedObjective(_quad, SPACE, nan_at=dict(plan.nan_slots))
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=4))
+    optimize_sharded(
+        study,
+        obj,
+        n_trials=plan.batch_size,
+        batch_size=plan.batch_size,
+        mesh_shape={"trials": plan.mesh_trials, "model": 1},
+        non_finite="clip",
+    )
+    assert _states(study)[TrialState.COMPLETE] == plan.batch_size
+    snap = telemetry.snapshot()
+    assert snap["gauges"].get("device.shard.quarantined.total", 0.0) == 0.0
+    assert "executor.quarantine" not in snap["counters"]
+    rows = plan.batch_size // plan.mesh_trials
+    for k in range(plan.mesh_trials):
+        assert snap["gauges"][f"shard.trials.t{k}.total"] == float(rows)
+
+
+def test_fully_quarantined_shard_registers_zero_throughput_gauge():
+    """A shard whose slots are ALL quarantined must still publish its
+    (zero) throughput gauge — otherwise the doctor's shard.imbalance check
+    can never see the worst imbalance case, the dead shard."""
+    obj = FaultyVectorizedObjective(
+        _quad, SPACE, nan_at={d: (0, 1) for d in range(3)}
+    )
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=4))
+    optimize_sharded(
+        study, obj, n_trials=24, batch_size=8, mesh_shape={"trials": 4, "model": 1}
+    )
+    snap = telemetry.snapshot()
+    assert snap["gauges"]["shard.trials.t0.total"] == 0.0  # present, and zero
+    assert snap["gauges"]["shard.trials.t1.total"] == 6.0
+
+
+def test_fakepod_lockstep_surfaces_root_fault_not_barrier_symptom():
+    """A fault on a non-zero worker aborts the barrier; the bystanders'
+    BrokenBarrierError must not mask the root fault when lockstep
+    re-raises."""
+
+    def fine():
+        bus.workers[0].exchange()
+
+    def broken():
+        raise RuntimeError("injected worker-1 fault")
+
+    bus = FakePodBus(2)
+    with pytest.raises(RuntimeError, match="injected worker-1 fault"):
+        bus.lockstep(fine, broken)
+
+
+def test_follower_storage_accepts_decorated_journal():
+    """The follower accepts exactly what _PodSync.detect accepts: the
+    journal may sit under forwarding decorators (RetryingStorage)."""
+    from optuna_tpu.parallel import IciJournalBackend
+    from optuna_tpu.storages._retry import RetryingStorage
+
+    journal = JournalStorage(IciJournalBackend())
+    decorated = RetryingStorage(journal)
+    follower = PodFollowerStorage(decorated)
+    assert follower._journal is journal
+
+
+def test_fault_free_twin_reports_zero_shard_faults():
+    obj = VectorizedObjective(_quad, SPACE)
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=4))
+    optimize_sharded(
+        study, obj, n_trials=8, batch_size=8, mesh_shape={"trials": 4, "model": 1}
+    )
+    snap = telemetry.snapshot()
+    assert snap["gauges"].get("device.shard.quarantined.total", 0.0) == 0.0
+    assert "device.shard.contained_groups.total" not in snap["gauges"]
+    assert not any(
+        name.startswith(("executor.", "heartbeat.")) for name in snap["counters"]
+    )
+
+
+# ------------------------------------------------------- heartbeat reap (mesh)
+
+
+def test_mesh_path_kill_reap_and_drain_converges_exactly(tmp_path):
+    """The executor's kill/reap/drain acceptance replayed on the mesh path:
+    a SIGKILL'd worker strands its sharded batch RUNNING, a survivor reaps
+    it at a batch boundary, retry clones re-enqueue with ``batch_exec:``
+    bookkeeping stripped and lineage intact, and the drained study matches
+    the fault-free run exactly."""
+    clean = optuna_tpu.create_study(sampler=RandomSampler(seed=9))
+    optimize_sharded(
+        clean,
+        VectorizedObjective(_quad, SPACE),
+        n_trials=16,
+        batch_size=8,
+        mesh_shape={"trials": 4, "model": 2},
+    )
+    clean_values = sorted(t.value for t in clean.trials)
+
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/schaos.db",
+        heartbeat_interval=60,
+        grace_period=120,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=2),
+    )
+    study = optuna_tpu.create_study(
+        study_name="schaos", storage=storage, sampler=RandomSampler(seed=9)
+    )
+    obj = FaultyVectorizedObjective(_quad, SPACE, kill_at={1})
+    with pytest.raises(SimulatedWorkerDeath):
+        optimize_sharded(
+            study, obj, n_trials=16, batch_size=8, mesh_shape={"trials": 4, "model": 2}
+        )
+    assert _states(study)[TrialState.RUNNING] == 8
+
+    con = storage._conn()
+    con.execute("UPDATE trial_heartbeats SET heartbeat = heartbeat - 100000")
+    con.commit()
+    survivor = optuna_tpu.load_study(study_name="schaos", storage=storage)
+    survivor.sampler = RandomSampler(seed=99)  # irrelevant: clones fix params
+    fail_stale_trials(survivor)
+
+    clones = [t for t in survivor.trials if t.state == TrialState.WAITING]
+    assert len(clones) == 8
+    assert not any(
+        k.startswith(EXECUTOR_ATTR_PREFIX) for c in clones for k in c.system_attrs
+    )
+    assert all("fixed_params" in c.system_attrs for c in clones)
+    assert all("failed_trial" in c.system_attrs for c in clones)
+
+    optimize_sharded(
+        survivor,
+        VectorizedObjective(_quad, SPACE),
+        n_trials=len(clones),
+        batch_size=8,
+        mesh_shape={"trials": 4, "model": 2},
+    )
+    final = _states(survivor)
+    assert final[TrialState.RUNNING] == 0
+    assert final[TrialState.COMPLETE] == 16
+    final_values = sorted(
+        t.value for t in survivor.trials if t.state == TrialState.COMPLETE
+    )
+    assert final_values == clean_values
+    assert survivor.best_value == clean.best_value
+
+
+# ------------------------------------------------------- FakePodBus chaos
+
+
+def test_fakepod_chaos_acceptance(tmp_path):
+    """ISSUE 12 acceptance: NaN slots on one shard + a killed host in ONE
+    study. The doctor reports ``worker.dead`` for the mesh coordinate, the
+    dead host's shard trials are reaped and re-enqueued, every healthy
+    trial COMPLETEs exactly once, zero RUNNING at exit — and the fault-free
+    twin is containment-free."""
+    plan = shard_chaos_plan()
+    mesh_shape = {"trials": plan.mesh_trials, "model": plan.mesh_model}
+
+    clean = optuna_tpu.create_study(sampler=RandomSampler(seed=21))
+    optimize_sharded(
+        clean,
+        VectorizedObjective(_quad, SPACE),
+        n_trials=plan.n_trials,
+        batch_size=plan.batch_size,
+        mesh_shape=mesh_shape,
+    )
+    assert _states(clean)[TrialState.COMPLETE] == plan.n_trials
+    clean_snap = telemetry.snapshot()
+    assert not any(
+        name.startswith(("executor.", "heartbeat.", "sampler.fallback"))
+        for name in clean_snap["counters"]
+    )
+    clean_params = sorted(t.params["x"] for t in clean.trials)
+
+    telemetry.enable(telemetry.MetricsRegistry())  # fresh registry for the chaos twin
+    storage = RDBStorage(
+        f"sqlite:///{tmp_path}/podchaos.db",
+        heartbeat_interval=60,
+        grace_period=120,
+        failed_trial_callback=RetryFailedTrialCallback(max_retry=2),
+    )
+    study = optuna_tpu.create_study(
+        study_name="podchaos", storage=storage, sampler=RandomSampler(seed=21)
+    )
+    obj = FaultyVectorizedObjective(
+        _quad, SPACE, nan_at=dict(plan.nan_slots), kill_at={plan.kill_dispatch}
+    )
+    with pytest.raises(SimulatedWorkerDeath):
+        optimize_sharded(
+            study,
+            obj,
+            n_trials=plan.n_trials,
+            batch_size=plan.batch_size,
+            mesh_shape=mesh_shape,
+        )
+    # The killed host left its stale health snapshot behind, stamped with
+    # its mesh coordinate.
+    plant_dead_worker(study, worker_id=plan.dead_worker_id, age_s=plan.dead_worker_age_s)
+
+    con = storage._conn()
+    con.execute("UPDATE trial_heartbeats SET heartbeat = heartbeat - 100000")
+    con.commit()
+    survivor = optuna_tpu.load_study(
+        study_name="podchaos", storage=storage, sampler=RandomSampler(seed=77)
+    )
+    fail_stale_trials(survivor)
+    assert _states(survivor)[TrialState.RUNNING] == 0
+
+    # The doctor diagnoses the dead host at its mesh coordinate.
+    report = survivor.health_report()
+    findings = {f["check"]: f for f in report["findings"]}
+    for check in plan.expected_findings:
+        assert check in findings, report["findings"]
+    dead = findings["worker.dead"]
+    assert plan.dead_worker_id in dead["evidence"]["dead_workers"]
+    assert plan.dead_worker_coord in dead["summary"]
+
+    # Re-enqueue the NaN quarantine victims too, then drain.
+    retry = RetryFailedTrialCallback()
+    for t in survivor.trials:
+        if t.state == TrialState.FAIL and "non-finite" in t.system_attrs.get(
+            "fail_reason", ""
+        ):
+            retry(survivor, t)
+    waiting = [t for t in survivor.trials if t.state == TrialState.WAITING]
+    assert len(waiting) == plan.batch_size + plan.expected_quarantined
+    remaining = plan.n_trials - _states(survivor)[TrialState.COMPLETE]
+    optimize_sharded(
+        survivor,
+        VectorizedObjective(_quad, SPACE),
+        n_trials=remaining,
+        batch_size=plan.batch_size,
+        mesh_shape=mesh_shape,
+    )
+    final = _states(survivor)
+    assert final[TrialState.RUNNING] == 0
+    assert final[TrialState.COMPLETE] == plan.n_trials
+    # Every healthy trial exactly once: the completed params match the
+    # fault-free twin's draws (same seed; clones re-ran their originals).
+    final_params = sorted(
+        t.params["x"] for t in survivor.trials if t.state == TrialState.COMPLETE
+    )
+    assert final_params == clean_params
+    assert survivor.best_value == clean.best_value
+
+
+# -------------------------------------------------------- doctor: imbalance
+
+
+def _fleet_with_shard_gauges(gauges):
+    return {
+        "workers": [],
+        "n_workers": 1,
+        "n_alive": 1,
+        "counters": {},
+        "gauges": gauges,
+        "histograms": {},
+        "jit": {},
+    }
+
+
+def test_shard_imbalance_check_fires_on_lagging_shard():
+    fleet = _fleet_with_shard_gauges(
+        {
+            "shard.trials.t0.total": 24.0,
+            "shard.trials.t1.total": 26.0,
+            "shard.trials.t2.total": 8.0,  # >= 2x below the median
+            "shard.trials.t3.total": 25.0,
+        }
+    )
+    findings = health.diagnose(fleet, [], [optuna_tpu.study.StudyDirection.MINIMIZE])
+    assert [f.check for f in findings] == ["shard.imbalance"]
+    finding = findings[0]
+    assert finding.severity == "WARNING"
+    assert finding.evidence["lagging_shards"] == ["t2"]
+    assert "t2" in finding.summary
+
+
+def test_shard_imbalance_sees_majority_dead_shards():
+    """The evidence floor gates on the BEST shard: with three of four
+    shards dead the median is 0, and a median-gated floor would go silent
+    exactly in the worst imbalance case."""
+    fleet = _fleet_with_shard_gauges(
+        {
+            "shard.trials.t0.total": 100.0,
+            "shard.trials.t1.total": 0.0,
+            "shard.trials.t2.total": 0.0,
+            "shard.trials.t3.total": 0.0,
+        }
+    )
+    findings = health.diagnose(fleet, [], [optuna_tpu.study.StudyDirection.MINIMIZE])
+    assert [f.check for f in findings] == ["shard.imbalance"]
+    assert findings[0].evidence["lagging_shards"] == ["t1", "t2", "t3"]
+
+
+def test_shard_imbalance_stays_clean_when_balanced_or_sparse():
+    balanced = _fleet_with_shard_gauges(
+        {f"shard.trials.t{k}.total": 24.0 + k for k in range(4)}
+    )
+    assert not health.diagnose(
+        balanced, [], [optuna_tpu.study.StudyDirection.MINIMIZE]
+    )
+    # Startup skew below the evidence floor must not flag.
+    sparse = _fleet_with_shard_gauges(
+        {"shard.trials.t0.total": 4.0, "shard.trials.t1.total": 1.0}
+    )
+    assert not health.diagnose(sparse, [], [optuna_tpu.study.StudyDirection.MINIMIZE])
+
+
+def test_shard_imbalance_flows_through_published_snapshots():
+    """End to end through the fleet channel: a worker publishes lagging
+    shard gauges; the aggregated report flags the coordinate."""
+    clock = {"t": 0.0}
+    health.enable(
+        interval_s=0.0,
+        worker_id="host-1-t0m0",
+        clock=lambda: clock["t"],
+        now=lambda: 1000.0 + clock["t"],
+    )
+    try:
+        study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+        health.attach(study)
+        for k, n in enumerate((30.0, 31.0, 29.0, 5.0)):
+            telemetry.add_gauge(f"shard.trials.t{k}.total", n)
+        reporter = study.__dict__["_health_reporter"]
+        snapshot = reporter.publish()
+        assert snapshot is not None
+        assert snapshot["gauges"]["shard.trials.t3.total"] == 5.0
+        report = study.health_report(now=1001.0)
+        checks = {f["check"] for f in report["findings"]}
+        assert "shard.imbalance" in checks
+    finally:
+        health.disable()
+
+
+def test_sharded_loop_attaches_mesh_worker_id():
+    clock = {"t": 0.0}
+    health.enable(
+        interval_s=0.0, clock=lambda: clock["t"], now=lambda: 1000.0 + clock["t"]
+    )
+    try:
+        study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+        optimize_sharded(
+            study,
+            VectorizedObjective(_quad, SPACE),
+            n_trials=4,
+            batch_size=4,
+            mesh_shape={"trials": 4, "model": 2},
+        )
+        workers = health.worker_snapshots(study._storage, study._study_id)
+        assert len(workers) == 1
+        (worker_id,) = workers
+        assert worker_id.endswith("-t0m0")
+    finally:
+        health.disable()
+
+
+# ----------------------------------------------------- pod lockstep (FakePodBus)
+
+
+def test_pod_lockstep_leader_follower_derive_identical_study():
+    """Two 'hosts' on the FakePodBus run the SAME optimize_sharded loop in
+    lockstep: host 0 leads the journal writes, host 1's writes are mirrored
+    by :class:`PodFollowerStorage` (one paced exchange per leader append +
+    the batch-boundary barrier). Both hosts derive byte-identical journals
+    and the identical trial set — the single-host executable form of the
+    pod's ICI trial-sync contract."""
+    bus = FakePodBus(2)
+    stores = [JournalStorage(w) for w in bus.workers]
+    MIN = optuna_tpu.study.StudyDirection.MINIMIZE
+
+    sid, _ = bus.lockstep(
+        lambda: stores[0].create_new_study([MIN], study_name="pod"),
+        lambda: bus.workers[1].exchange(),
+    )
+    studies = [
+        optuna_tpu.load_study(
+            study_name="pod", storage=stores[0], sampler=RandomSampler(seed=5)
+        ),
+        optuna_tpu.load_study(
+            study_name="pod", storage=stores[1], sampler=RandomSampler(seed=5)
+        ),
+    ]
+    # The follower's writes become paced exchanges deriving the leader's
+    # results (on a real pod optimize_sharded wraps automatically from
+    # jax.process_index(); single-process tests wire the role explicitly).
+    studies[1]._storage = PodFollowerStorage(stores[1])
+
+    def run(i):
+        objective = VectorizedObjective(_quad, SPACE)
+        optimize_sharded(
+            studies[i],
+            objective,
+            n_trials=8,
+            batch_size=4,
+            mesh_shape={"trials": 4, "model": 1},
+        )
+
+    bus.lockstep(lambda: run(0), lambda: run(1))
+
+    assert bus.workers[0].read_logs(0) == bus.workers[1].read_logs(0)
+    trials0 = stores[0].get_all_trials(sid)
+    trials1 = stores[1].get_all_trials(sid)
+    assert len(trials0) == len(trials1) == 8
+    for a, b in zip(trials0, trials1):
+        assert a.params == b.params
+        assert a.state == b.state == TrialState.COMPLETE
+        assert a.values == b.values
+    # The batch-boundary exchange points were spanned under the registered
+    # shard.exchange phase (2 batches per host).
+    hist = telemetry.snapshot()["histograms"].get("phase.shard.exchange")
+    assert hist is not None and hist["count"] >= 4
+
+
+def test_health_suppress_skips_publishes_while_enabled():
+    """On a multi-process pod the wall-clock-rate-limited health publish
+    would desynchronize the lockstep exchange count, so optimize_sharded
+    suppresses reporting for the run: a suppressed study publishes nothing
+    through maybe_report/flush even while the reporter is globally on."""
+    health.enable(interval_s=0.0)
+    try:
+        study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+        health.suppress(study)
+        health.attach(study)  # must not resurrect a reporter
+        health.maybe_report(study)
+        health.flush(study)
+        assert health.worker_snapshots(study._storage, study._study_id) == {}
+        # Clearing the sentinel restores normal reporting.
+        study.__dict__.pop("_health_reporter")
+        health.maybe_report(study)
+        assert len(health.worker_snapshots(study._storage, study._study_id)) == 1
+    finally:
+        health.disable()
+
+
+def test_follower_storage_rejects_non_ici_backends():
+    with pytest.raises(ValueError, match="IciJournalBackend"):
+        PodFollowerStorage(optuna_tpu.storages.InMemoryStorage())  # type: ignore[arg-type]
+
+
+def test_follower_zero_width_create_paces_no_exchange():
+    """The leader's create_new_trials(n<=0) early-returns without an
+    append; the follower must not pace a collective for it (an unpaired
+    exchange would desynchronize the pod's allgather rounds)."""
+    from optuna_tpu.parallel import IciJournalBackend
+
+    journal = JournalStorage(IciJournalBackend())
+    MIN = optuna_tpu.study.StudyDirection.MINIMIZE
+    sid = journal.create_new_study([MIN], study_name="zero-width")
+    follower = PodFollowerStorage(journal)
+
+    def explode():
+        raise AssertionError("zero-width create must not exchange")
+
+    follower._ici.exchange = explode  # type: ignore[method-assign]
+    assert follower.create_new_trials(sid, 0) == []
