@@ -316,6 +316,257 @@ def run_ours_tpe(n_warmup: int, n_timed: int, objective=None) -> tuple[float, fl
     return n_timed / dt, study.best_value
 
 
+def _serve_objective(trial) -> float:
+    x = trial.suggest_float("x", -5.0, 5.0)
+    y = trial.suggest_float("y", -5.0, 5.0)
+    return (x - 1.0) ** 2 + (y + 2.0) ** 2
+
+
+_SERVE_TPE_KWARGS = dict(multivariate=True, n_startup_trials=10)
+
+
+def run_ours_tpe_serve(
+    n_clients: int, asks_per_client: int, warm_trials: int = 40
+) -> tuple[float, dict]:
+    """``--loop=serve``: N simulated thin clients in a closed ask/eval/tell
+    loop against ONE in-process suggestion service (ISSUE 13) — the server
+    code path end to end (wire codec + op tokens + handler), mounted
+    handler-direct so the measurement is the service, not loopback TCP.
+
+    Returns (asks/s over the timed window, detail dict with per-ask
+    p50/p99 ms, coalesce width stats, and the best value seen)."""
+    import threading as _th
+    import types as _types
+
+    import optuna_tpu
+    from optuna_tpu.samplers import TPESampler, ThinClientSampler
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc import _service as _wire
+    from optuna_tpu.storages._grpc.server import _make_handler
+    from optuna_tpu.storages._grpc.suggest_service import SuggestService
+
+    _silence()
+    from optuna_tpu.storages._grpc.suggest_service import ShedPolicy
+
+    storage = InMemoryStorage()
+    service = SuggestService(
+        storage,
+        lambda: TPESampler(seed=0, **_SERVE_TPE_KWARGS),
+        # Big speculation batches amortize the per-refill fit cost (the fit
+        # dominates; proposals are ~free on top), which is what keeps the
+        # refill capacity above client demand at deep history.
+        ready_ahead=4 * n_clients,
+        # Bump the queue epoch every 2N tells: at the window's history depth
+        # (hundreds of trials) the posterior moves marginally per tell, and
+        # spacing invalidations past the refill latency lets the bounded-
+        # stale double buffer always bridge the swap (no miss window).
+        invalidate_after=2 * n_clients,
+        max_coalesce=n_clients,
+        coalesce_window_s=0.002,
+        # The bench measures serving capacity at exactly n_clients, so the
+        # ladder is sized to absorb that concurrency (shedding under it
+        # would measure the policy, not the server).
+        shed_policy=ShedPolicy(
+            degrade_depth=n_clients,
+            independent_depth=2 * n_clients,
+            reject_depth=4 * n_clients,
+        ),
+        health_reporting=False,
+    )
+    mounted = service.wrap_storage(storage)
+    handler = _make_handler(mounted, service)
+    method_handler = handler.service(
+        _types.SimpleNamespace(method=f"/{_wire.SERVICE_NAME}/x")
+    )
+
+    def rpc(method, *args, **kwargs):
+        ok, payload = _wire.decode_response(
+            method_handler.unary_unary(_wire.encode_request(method, args, kwargs), None)
+        )
+        if not ok:
+            raise payload
+        return payload
+
+    def make_study(seed, name="serve-bench"):
+        def ask(study_id, trial_id, number, token):
+            return rpc(
+                "service_ask", study_id, trial_id, number,
+                **{_wire.OP_TOKEN_KEY: token},
+            )
+
+        return optuna_tpu.load_study(
+            study_name=name,
+            storage=mounted,
+            sampler=ThinClientSampler(ask, seed=seed),
+        )
+
+    optuna_tpu.create_study(
+        storage=mounted, study_name="serve-bench", direction="minimize"
+    )
+    # Warm-up, the run_ours_tpe policy extended to the width ladder: a
+    # throwaway study visits every TPE history bucket the timed window will
+    # touch, with service.prewarm at each power-of-two crossing compiling
+    # the whole coalesce width ladder AT that bucket — so the measurement
+    # excludes XLA compile time the way every other bench config does.
+    # Cover BOTH timed phases' history growth (saturation + the paced
+    # steady-state phase), so no obs bucket compiles mid-window.
+    warm_total = (
+        warm_trials
+        + n_clients * asks_per_client
+        + n_clients * max(4, asks_per_client // 2)
+    )
+    optuna_tpu.create_study(
+        storage=mounted, study_name="serve-warm", direction="minimize"
+    )
+    wsid = storage.get_study_id_from_name("serve-warm")
+    throwaway = make_study(1, name="serve-warm")
+    next_prewarm = 64
+    for i in range(warm_total):
+        t = throwaway.ask()
+        throwaway.tell(t, _serve_objective(t))
+        if i + 1 >= next_prewarm:
+            service.prewarm(wsid)
+            next_prewarm *= 2
+    service.prewarm(wsid)
+    # The timed study starts fresh past the startup phase, fully warm.
+    warm = make_study(2)
+    for _ in range(warm_trials):
+        t = warm.ask()
+        warm.tell(t, _serve_objective(t))
+    sid = storage.get_study_id_from_name("serve-bench")
+    assert service.prewarm(sid) > 0
+
+    errors: list[BaseException] = []
+    best: list[float] = []
+
+    def run_phase(phase_asks_per_client: int, think_s: float, seed_base: int):
+        """One N-client closed-loop phase; returns (wall_s, sorted per-ask
+        latencies). ``think_s`` is simulated objective-evaluation time
+        between ask and tell (the trial is RUNNING while the client
+        'works'); per-ask latency = ask + param materialization."""
+        latencies: list[float] = []
+        lat_lock = _th.Lock()
+
+        def client(seed):
+            try:
+                study = make_study(seed)
+                local: list[float] = []
+                if think_s:
+                    # Stagger the fleet across one think period: real
+                    # workers are not phase-locked, and a synchronized
+                    # 64-ask thundering herd every round would measure the
+                    # herd, not the steady state.
+                    time.sleep(think_s * ((seed % n_clients) / n_clients))
+                for _ in range(phase_asks_per_client):
+                    t0 = time.perf_counter()
+                    trial = study.ask()
+                    value = _serve_objective(trial)
+                    local.append(time.perf_counter() - t0)
+                    if think_s:
+                        time.sleep(think_s)
+                    study.tell(trial, value)
+                    best.append(value)
+                with lat_lock:
+                    latencies.extend(local)
+            except BaseException as err:  # pragma: no cover - surfaced below
+                errors.append(err)
+
+        threads = [
+            _th.Thread(target=client, args=(seed_base + i,))
+            for i in range(n_clients)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        if errors:
+            raise errors[0]
+        latencies.sort()
+        return wall, latencies
+
+    def _pct(sorted_vals, p: float) -> float:
+        return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+    _reset_phase_telemetry()
+    # Phase A — saturation throughput: zero think time, the most adversarial
+    # closed loop. The headline asks/s is the server's serving capacity; at
+    # saturation tail latency is queueing-bound (Little's law), so the p99
+    # contract is NOT measured here.
+    sat_wall, sat_lat = run_phase(asks_per_client, 0.0, 100)
+    # Re-prime the speculation between phases: the saturation phase ends
+    # with the queue drained, which is phase A's residue, not phase B's
+    # steady state.
+    service.refill_now(sid)
+    # Phase B — steady state: clients 'evaluate' for think_s between ask and
+    # tell (trials RUNNING meanwhile), keeping aggregate demand below the
+    # speculation capacity — the regime where "a steady-state ask is a
+    # ready-queue pop" is the contract, and where the 64-client p99 must
+    # meet the single-client ask latency.
+    # Pacing targets sub-saturation demand: the steady-state contract (a
+    # ready-queue pop) is defined below the server's speculation capacity,
+    # which shrinks as history (and the per-refill fit) grows — the full
+    # run's deeper window gets the slower cadence a real long study has.
+    steady_think_s = 0.25 if asks_per_client <= 8 else 0.5
+    steady_asks = max(4, asks_per_client // 2)
+    _, steady_lat = run_phase(steady_asks, steady_think_s, 1000)
+    from optuna_tpu import telemetry as _telemetry
+
+    gauges = _telemetry.snapshot()["gauges"]
+    counters = _telemetry.snapshot()["counters"]
+    service.close()
+    n_asks = n_clients * asks_per_client
+    detail = {
+        "n_clients": n_clients,
+        "asks_per_client": asks_per_client,
+        "serve_ask_p50_ms": round(1e3 * _pct(steady_lat, 0.50), 3),
+        "serve_ask_p99_ms": round(1e3 * _pct(steady_lat, 0.99), 3),
+        "steady_think_s": steady_think_s,
+        "steady_asks": steady_asks * n_clients,
+        "saturated_ask_p50_ms": round(1e3 * _pct(sat_lat, 0.50), 3),
+        "saturated_ask_p99_ms": round(1e3 * _pct(sat_lat, 0.99), 3),
+        "coalesce_width_max": int(gauges.get("serve.coalesce.width.max", 0)),
+        "ready_queue_hits": int(counters.get("serve.ready_queue.hit", 0)),
+        "ready_queue_misses": int(counters.get("serve.ready_queue.miss", 0)),
+        "sheds": int(
+            sum(v for k, v in counters.items() if k.startswith("serve.shed."))
+        ),
+        "best": round(min(best), 6),
+    }
+    return n_asks / sat_wall, detail
+
+
+def run_ours_tpe_single_client(warm_trials: int, n_asks: int) -> tuple[float, float]:
+    """The unbatched twin for ``--loop=serve``: ONE client running the same
+    TPE config locally (the pre-service architecture — every ask pays its
+    own fit+propose). Returns (asks/s closed-loop, mean per-ask seconds —
+    the latency bar the 64-client p99 must meet)."""
+    import optuna_tpu
+    from optuna_tpu.samplers import TPESampler
+
+    _silence()
+    study = optuna_tpu.create_study(
+        sampler=TPESampler(seed=0, **_SERVE_TPE_KWARGS)
+    )
+    for _ in range(warm_trials):
+        t = study.ask()
+        study.tell(t, _serve_objective(t))
+    ask_seconds: list[float] = []
+    t0 = time.time()
+    for _ in range(n_asks):
+        # Same latency definition as the serve side: ask + the suggests
+        # that materialize the params (where a local sampler's lazy fit
+        # actually runs).
+        a0 = time.perf_counter()
+        trial = study.ask()
+        value = _serve_objective(trial)
+        ask_seconds.append(time.perf_counter() - a0)
+        study.tell(trial, value)
+    dt = time.time() - t0
+    return n_asks / dt, sum(ask_seconds) / len(ask_seconds)
+
+
 def run_ours_cmaes(n_warmup: int, n_timed: int) -> tuple[float, float]:
     import optuna_tpu
     from optuna_tpu.models.benchmarks import rastrigin
@@ -1045,13 +1296,14 @@ def main() -> None:
     parser.add_argument(
         "--loop",
         default="ask_tell",
-        choices=["ask_tell", "scan", "sharded"],
+        choices=["ask_tell", "scan", "sharded", "serve"],
         help="study-loop mode: the per-trial ask/tell path (default), the "
-        "HBM-resident lax.scan loop (gp config only), or the pod-mesh "
+        "HBM-resident lax.scan loop (gp config only), the pod-mesh "
         "sharded loop (the MULTICHIP dry-run promoted: sharded MLP trials "
-        "on a {'trials': 4, 'model': 2} CPU mesh) — scan and sharded each "
-        "carry their own trajectory metric, so each path gets a distinct "
-        "gate baseline",
+        "on a {'trials': 4, 'model': 2} CPU mesh), or the suggestion-"
+        "service closed loop (64 thin clients against one coalescing "
+        "server, tpe config only) — scan/sharded/serve each carry their "
+        "own trajectory metric, so each path gets a distinct gate baseline",
     )
     args = parser.parse_args()
     watchdog.phase(f"run:{args.config}:{args.loop}")
@@ -1064,7 +1316,48 @@ def main() -> None:
     # steady-state trials/s figure.
     n_timed = None
 
-    if args.loop == "sharded":
+    if args.loop == "serve":
+        if args.config != "tpe":
+            parser.error("--loop=serve is only defined for --config tpe")
+        # Acceptance geometry (ISSUE 13): 64 simulated concurrent thin
+        # clients in a closed ask/eval/tell loop against ONE suggestion
+        # service, vs the single-client local-sampler twin on the same TPE
+        # config. The committed comparable is asks/s; the p50/p99 per-ask
+        # latencies and the twin's mean ask latency ride beside it (the
+        # p99-vs-single-client bar the issue names).
+        n_clients = 64
+        asks_per_client = 8 if args.quick else 24
+        _log(
+            f"running ours (suggestion service / TPE, {n_clients} clients x "
+            f"{asks_per_client} asks, closed loop)..."
+        )
+        ours_rate, serve_detail = run_ours_tpe_serve(n_clients, asks_per_client)
+        n_timed = n_clients * asks_per_client
+        ours_best = serve_detail.pop("best")
+        # Capture the serve window's breakdown NOW: the single-client twin
+        # below is instrumented ours-side code too (same policy as
+        # --loop=scan/sharded's capture ordering).
+        extra["phases"] = _phase_breakdown()
+        extra["device_stats"] = _device_stats_breakdown()
+        extra["compile"] = _compile_breakdown()
+        extra["serve"] = serve_detail
+        extra["unit_override"] = "asks/s"
+        _log(
+            f"ours(serve): {ours_rate:.3f} asks/s "
+            f"(p50 {serve_detail['serve_ask_p50_ms']}ms, "
+            f"p99 {serve_detail['serve_ask_p99_ms']}ms); "
+            "running single-client local-sampler twin..."
+        )
+        watchdog.update(value=round(ours_rate, 3))
+        watchdog.phase("baseline:tpe_single_client")
+        base_rate, single_ask_s = run_ours_tpe_single_client(
+            40, max(64, n_timed // 8)
+        )
+        serve_detail["single_client_ask_ms"] = round(1e3 * single_ask_s, 3)
+        base = (base_rate, ours_best)
+        provenance = "live-ours-single-client-local-sampler"
+        metric = f"serve_asks_per_sec_tpe_{n_clients}clients"
+    elif args.loop == "sharded":
         if args.config not in ("gp", "mlp"):
             parser.error(
                 "--loop=sharded runs the sharded MLP mesh study (default or "

@@ -169,6 +169,14 @@ def append_entry(
         entry["device_stats"] = result["device_stats"]
     if result.get("mesh"):
         entry["mesh"] = result["mesh"]
+    if result.get("serve"):
+        # The suggestion-service loop's latency block (ISSUE 13): per-ask
+        # p50/p99 for the paced steady-state phase, the saturated twin
+        # figures, queue hit/miss counts, and the single-client local-
+        # sampler ask latency the p99 is contracted against.
+        entry["serve"] = result["serve"]
+    if result.get("unit") and result.get("unit") != "trials/s":
+        entry["unit"] = result["unit"]
     if result.get("steady_state_trials_per_sec") is not None:
         entry["steady_state_trials_per_sec"] = result["steady_state_trials_per_sec"]
     provenance = git_provenance()

@@ -18,6 +18,7 @@ OBS003   device-stat vocabularies drifted from the canonical one
 OBS004   study-doctor check vocabularies drifted from the canonical one
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
+SRV001   suggestion-service shed policy sets drifted from the canonical one
 EXE001   non-finite quarantine policy sets drifted from the canonical one
 SMP001   sampler fallback policy sets drifted from the canonical one
 SMP002   bare Cholesky in sampler code (route through ladder_cholesky)
@@ -62,6 +63,7 @@ def all_rules() -> list[Rule]:
     )
     from optuna_tpu._lint.rules_storage import (
         EXE001NonFinitePolicySync,
+        SRV001ShedPolicySync,
         STO001ReplayRegistrySync,
         STO002LockOrder,
     )
@@ -77,6 +79,7 @@ def all_rules() -> list[Rule]:
         OBS004HealthCheckSync(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
+        SRV001ShedPolicySync(),
         EXE001NonFinitePolicySync(),
         SMP001FallbackPolicySync(),
         SMP002LadderCholeskyOnly(),

@@ -97,6 +97,10 @@ class Config:
     obs004_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.HEALTH_CHECK_REGISTRY
     )
+    srv001_targets: tuple[tuple[str, str, str], ...] = registry.SRV001_TARGETS
+    srv001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.SHED_POLICY_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

@@ -136,6 +136,9 @@ TELEMETRY_PHASE_REGISTRY: dict[str, str] = {
     "scan.chunk": "one HBM-resident scan-chunk dispatch (host side; the device run overlaps the previous chunk's sync)",
     "scan.sync": "chunk-boundary result wait + storage sync of a scan chunk's trials",
     "shard.exchange": "one pod-wide ICI-journal exchange point at a sharded batch boundary",
+    "serve.ask": "one suggestion-service ask served end to end (queue pop, shed rung, or coalesced dispatch)",
+    "serve.coalesce": "one fused proposal dispatch answering a whole coalesced ask batch",
+    "serve.ready_queue": "one speculative ask-ahead refill dispatch (background, off the RPC path)",
 }
 
 #: The containment-counter families: canonical mirror of
@@ -152,6 +155,8 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "executor.dispatch_timeout": "a device dispatch overran its deadline and was abandoned",
     "heartbeat.reap": "a stale (dead-worker) RUNNING trial was reaped to FAIL",
     "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
+    "serve.shed": "(suffixed by policy) an overloaded ask was degraded or refused by the shed ladder",
+    "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
 }
 
 #: The flight recorder's event-kind vocabulary: canonical mirror of
@@ -247,6 +252,8 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
     "worker.dead": "a worker's health snapshot went stale past its report interval",
     "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
+    "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
+    "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
 }
 
 #: The hand-maintained copies OBS004 cross-checks, as
@@ -262,6 +269,34 @@ OBS004_TARGETS: tuple[tuple[str, str, str], ...] = (
         "optuna_tpu/testing/fault_injection.py",
         "HEALTH_CHECK_CHAOS_MATRIX",
         "chaos matrix: every health check must have a fault scenario that fires it",
+    ),
+)
+
+#: The suggestion service's load-shedding ladder (the overload rungs
+#: ``storages/_grpc/suggest_service.py`` may answer an ask with), mildest
+#: first. Two code sites carry a hand-written copy (see
+#: :data:`SRV001_TARGETS`); rule **SRV001** fails the lint if either drifts
+#: from this registry — a shed rung nobody has chaos-tested is a silent way
+#: to drop asks under exactly the load that makes debugging hardest.
+SHED_POLICY_REGISTRY: dict[str, str] = {
+    "stale_queue": "degrade: serve a stale (posterior-moved) ready-queue proposal without a fit",
+    "independent": "degrade: serve an empty relative proposal; the client samples independently",
+    "reject": "backpressure: refuse the ask with RESOURCE_EXHAUSTED and a retry-after hint",
+}
+
+#: The hand-maintained copies SRV001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+SRV001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/storages/_grpc/suggest_service.py",
+        "SHED_POLICIES",
+        "the service's accepted shed rungs (the ladder decide() can answer with)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "SHED_CHAOS_POLICIES",
+        "chaos matrix: every shed rung must have an overload scenario that forces it",
     ),
 )
 

@@ -169,6 +169,27 @@ class EXE001NonFinitePolicySync(_RegistrySyncRule):
         return config.exe001_targets
 
 
+class SRV001ShedPolicySync(_RegistrySyncRule):
+    """The STO001/EXE001/SMP001 anti-drift machinery pointed at the
+    suggestion service's load-shedding ladder: the service's
+    ``SHED_POLICIES`` literal and the chaos matrix
+    ``fault_injection.py::SHED_CHAOS_POLICIES`` must both equal the
+    canonical ``registry.SHED_POLICY_REGISTRY`` — a shed rung added without
+    an overload scenario that forces it is a lint failure, because an
+    untested rung drops asks under exactly the load that makes the drop
+    hardest to debug."""
+
+    id = "SRV001"
+    title = "suggestion-service shed policy sets out of sync"
+    noun = "shed policies"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.srv001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.srv001_targets
+
+
 # --------------------------------------------------------------------- STO002
 
 

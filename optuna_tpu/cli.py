@@ -408,9 +408,27 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
     def _device(entry: dict[str, Any]) -> str:
         stats = entry.get("device_stats") or {}
         mesh = entry.get("mesh") or {}
-        if not stats and not mesh:
+        serve = entry.get("serve") or {}
+        if not stats and not mesh and not serve:
             return ""
         parts = []
+        if serve:
+            # Serve-loop entries (bench --loop=serve) lead with the latency
+            # contract: steady-state per-ask p99 vs the single-client twin's
+            # mean ask latency (the bar it must meet), then ready-queue
+            # hit/miss, widest observed coalesce, and any sheds.
+            parts.append(
+                f"p99={serve.get('serve_ask_p99_ms')}ms"
+                f"/1cl={serve.get('single_client_ask_ms')}ms"
+            )
+            parts.append(
+                f"q={serve.get('ready_queue_hits', 0)}"
+                f"/{serve.get('ready_queue_misses', 0)}"
+            )
+            if serve.get("coalesce_width_max") is not None:
+                parts.append(f"w={serve['coalesce_width_max']}")
+            if serve.get("sheds"):
+                parts.append(f"shed={serve['sheds']}")
         if mesh:
             # Sharded-loop entries (bench --loop=sharded) lead with the mesh
             # geometry the number was captured on.

@@ -109,6 +109,8 @@ HEALTH_CHECKS: dict[str, str] = {
     "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
     "worker.dead": "a worker's health snapshot went stale past its report interval",
     "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
+    "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
+    "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
 }
 
 #: Finding severities, mildest first. CRITICAL findings are additionally
@@ -130,6 +132,8 @@ CHECK_SEVERITIES: dict[str, str] = {
     "gp.ladder_escalation": "WARNING",
     "worker.dead": "CRITICAL",
     "shard.imbalance": "WARNING",
+    "service.backpressure": "WARNING",
+    "service.ready_queue_starved": "WARNING",
 }
 
 #: Study system-attr namespace the reporter publishes under; one attr per
@@ -162,12 +166,15 @@ DUPLICATE_RATE = 0.25  # exact-duplicate completed trials per completed trial
 DUPLICATE_MIN = 4
 SHARD_IMBALANCE_FACTOR = 2.0  # a shard this far below the median is lagging
 SHARD_IMBALANCE_MIN_TRIALS = 8  # ...once the BEST shard has done this much
+BACKPRESSURE_SHED_MIN = 3  # shed asks before the service is flagged overloaded
+READY_QUEUE_MISS_MIN = 8  # ready-queue misses before starvation can flag
+READY_QUEUE_MISS_RATE = 0.5  # ...and misses must be this share of lookups
 
 #: Gauge prefixes a worker snapshot carries (bounded: the device-stat,
 #: jit-label and mesh-coordinate vocabularies are small by construction;
 #: everything else — ad-hoc gauges like ``batch_size`` — stays
 #: process-local).
-_SNAPSHOT_GAUGE_PREFIXES = ("device.", "jit.", "hbm.", "shard.")
+_SNAPSHOT_GAUGE_PREFIXES = ("device.", "jit.", "hbm.", "shard.", "serve.")
 _PHASE_HISTOGRAM_PREFIX = "phase."
 
 
@@ -973,6 +980,68 @@ def _check_shard_imbalance(
     )
 
 
+def _check_backpressure(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    counters = fleet["counters"]
+    sheds = {
+        name[len("serve.shed."):]: value
+        for name, value in counters.items()
+        if name.startswith("serve.shed.")
+    }
+    total = sum(sheds.values())
+    if total < BACKPRESSURE_SHED_MIN:
+        return None
+    return HealthFinding(
+        check="service.backpressure",
+        severity=CHECK_SEVERITIES["service.backpressure"],
+        summary=(
+            f"the suggestion service shed {total} asks "
+            f"({', '.join(f'{k}: {sheds[k]}' for k in sorted(sheds))}): "
+            "the overload ladder is engaged"
+        ),
+        evidence={"sheds": {k: sheds[k] for k in sorted(sheds)}, "total": total},
+        remediation=(
+            "clients are arriving faster than the server can propose: raise "
+            "max_coalesce / ready_ahead on the service, add a second hub, or "
+            "slow the client ask rate; rejected clients honor retry-after, "
+            "so convergence is delayed, not lost"
+        ),
+    )
+
+
+def _check_ready_queue_starved(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    counters = fleet["counters"]
+    hits = counters.get("serve.ready_queue.hit", 0)
+    misses = counters.get("serve.ready_queue.miss", 0)
+    lookups = hits + misses
+    rate = misses / max(1, lookups)
+    if misses < READY_QUEUE_MISS_MIN or rate < READY_QUEUE_MISS_RATE:
+        return None
+    return HealthFinding(
+        check="service.ready_queue_starved",
+        severity=CHECK_SEVERITIES["service.ready_queue_starved"],
+        summary=(
+            f"{misses} of {lookups} asks missed the speculative ready queue "
+            f"({rate:.0%}): steady-state asks are paying full fit+propose latency"
+        ),
+        evidence={
+            "hits": hits,
+            "misses": misses,
+            "rate": round(rate, 3),
+            "refills": counters.get("serve.ready_queue.refill", 0),
+            "invalidations": counters.get("serve.ready_queue.invalidate", 0),
+        },
+        remediation=(
+            "the ask-ahead worker is not keeping up: raise ready_ahead, relax "
+            "invalidate_after (each invalidation stales a whole queue), or "
+            "check whether refill dispatches are starved of device time"
+        ),
+    )
+
+
 #: The rule table: one function per check id, keyed exactly by
 #: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py`` — a check in
 #: the vocabulary without a rule, or vice versa, is a test failure).
@@ -986,6 +1055,8 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "gp.ladder_escalation": _check_ladder_escalation,
     "worker.dead": _check_worker_dead,
     "shard.imbalance": _check_shard_imbalance,
+    "service.backpressure": _check_backpressure,
+    "service.ready_queue_starved": _check_ready_queue_starved,
 }
 
 _SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}

@@ -26,6 +26,7 @@ __all__ = [
     "QMCSampler",
     "RandomSampler",
     "TPESampler",
+    "ThinClientSampler",
 ]
 
 _LAZY = {
@@ -42,6 +43,10 @@ _LAZY = {
     "GridSampler": ("optuna_tpu.samplers._grid", "GridSampler"),
     "BruteForceSampler": ("optuna_tpu.samplers._brute_force", "BruteForceSampler"),
     "PartialFixedSampler": ("optuna_tpu.samplers._partial_fixed", "PartialFixedSampler"),
+    "ThinClientSampler": (
+        "optuna_tpu.storages._grpc.suggest_service",
+        "ThinClientSampler",
+    ),
 }
 
 

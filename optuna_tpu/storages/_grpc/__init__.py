@@ -1,4 +1,15 @@
 from optuna_tpu.storages._grpc.client import GrpcStorageProxy
 from optuna_tpu.storages._grpc.server import run_grpc_proxy_server
+from optuna_tpu.storages._grpc.suggest_service import (
+    ShedPolicy,
+    SuggestService,
+    ThinClientSampler,
+)
 
-__all__ = ["GrpcStorageProxy", "run_grpc_proxy_server"]
+__all__ = [
+    "GrpcStorageProxy",
+    "ShedPolicy",
+    "SuggestService",
+    "ThinClientSampler",
+    "run_grpc_proxy_server",
+]

@@ -88,6 +88,17 @@ METHODS = (
     "get_failed_trial_callback",
 )
 
+# The suggestion-service RPCs (ISSUE 13): dispatched to the server's mounted
+# SuggestService instead of the backing storage, and only accepted when one
+# is mounted — a storage-only hub answers them with the same 'Unknown
+# method' error as any bad name, which ThinClientSampler treats as a
+# permanent downgrade to local independent sampling (wire-compatible skew,
+# no WIRE_VERSION bump needed: the method namespace was already open).
+# ``service_ask`` always carries an OP_TOKEN_KEY kwarg: a transport-level
+# replay of an ask must return the recorded proposal, not pop a second
+# ready-queue entry or mint a second proposal for the same trial.
+SUGGEST_METHODS = ("service_ask",)
+
 # Exceptions allowed to re-materialize client-side, by name. Anything else
 # becomes a plain RuntimeError carrying the message — never an arbitrary
 # class lookup on attacker-controlled input.

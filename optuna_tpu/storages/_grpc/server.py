@@ -20,6 +20,7 @@ from optuna_tpu.storages._grpc._service import (
     METHODS,
     OP_TOKEN_KEY,
     SERVICE_NAME,
+    SUGGEST_METHODS,
     WireVersionError,
     decode_request,
     encode_response,
@@ -27,6 +28,8 @@ from optuna_tpu.storages._grpc._service import (
 
 if TYPE_CHECKING:
     import grpc
+
+    from optuna_tpu.storages._grpc.suggest_service import SuggestService
 
 _logger = get_logger(__name__)
 
@@ -36,7 +39,7 @@ _logger = get_logger(__name__)
 _OP_TOKEN_CACHE_SIZE = 8192
 
 
-def _make_handler(storage: BaseStorage):
+def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None" = None):
     import grpc
 
     _HEARTBEAT_DEFAULTS = {
@@ -63,7 +66,8 @@ def _make_handler(storage: BaseStorage):
             return encode_response(False, e)
         except Exception as e:  # graphlint: ignore[PY001] -- security boundary: malformed wire bytes of any flavor are rejected, the server never crashes on input
             return encode_response(False, ValueError(f"Malformed request: {e}"))
-        if method_name not in METHODS:
+        is_suggest = suggest_service is not None and method_name in SUGGEST_METHODS
+        if method_name not in METHODS and not is_suggest:
             return encode_response(False, ValueError(f"Unknown method {method_name!r}"))
         # Always stripped (the storage must never see the wire-plumbing
         # kwarg); only *used* when this server records flight events.
@@ -101,7 +105,8 @@ def _make_handler(storage: BaseStorage):
             # sent them), so client timeline and server timeline stitch into
             # one trace even across machines.
             with flight.rpc_span("server", method_name, flight_ctx):
-                result = getattr(storage, method_name)(*args, **kwargs)
+                target = suggest_service if is_suggest else storage
+                result = getattr(target, method_name)(*args, **kwargs)
             response = encode_response(True, result)
         except Exception as e:  # graphlint: ignore[PY001] -- exceptions ride the wire: every storage error is encoded and re-raised client-side, not handled here
             # Failures are NOT recorded: a retry after an app-level error
@@ -133,12 +138,20 @@ def _make_handler(storage: BaseStorage):
 
 
 def make_grpc_server(
-    storage: BaseStorage, host: str = "localhost", port: int = 13000, thread_pool_size: int = 10
+    storage: BaseStorage,
+    host: str = "localhost",
+    port: int = 13000,
+    thread_pool_size: int = 10,
+    suggest_service: "SuggestService | None" = None,
 ):
     import grpc
 
+    if suggest_service is not None:
+        # Tells flow through the service's observer so speculative ask-ahead
+        # refills on fresh evidence; suggest RPCs dispatch to the service.
+        storage = suggest_service.wrap_storage(storage)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=thread_pool_size))
-    server.add_generic_rpc_handlers((_make_handler(storage),))
+    server.add_generic_rpc_handlers((_make_handler(storage, suggest_service),))
     server.add_insecure_port(f"{host}:{port}")
     return server
 
@@ -151,6 +164,7 @@ def run_grpc_proxy_server(
     thread_pool_size: int = 10,
     drain_grace: float | None = 15.0,
     metrics_port: int | None = None,
+    suggest_service: "SuggestService | None" = None,
 ) -> None:
     """Blocking server entry point (reference ``server.py:38``).
 
@@ -175,7 +189,7 @@ def run_grpc_proxy_server(
 
     from optuna_tpu import health
 
-    server = make_grpc_server(storage, host, port, thread_pool_size)
+    server = make_grpc_server(storage, host, port, thread_pool_size, suggest_service)
     metrics_server = None
     if metrics_port is not None:
         telemetry.enable()
@@ -197,6 +211,11 @@ def run_grpc_proxy_server(
             f"Signal {signum}: draining (refusing new RPCs, "
             f"up to {drain_grace}s for in-flight calls)..."
         )
+        if suggest_service is not None:
+            # Flush the open coalesce window FIRST: askers parked mid-window
+            # get their batch dispatched and answered before the listener
+            # refuses new RPCs — a SIGTERM never strands a coalesced ask.
+            suggest_service.drain()
         server.stop(grace=drain_grace)
 
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -205,6 +224,8 @@ def run_grpc_proxy_server(
         except ValueError:
             pass  # not the main thread; caller owns signal handling
     server.wait_for_termination()
+    if suggest_service is not None:
+        suggest_service.close()
     if metrics_server is not None:
         metrics_server.shutdown()
     try:

@@ -90,6 +90,9 @@ PHASES: dict[str, str] = {
     "scan.chunk": "one HBM-resident scan-chunk dispatch (host side; the device run overlaps the previous chunk's sync)",
     "scan.sync": "chunk-boundary result wait + storage sync of a scan chunk's trials",
     "shard.exchange": "one pod-wide ICI-journal exchange point at a sharded batch boundary",
+    "serve.ask": "one suggestion-service ask served end to end (queue pop, shed rung, or coalesced dispatch)",
+    "serve.coalesce": "one fused proposal dispatch answering a whole coalesced ask batch",
+    "serve.ready_queue": "one speculative ask-ahead refill dispatch (background, off the RPC path)",
 }
 
 #: The containment-counter vocabulary: one entry per event family the
@@ -108,6 +111,8 @@ COUNTERS: dict[str, str] = {
     "executor.dispatch_timeout": "a device dispatch overran its deadline and was abandoned",
     "heartbeat.reap": "a stale (dead-worker) RUNNING trial was reaped to FAIL",
     "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
+    "serve.shed": "(suffixed by policy) an overloaded ask was degraded or refused by the shed ladder",
+    "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
 }
 
 _PHASE_METRIC_PREFIX = "phase."
@@ -346,7 +351,11 @@ def _prom_name(name: str) -> str:
 #: renders as a label, as ``{family prefix: label name}``. The counter side
 #: is exactly the ``(suffixed)`` families in :data:`COUNTERS`; the gauge
 #: side is the per-label jit instrumentation from :mod:`optuna_tpu.flight`.
-_LABELED_COUNTER_FAMILIES: dict[str, str] = {"sampler.fallback": "family"}
+_LABELED_COUNTER_FAMILIES: dict[str, str] = {
+    "sampler.fallback": "family",
+    "serve.shed": "policy",
+    "serve.ready_queue": "event",
+}
 _LABELED_GAUGE_FAMILIES: dict[str, str] = {
     "jit.compiles": "label",
     "jit.compile_seconds": "label",

@@ -331,6 +331,10 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "worker leaves); liveness derives dead from snapshot age vs interval",
     "shard.imbalance": "publish shard.trials.<coord> throughput gauges with one shard >= 2x "
     "below the mesh median; the lagging coordinate is named, the balanced twin stays clean",
+    "service.backpressure": "force the suggestion service's shed ladder with an overload "
+    "burst (ServiceChaosPlan); the doctor reports the exact per-policy shed counts",
+    "service.ready_queue_starved": "drive asks with ask-ahead disabled (or perpetually "
+    "invalidated); the miss rate crosses the starvation threshold, the speculating twin stays clean",
 }
 
 
@@ -419,6 +423,81 @@ def plant_dead_worker(
         study._study_id, WORKER_ATTR_PREFIX + worker_id, snapshot
     )
     return snapshot
+
+
+# ------------------------------------------------------ suggestion-service chaos
+
+
+# Chaos matrix for the suggestion service's load-shedding ladder: every rung
+# the service can answer an ask with (``storages/_grpc/suggest_service.py::
+# SHED_POLICIES``) maps to the overload scenario the chaos suite must force.
+# Deliberately a hand-written literal (not an import of ``SHED_POLICIES``):
+# graphlint rule SRV001 cross-checks both against ``_lint/registry.py::
+# SHED_POLICY_REGISTRY`` — adding a shed rung without an overload scenario
+# that provably forces it is a lint failure (the STO001/EXE001/SMP001
+# pattern), because an untested rung drops asks under exactly the load that
+# makes the drop hardest to debug.
+SHED_CHAOS_POLICIES: dict[str, str] = {
+    "stale_queue": "invalidate the ready queue, then overload past the degrade depth; the "
+    "stale proposals are served and counted, and the trials still complete",
+    "independent": "overload past the independent depth with an empty queue; clients get "
+    "empty relative proposals and converge via local independent sampling",
+    "reject": "overload past the reject depth; the response carries RESOURCE_EXHAUSTED + "
+    "retry-after, clients back off and converge, every shed is counted",
+}
+
+
+@dataclass(frozen=True)
+class ServiceChaosPlan:
+    """One deterministic suggestion-service chaos scenario: slow-tell thin
+    clients, a poison server-resident sampler (raise + NaN proposals via
+    :class:`FaultySampler` under ``GuardedSampler``), and a forced overload
+    burst — all against ONE study — plus the exact outcome the acceptance
+    test asserts (``tests/test_suggest_service.py``): server-side degrades
+    carry ``sampler_fallback:`` attrs visible to clients, every shed is
+    counted per rung exactly, shed responses carry retry-after and clients
+    converge, zero trials stay RUNNING after drain, and the fault-free twin
+    (ask-ahead off, width-1 asks) is bit-identical to a local-sampler study
+    on the same seed.
+
+    The burst is made deterministic by forcing the policy, not by racing
+    threads: ``burst_asks`` sequential asks run under a ``reject_depth=0``
+    policy (every ask sheds exactly once; clients are configured with zero
+    shed retries so counters equal the plan), then the policy is restored
+    and the same clients converge.
+    """
+
+    n_clients: int = 4
+    n_trials: int = 24
+    n_startup_trials: int = 4
+    seed: int = 7
+    slow_tell_s: float = 0.01
+    # FaultySampler schedule over the server-resident sampler's relative
+    # suggests: one raise + two NaN proposals — each degrades server-side.
+    sampler_raise_at: tuple[int, ...] = (1,)
+    sampler_nan_at: tuple[int, ...] = (2, 3)
+    burst_asks: int = 5
+    stale_burst_asks: int = 2
+    independent_burst_asks: int = 3
+
+    @property
+    def expected_sheds(self) -> dict[str, int]:
+        return {
+            "reject": self.burst_asks,
+            "stale_queue": self.stale_burst_asks,
+            "independent": self.independent_burst_asks,
+        }
+
+    @property
+    def expected_fallbacks(self) -> int:
+        return len(self.sampler_raise_at) + len(self.sampler_nan_at)
+
+
+def service_chaos_plan() -> ServiceChaosPlan:
+    """The default :class:`ServiceChaosPlan` the chaos suite runs — four
+    slow-tell clients, three server-side sampler faults, a five-ask reject
+    burst plus forced stale/independent rungs."""
+    return ServiceChaosPlan()
 
 
 # ------------------------------------------------------------- pod-bus chaos

@@ -1,0 +1,5 @@
+# graphlint fixture: SRV001 negative — both copies agree with the registry.
+SHED_CHAOS_POLICIES = {
+    "stale_queue": "overload past the degrade depth with a stale queue on hand",
+    "reject": "overload past the reject depth; the response carries retry-after",
+}

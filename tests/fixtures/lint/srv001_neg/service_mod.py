@@ -1,0 +1,5 @@
+# graphlint fixture: SRV001 negative — both copies agree with the registry.
+SHED_POLICIES = {
+    "stale_queue": "serve a stale proposal",
+    "reject": "refuse with retry-after",
+}

@@ -1,0 +1,2 @@
+# graphlint fixture: SRV001 — this copy DRIFTED: 'reject' is missing.
+SHED_CHAOS_POLICIES = {"stale_queue": "force the rung"}  # EXPECT: SRV001

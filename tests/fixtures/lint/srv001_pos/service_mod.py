@@ -1,0 +1,6 @@
+# graphlint fixture: SRV001 — this copy DRIFTED: 'vaporize' is extra.
+SHED_POLICIES = {  # EXPECT: SRV001
+    "stale_queue": "serve a stale proposal",
+    "reject": "refuse with retry-after",
+    "vaporize": "made-up rung",
+}

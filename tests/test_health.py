@@ -574,6 +574,17 @@ def _trajectory_file(tmp_path):
                 "metric": "tpe", "mode": "quick", "platform": "cpu",
                 "value": None, "partial": True,
             },
+            {
+                "round": "local-4", "captured": "2026-08-04T00:00:00",
+                "metric": "serve_asks_per_sec_tpe_64clients", "mode": "quick",
+                "platform": "cpu", "value": 432.1, "unit": "asks/s",
+                "serve": {
+                    "n_clients": 64, "serve_ask_p99_ms": 2.16,
+                    "single_client_ask_ms": 23.4, "ready_queue_hits": 250,
+                    "ready_queue_misses": 6, "coalesce_width_max": 48,
+                    "sheds": 0,
+                },
+            },
         ],
     }
     path = tmp_path / "BENCH_TRAJECTORY.json"
@@ -590,11 +601,17 @@ def test_trajectory_cli_table_and_json(tmp_path, capsys):
     assert "rung=2 fit=120 quar=1" in table  # device_stats condensed
     assert "123456789*" in table  # short sha + dirty marker
     assert "partial" in table
+    # Serve-loop entries condense the latency contract + queue health
+    # (bench --loop=serve, ISSUE 13).
+    assert "p99=2.16ms/1cl=23.4ms q=250/6 w=48" in table
 
     assert cli_main(["trajectory", "--path", path, "-f", "json"]) == 0
     payload = json.loads(capsys.readouterr().out)
-    assert [e["round"] for e in payload["entries"]] == ["r03", "r04", "r05"]
+    assert [e["round"] for e in payload["entries"]] == [
+        "r03", "r04", "r05", "local-4",
+    ]
     assert payload["entries"][1]["device_stats"]["fit_iterations"] == 120
+    assert payload["entries"][3]["serve"]["serve_ask_p99_ms"] == 2.16
 
     # --metric filters to one bench metric (the claw-back hunt's slice).
     assert cli_main(

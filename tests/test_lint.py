@@ -155,6 +155,66 @@ def test_smp001_gate_rejects_drift():
     assert all("shrug" in f.message for f in drifted)
 
 
+def test_srv001_registry_matches_runtime_sets():
+    """The canonical shed-policy registry equals the *runtime* values of
+    both hand-written copies (the lint compares them statically)."""
+    from optuna_tpu.storages._grpc.suggest_service import SHED_POLICIES
+    from optuna_tpu.testing.fault_injection import SHED_CHAOS_POLICIES
+
+    canonical = set(lint_registry.SHED_POLICY_REGISTRY)
+    assert set(SHED_POLICIES) == canonical
+    assert set(SHED_CHAOS_POLICIES) == canonical
+
+
+def test_srv001_gate_rejects_drift():
+    """Point SRV001 at the real files with a registry containing a rung the
+    code does not know: both copies must be reported as drifted — adding a
+    shed rung without an overload scenario forcing it is a lint failure."""
+    fat_registry = dict(lint_registry.SHED_POLICY_REGISTRY)
+    fat_registry["vaporize"] = "made-up rung to prove the check is live"
+    config = Config(srv001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.srv001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "SRV001"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("vaporize" in f.message for f in drifted)
+
+
+_SRV001_FIXTURE_REGISTRY = {
+    "stale_queue": "serve a stale proposal",
+    "reject": "refuse with retry-after",
+}
+
+
+def _srv001_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        srv001_registry=_SRV001_FIXTURE_REGISTRY,
+        srv001_targets=(
+            (f"fixtures/lint/{tree}/service_mod.py", "SHED_POLICIES", "ladder rungs"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "SHED_CHAOS_POLICIES", "chaos"),
+        ),
+    )
+
+
+def test_srv001_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "srv001_pos")
+    result = run_lint([tree], _srv001_config("srv001_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "vaporize" in by_file["service_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_srv001_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "srv001_neg")
+    result = run_lint([tree], _srv001_config("srv001_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 def test_obs002_registry_matches_runtime_sets():
     """The canonical flight event-kind registry equals the *runtime* values
     of both hand-written copies (the lint compares them statically)."""

@@ -1,0 +1,340 @@
+"""Suggestion-service chaos acceptance (ISSUE 13 / ServiceChaosPlan).
+
+ONE study absorbs slow-tell thin clients + a poison server-resident sampler
+(raise/NaN via FaultySampler) + a forced overload burst: GuardedSampler
+degrades server-side with fallback attrs visible to clients, every shed is
+counted per rung exactly, shed responses carry retry-after and clients
+converge, zero trials stay RUNNING after drain, and the doctor reports
+``service.backpressure`` with the plan's evidence counts exactly. The
+fault-free twin (ask-ahead off, sequential width-1 asks) is bit-identical
+to a local-sampler study on the same seed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import types
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import health, telemetry
+from optuna_tpu.samplers import TPESampler
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.storages._grpc import _service as wire
+from optuna_tpu.storages._grpc.server import _make_handler
+from optuna_tpu.storages._grpc.suggest_service import (
+    ShedPolicy,
+    SuggestService,
+    ThinClientSampler,
+)
+from optuna_tpu.testing.fault_injection import (
+    SHED_CHAOS_POLICIES,
+    FaultySampler,
+    ServiceChaosPlan,
+    service_chaos_plan,
+)
+from optuna_tpu.trial._state import TrialState
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability():
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    health_was = health.enabled()
+    health.enable(interval_s=0.0)
+    yield
+    health.disable()
+    if health_was:
+        health.enable()
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _objective(trial) -> float:
+    x = trial.suggest_float("x", -5.0, 5.0)
+    y = trial.suggest_float("y", -5.0, 5.0)
+    return (x - 1.0) ** 2 + (y + 2.0) ** 2
+
+
+def _mount(storage, service):
+    mounted = service.wrap_storage(storage)
+    handler = _make_handler(mounted, service)
+    method_handler = handler.service(
+        types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/x")
+    )
+
+    def rpc(method, *args, **kwargs):
+        ok, payload = wire.decode_response(
+            method_handler.unary_unary(wire.encode_request(method, args, kwargs), None)
+        )
+        if not ok:
+            raise payload
+        return payload
+
+    return mounted, rpc
+
+
+def _thin(rpc, **kwargs):
+    def ask(study_id, trial_id, number, token):
+        return rpc(
+            "service_ask", study_id, trial_id, number, **{wire.OP_TOKEN_KEY: token}
+        )
+
+    return ThinClientSampler(ask, **kwargs)
+
+
+def test_shed_chaos_matrix_covers_every_policy():
+    from optuna_tpu.storages._grpc.suggest_service import SHED_POLICIES
+
+    assert set(SHED_CHAOS_POLICIES) == set(SHED_POLICIES)
+
+
+def test_service_chaos_acceptance():
+    plan = service_chaos_plan()
+    storage = InMemoryStorage()
+    faulty = FaultySampler(
+        TPESampler(multivariate=True, n_startup_trials=plan.n_startup_trials,
+                   seed=plan.seed),
+        raise_at=plan.sampler_raise_at,
+        nan_at=plan.sampler_nan_at,
+        force_relative=True,
+    )
+    service = SuggestService(
+        storage,
+        lambda: faulty,
+        ready_ahead=0,  # every post-startup ask walks the faulty relative path
+        coalesce_window_s=0.002,
+        max_stale_epochs=0,  # strict staleness: the rung evidence is exact
+    )
+    mounted, rpc = _mount(storage, service)
+    try:
+        optuna_tpu.create_study(
+            storage=mounted, study_name="chaos", direction="minimize"
+        )
+        sid = storage.get_study_id_from_name("chaos")
+
+        # ---- phase 1: slow-tell clients drive the study through the faults
+        per_client = plan.n_trials // plan.n_clients
+        errors: list[BaseException] = []
+
+        def client(seed):
+            try:
+                sampler = _thin(rpc, seed=seed)
+                study = optuna_tpu.load_study(
+                    study_name="chaos", storage=mounted, sampler=sampler
+                )
+                for _ in range(per_client):
+                    trial = study.ask()
+                    value = _objective(trial)
+                    time.sleep(plan.slow_tell_s)
+                    study.tell(trial, value)
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append(err)
+
+        threads = [
+            threading.Thread(target=client, args=(200 + i,))
+            for i in range(plan.n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        study = optuna_tpu.load_study(study_name="chaos", storage=mounted)
+        trials = study.trials
+        assert len(trials) == plan.n_trials
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+        assert all(set(t.params) == {"x", "y"} for t in trials)
+
+        # The server-side degrades are visible to clients: fallback attrs on
+        # exactly the faulted suggests' trials (raise + NaN proposals), and
+        # counted on the one telemetry vocabulary.
+        flagged = [
+            t
+            for t in trials
+            if any(k.startswith("sampler_fallback:") for k in t.system_attrs)
+        ]
+        assert len(flagged) == plan.expected_fallbacks
+        counters = telemetry.snapshot()["counters"]
+        fallback_total = sum(
+            v for k, v in counters.items() if k.startswith("sampler.fallback")
+        )
+        assert fallback_total == plan.expected_fallbacks
+
+        # ---- phase 2: deterministic overload burst, rung by rung
+        telemetry_before = dict(telemetry.snapshot()["counters"])
+
+        # reject rung: every ask sheds exactly once (clients retry 0 times),
+        # the response carries retry-after, and the trial still converges.
+        service.shed_policy = ShedPolicy(
+            degrade_depth=0, independent_depth=0, reject_depth=1, retry_after_s=0.001
+        )
+        sleeps: list[float] = []
+        burst_sampler = _thin(rpc, seed=999, max_shed_retries=0, sleep=sleeps.append)
+        burst_study = optuna_tpu.load_study(
+            study_name="chaos", storage=mounted, sampler=burst_sampler
+        )
+        for _ in range(plan.burst_asks):
+            trial = burst_study.ask()
+            burst_study.tell(trial, _objective(trial))
+        assert burst_sampler.sheds_seen == plan.burst_asks
+
+        # stale-queue rung: a queue invalidated by fresh evidence still
+        # serves its retained proposals under overload. The poison sampler
+        # has no batch hook, so the queue is stocked deterministically with
+        # known proposals, then invalidated (the posterior "moved").
+        from optuna_tpu.distributions import FloatDistribution, distribution_to_json
+        from optuna_tpu.storages._grpc.suggest_service import _ReadyEntry
+
+        dists = {
+            name: distribution_to_json(FloatDistribution(-5.0, 5.0))
+            for name in ("x", "y")
+        }
+        handle = service._handle(sid)
+        handle.queue.refill(
+            [
+                _ReadyEntry({"x": 0.25 * i, "y": -0.5 * i}, dists, handle.queue.epoch)
+                for i in range(1, plan.stale_burst_asks + 1)
+            ]
+        )
+        handle.queue.invalidate()
+        service.shed_policy = ShedPolicy(
+            degrade_depth=0, independent_depth=64, reject_depth=128
+        )
+        stale_sampler = _thin(rpc, seed=998)
+        stale_study = optuna_tpu.load_study(
+            study_name="chaos", storage=mounted, sampler=stale_sampler
+        )
+        for _ in range(plan.stale_burst_asks):
+            trial = stale_study.ask()
+            stale_study.tell(trial, _objective(trial))
+        assert list(stale_sampler.served_sources)[-plan.stale_burst_asks:] == (
+            ["stale_queue"] * plan.stale_burst_asks
+        )
+
+        # independent rung: an empty queue under the same pressure serves
+        # empty relative proposals; clients converge locally.
+        handle.queue.refill([])
+        service.ready_ahead = 0
+        service.shed_policy = ShedPolicy(
+            degrade_depth=0, independent_depth=1, reject_depth=128
+        )
+        indep_sampler = _thin(rpc, seed=997)
+        indep_study = optuna_tpu.load_study(
+            study_name="chaos", storage=mounted, sampler=indep_sampler
+        )
+        for _ in range(plan.independent_burst_asks):
+            trial = indep_study.ask()
+            indep_study.tell(trial, _objective(trial))
+
+        counters = telemetry.snapshot()["counters"]
+        sheds = {
+            name[len("serve.shed."):]: value
+            - telemetry_before.get(name, 0)
+            for name, value in counters.items()
+            if name.startswith("serve.shed.")
+        }
+        assert sheds == plan.expected_sheds  # every shed counted, exactly
+
+        # ---- the doctor sees it, with the plan's evidence counts exactly
+        report = study.health_report()
+        findings = {f["check"]: f for f in report["findings"]}
+        assert "service.backpressure" in findings
+        assert findings["service.backpressure"]["evidence"]["sheds"] == (
+            plan.expected_sheds
+        )
+        assert findings["service.backpressure"]["evidence"]["total"] == sum(
+            plan.expected_sheds.values()
+        )
+
+        # ---- drain: zero RUNNING strands, the study never aborted
+        service.drain()
+        final = optuna_tpu.load_study(study_name="chaos", storage=mounted).trials
+        assert sum(1 for t in final if t.state == TrialState.RUNNING) == 0
+        assert all(t.state == TrialState.COMPLETE for t in final)
+    finally:
+        service.close()
+
+
+def test_fault_free_twin_is_bit_identical_to_local_asks():
+    """The chaos plan's fault-free twin: a sequential thin client against a
+    clean service (ask-ahead off, width-1 asks) reproduces the local
+    sampler's draw sequence bit for bit, with zero containment counters."""
+    plan = ServiceChaosPlan()
+
+    def sampler():
+        return TPESampler(
+            multivariate=True, n_startup_trials=plan.n_startup_trials, seed=plan.seed
+        )
+
+    local_storage = InMemoryStorage()
+    optuna_tpu.create_study(
+        storage=local_storage, study_name="twin", direction="minimize"
+    )
+    local = optuna_tpu.load_study(
+        study_name="twin", storage=local_storage, sampler=sampler()
+    )
+    for _ in range(12):
+        trial = local.ask()
+        local.tell(trial, _objective(trial))
+
+    storage = InMemoryStorage()
+    service = SuggestService(
+        storage, sampler, ready_ahead=0, health_reporting=False
+    )
+    mounted, rpc = _mount(storage, service)
+    try:
+        optuna_tpu.create_study(
+            storage=mounted, study_name="twin", direction="minimize"
+        )
+        served = optuna_tpu.load_study(
+            study_name="twin", storage=mounted, sampler=_thin(rpc, seed=plan.seed)
+        )
+        for _ in range(12):
+            trial = served.ask()
+            served.tell(trial, _objective(trial))
+        for ours, ref in zip(served.trials, local.trials):
+            assert ours.params == ref.params
+            assert ours.values == ref.values
+            assert ours.state == ref.state == TrialState.COMPLETE
+        counters = telemetry.snapshot()["counters"]
+        assert not any(k.startswith("sampler.fallback") for k in counters)
+        assert not any(k.startswith("serve.shed") for k in counters)
+    finally:
+        service.close()
+
+
+def test_ready_queue_starvation_fires_the_doctor_and_speculating_twin_clean():
+    """The service.ready_queue_starved chaos row: asks that keep missing the
+    speculative queue cross the starvation threshold through the fleet
+    channel; a healthy hit pattern stays clean."""
+    from optuna_tpu.health import HealthReporter
+
+    def run(hits: int, misses: int):
+        storage = InMemoryStorage()
+        study = optuna_tpu.create_study(
+            storage=storage, study_name="q", direction="minimize"
+        )
+        telemetry.enable(telemetry.MetricsRegistry())
+        reporter = HealthReporter(study, worker_id="w-serve")
+        for _ in range(hits):
+            telemetry.count("serve.ready_queue.hit")
+        for _ in range(misses):
+            telemetry.count("serve.ready_queue.miss")
+        assert reporter.publish() is not None
+        return study.health_report()
+
+    starved = run(hits=2, misses=10)
+    assert "service.ready_queue_starved" in {
+        f["check"] for f in starved["findings"]
+    }
+    healthy = run(hits=20, misses=4)
+    assert "service.ready_queue_starved" not in {
+        f["check"] for f in healthy["findings"]
+    }

@@ -1,0 +1,714 @@
+"""Batched suggestion service (ISSUE 13): coalesced ask, speculative
+ask-ahead, load shedding, and the thin-client contract.
+
+The centerpiece proofs:
+
+* a burst of B concurrent asks triggers exactly ONE fused fit+propose
+  dispatch (phase counters) and yields B *distinct* proposals;
+* a steady-state ask is a ready-queue pop (no proposal dispatch at all);
+* the shed ladder answers overload down explicit rungs, each counted, with
+  ``reject`` carrying ``RESOURCE_EXHAUSTED`` + retry-after;
+* a thin client's trials are logically identical to local-sampler trials —
+  params under distributions, fallback-attr round-trip, exactly-once under
+  op-token replay — against in-memory, RDB, and journal backing storages.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import types
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import telemetry
+from optuna_tpu.samplers import RandomSampler, TPESampler
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.storages._grpc import _service as wire
+from optuna_tpu.storages._grpc.server import _make_handler
+from optuna_tpu.storages._grpc.suggest_service import (
+    SHED_POLICIES,
+    ShedPolicy,
+    SuggestService,
+    ThinClientSampler,
+    _AskCoalescer,
+    _PendingAsk,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE_SEED = 11
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry():
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _tpe_factory(seed: int = SPACE_SEED, n_startup: int = 4):
+    return lambda: TPESampler(multivariate=True, n_startup_trials=n_startup, seed=seed)
+
+
+def _objective(trial) -> float:
+    x = trial.suggest_float("x", -5.0, 5.0)
+    y = trial.suggest_float("y", -5.0, 5.0)
+    return (x - 1.0) ** 2 + (y + 2.0) ** 2
+
+
+def _mount(storage, service):
+    """Handler-direct mounting (no network): the exact server code path,
+    deterministic in tests."""
+    mounted = service.wrap_storage(storage)
+    handler = _make_handler(mounted, service)
+    method_handler = handler.service(
+        types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/x")
+    )
+
+    def rpc_bytes(request: bytes) -> bytes:
+        return method_handler.unary_unary(request, None)
+
+    def rpc(method, *args, **kwargs):
+        ok, payload = wire.decode_response(
+            rpc_bytes(wire.encode_request(method, args, kwargs))
+        )
+        if not ok:
+            raise payload
+        return payload
+
+    return mounted, rpc, rpc_bytes
+
+
+def _thin_ask(rpc):
+    def ask(study_id, trial_id, number, token):
+        return rpc(
+            "service_ask", study_id, trial_id, number, **{wire.OP_TOKEN_KEY: token}
+        )
+
+    return ask
+
+
+def _serve_stack(storage, *, study_name="served", direction="minimize", **service_kwargs):
+    service_kwargs.setdefault("health_reporting", False)
+    service = SuggestService(storage, _tpe_factory(), **service_kwargs)
+    mounted, rpc, rpc_bytes = _mount(storage, service)
+    optuna_tpu.create_study(
+        storage=mounted, study_name=study_name, direction=direction,
+        load_if_exists=True,
+    )
+    return service, mounted, rpc, rpc_bytes
+
+
+def _client_study(mounted, rpc, *, study_name="served", seed=5, **sampler_kwargs):
+    sampler = ThinClientSampler(_thin_ask(rpc), seed=seed, **sampler_kwargs)
+    study = optuna_tpu.load_study(
+        study_name=study_name, storage=mounted, sampler=sampler
+    )
+    return study, sampler
+
+
+def _run_trials(study, n):
+    for _ in range(n):
+        trial = study.ask()
+        study.tell(trial, _objective(trial))
+
+
+# ---------------------------------------------------------------- coalescing
+
+
+def test_burst_of_asks_coalesces_into_one_dispatch_with_distinct_proposals():
+    """THE coalescing proof: B concurrent asks -> exactly one fused
+    fit+propose dispatch (phase counters), B distinct proposals, no
+    duplicate-proposal doctor finding on the fault-free path."""
+    storage = InMemoryStorage()
+    B = 6
+    service, mounted, rpc, _ = _serve_stack(
+        storage, ready_ahead=0, coalesce_window_s=5.0, max_coalesce=B
+    )
+    try:
+        # Seed past startup so the batch hook actually fits.
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        telemetry.reset()
+
+        results: list[dict] = []
+        errors: list[BaseException] = []
+
+        def one_client(seed):
+            try:
+                study, _ = _client_study(mounted, rpc, seed=seed)
+                trial = study.ask()
+                study.tell(trial, _objective(trial))
+                results.append(trial.params)
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append(err)
+
+        threads = [
+            threading.Thread(target=one_client, args=(100 + i,)) for i in range(B)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        snap = telemetry.snapshot()
+        phase_counts = {
+            name: hist["count"] for name, hist in snap["histograms"].items()
+        }
+        assert phase_counts.get("phase.serve.ask") == B
+        # One fused dispatch answered the whole burst.
+        assert phase_counts.get("phase.serve.coalesce") == 1
+        assert snap["gauges"].get("serve.coalesce.width.last") == B
+        # ...and the B proposals are distinct points.
+        assert len(results) == B
+        distinct = {tuple(sorted(p.items())) for p in results}
+        assert len(distinct) == B
+        # No duplicate-proposal finding on the fault-free path.
+        from optuna_tpu import health
+
+        report = health.health_report(storage, storage.get_study_id_from_name("served"))
+        assert "sampler.duplicate_proposals" not in {
+            f["check"] for f in report["findings"]
+        }
+        assert "service.backpressure" in report["checks_evaluated"]
+        assert "service.ready_queue_starved" in report["checks_evaluated"]
+    finally:
+        service.close()
+
+
+def test_coalesce_window_clock_is_injectable():
+    """The window honors the injected clock (the RetryPolicy contract): a
+    fake clock that jumps past the window flushes a lone ask immediately,
+    without real waiting."""
+    clock_calls = []
+
+    def fake_clock():
+        # Each call jumps a full minute: the 100s logical window expires
+        # after two reads without any real time passing.
+        clock_calls.append(None)
+        return 60.0 * len(clock_calls)
+
+    coalescer = _AskCoalescer(window_s=100.0, max_batch=8, clock=fake_clock)
+    dispatched: list[list[_PendingAsk]] = []
+
+    def dispatch(batch):
+        dispatched.append(batch)
+        for item in batch:
+            item.params = {"x": 1.0}
+
+    start = time.monotonic()
+    item = coalescer.submit(_PendingAsk(1, 0), dispatch)
+    assert time.monotonic() - start < 5.0  # no real 1e9-second window
+    assert item.params == {"x": 1.0}
+    assert [len(b) for b in dispatched] == [1]
+    assert len(clock_calls) >= 2  # deadline mint + at least one expiry check
+
+
+def test_collect_caps_a_backed_up_window_at_max_batch():
+    """Asks that piled up past max_batch while a dispatch was in flight are
+    split across leader rounds, never dispatched as one over-wide batch —
+    an over-wide width would fall outside the power-of-two ladder prewarm
+    compiled and pay a fresh XLA compile on the hot path."""
+    coalescer = _AskCoalescer(window_s=0.0, max_batch=2)
+    backlog = [_PendingAsk(i, i) for i in range(5)]
+    with coalescer._cond:
+        coalescer._pending.extend(backlog)
+    widths: list[int] = []
+
+    def dispatch(batch):
+        widths.append(len(batch))
+        for item in batch:
+            item.params = {"x": 1.0}
+
+    late = coalescer.submit(_PendingAsk(5, 5), dispatch)
+    assert late.params == {"x": 1.0}
+    assert all(w <= 2 for w in widths), widths
+    assert sum(widths) == 6
+    for item in backlog:
+        assert item.done.is_set() and item.params == {"x": 1.0}
+
+
+def test_drain_flushes_the_open_window_and_sheds_new_asks():
+    """SIGTERM contract: a drain mid-window dispatches the parked batch
+    immediately; asks arriving after the drain are shed with retry-after."""
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage, ready_ahead=0, coalesce_window_s=600.0, max_coalesce=8
+    )
+    try:
+        sid = storage.get_study_id_from_name("served")
+        parked = {}
+
+        def parked_ask():
+            trial_id = storage.create_new_trial(sid)
+            parked["resp"] = rpc("service_ask", sid, trial_id, 99)
+
+        thread = threading.Thread(target=parked_ask)
+        thread.start()
+        # Wait until the ask is actually parked in the window.
+        deadline = time.monotonic() + 10.0
+        while service.state()["coalescer_depth"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert service.state()["coalescer_depth"] == 1
+        service.drain()
+        thread.join(timeout=30.0)
+        assert not thread.is_alive()
+        # The parked ask was answered (served, not shed or dropped) — at
+        # startup the proposal is legitimately empty (independent path).
+        assert parked["resp"]["shed"] is None
+        assert parked["resp"]["source"] == "coalesced"
+
+        # A new ask during wind-down is refused with retry-after.
+        trial_id = storage.create_new_trial(sid)
+        resp = rpc("service_ask", sid, trial_id, 100)
+        assert resp["shed"] == "reject"
+        assert resp["status"] == "RESOURCE_EXHAUSTED"
+        assert resp["retry_after_s"] > 0
+    finally:
+        service.close()
+
+
+# --------------------------------------------------------------- ready queue
+
+
+def test_steady_state_ask_is_a_ready_queue_pop_with_no_dispatch():
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage, ready_ahead=4, invalidate_after=100
+    )
+    try:
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        sid = storage.get_study_id_from_name("served")
+        assert service.refill_now(sid) == 4
+        telemetry.reset()
+
+        study, sampler = _client_study(mounted, rpc, seed=2)
+        trial = study.ask()
+        study.tell(trial, _objective(trial))
+        assert sampler.served_sources[-1] == "ready_queue"
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("serve.ready_queue.hit") == 1
+        # The served ask itself paid for NO proposal dispatch.
+        phase_counts = {
+            name: hist["count"] for name, hist in snap["histograms"].items()
+        }
+        assert "phase.serve.coalesce" not in phase_counts
+        assert set(trial.params) == {"x", "y"}
+    finally:
+        service.close()
+
+
+def test_ready_queue_invalidates_after_enough_tells():
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage, ready_ahead=4, invalidate_after=2
+    )
+    try:
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        sid = storage.get_study_id_from_name("served")
+        service.refill_now(sid)
+        handle = service._handle(sid)
+        epoch_before = handle.queue.epoch
+        assert handle.queue.fresh_len() > 0
+        telemetry.reset()
+        # Two tells land -> the posterior moved -> the epoch bumps (the
+        # background worker may already be computing the replacement batch;
+        # the bump itself and its counter are the invalidation contract).
+        study, _ = _client_study(mounted, rpc, seed=3, max_shed_retries=0)
+        _run_trials(study, 2)
+        assert handle.queue.epoch > epoch_before
+        assert telemetry.snapshot()["counters"].get(
+            "serve.ready_queue.invalidate", 0
+        ) >= 1
+    finally:
+        service.close()
+
+
+def test_speculative_refills_are_demand_gated_and_demand_prioritized():
+    """Refill scheduling: tell-path (speculative) refills only run for
+    studies with ask evidence since their last fill, and ask-path requests
+    file in the demand queue the worker pops first. Before this, a retired
+    study's slower deep-history fit could head-of-line-block the one refill
+    thread against a live fleet's supply (the serve bench's warm-up study
+    starved phase-B refills into misses)."""
+    storage = InMemoryStorage()
+    # ready_ahead=0 during warm-up keeps every request path inert, so the
+    # background worker never starts and the request queues stay observable.
+    service, mounted, rpc, _ = _serve_stack(
+        storage, ready_ahead=0, invalidate_after=1, max_stale_epochs=10
+    )
+    try:
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        sid = storage.get_study_id_from_name("served")
+        service.ready_ahead = 4
+        # Pin the worker slot so requests park where the test can see them
+        # instead of being drained (close() joins the stand-in harmlessly).
+        service._refill_thread = types.SimpleNamespace(join=lambda timeout=None: None)
+        # The handle's queue was sized while ready_ahead was 0 (maxlen 2),
+        # so the refill holds 2 — exactly the low-water mark, which is all
+        # this test needs.
+        assert service.refill_now(sid) == 2
+        handle = service._handle(sid)
+        assert handle.asks_since_fill == 0
+
+        # Tells WITHOUT any ask since the fill: epochs bump (bookkeeping),
+        # but no speculative refill is requested — the study still holds
+        # its boundedly-stale fill and nobody is consuming it.
+        service.note_tell(0, TrialState.COMPLETE)
+        service.note_tell(0, TrialState.COMPLETE)
+        with service._refill_cond:
+            assert service._refill_needed == set()
+            assert service._refill_demand == set()
+
+        # A live consumer pops below the low-water mark: the request files
+        # in the DEMAND queue (popped ahead of every speculative request).
+        study, sampler = _client_study(mounted, rpc, seed=2)
+        study.ask()
+        assert sampler.served_sources[-1] == "ready_queue"
+        with service._refill_cond:
+            assert service._refill_demand == {sid}
+            assert service._refill_needed == set()
+
+        # With ask evidence on the books, tell-path speculation resumes —
+        # into the background queue, not the demand queue.
+        service.note_tell(0, TrialState.COMPLETE)
+        with service._refill_cond:
+            assert service._refill_needed == {sid}
+    finally:
+        service.close()
+
+
+# ------------------------------------------------------------- shed ladder
+
+
+def test_shed_policy_decide_walks_the_ladder():
+    policy = ShedPolicy(degrade_depth=4, independent_depth=8, reject_depth=16)
+    assert policy.decide(1, 0) is None
+    assert policy.decide(3, 5) is None
+    assert policy.decide(4, 5) == "stale_queue"
+    assert policy.decide(4, 0) is None  # nothing stale to serve: coalesce
+    assert policy.decide(8, 5) == "independent"
+    assert policy.decide(16, 5) == "reject"
+    # Vocabulary: every rung decide() can answer is registered.
+    assert {"stale_queue", "independent", "reject"} == set(SHED_POLICIES)
+    with pytest.raises(ValueError):
+        ShedPolicy(degrade_depth=10, independent_depth=5, reject_depth=20)
+
+
+def test_shed_policy_halves_thresholds_while_the_fleet_is_critical():
+    critical: list[str] = []
+    policy = ShedPolicy(
+        degrade_depth=8,
+        independent_depth=16,
+        reject_depth=32,
+        findings_source=lambda: critical,
+        findings_ttl_s=0.0,
+    )
+    assert policy.decide(16, 0) == "independent"
+    critical.append("worker.dead")
+    assert policy.decide(16, 0) == "reject"  # 32 -> 16 while drowning
+    assert policy.decide(8, 0) == "independent"
+
+
+def test_fleet_critical_refresh_never_blocks_concurrent_decides():
+    """The doctor feed can be a full storage scan: only ONE thread runs the
+    refresh (outside the policy lock), and every decide() arriving while it
+    is in flight reads the cached verdict instead of stalling — decide() is
+    on the path of every miss-path ask, under overload of all times."""
+    calls: list[int] = []
+
+    def source():
+        calls.append(1)
+        return ["worker.dead"]
+
+    policy = ShedPolicy(findings_source=source, findings_ttl_s=5.0)
+    assert policy.decide(1000, 0) == "reject"  # first decide refreshes
+    assert len(calls) == 1
+    # Another thread holds the refresh token with the cache expired: this
+    # thread must serve the cached CRITICAL verdict (halved thresholds)
+    # without running a second scan.
+    policy._findings_cached_at = None
+    policy._findings_refreshing = True
+    assert policy.decide(64, 0) == "reject"  # 128 halved from cache
+    assert len(calls) == 1
+    policy._findings_refreshing = False
+
+
+def test_coalesced_dispatch_serializes_with_refills_on_the_shared_sampler():
+    """_dispatch_batch holds handle.lock around the proposal dispatch: the
+    refill worker, prewarm, and the coalesced dispatch all drive the ONE
+    server-resident GuardedSampler, whose state (fit warm-starts, RNG,
+    last_batch_fallback_reason) is not safe under concurrent batch calls."""
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(storage, ready_ahead=0)
+    try:
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        sid = storage.get_study_id_from_name("served")
+        handle = service._handle(sid)
+
+        acquired: list[bool] = []
+        inner = threading.Lock()
+
+        class RecordingLock:
+            def __enter__(self):
+                inner.acquire()
+                acquired.append(True)
+
+            def __exit__(self, *exc):
+                inner.release()
+
+        handle.lock = RecordingLock()
+        trial_id = storage.create_new_trial(sid)
+        item = _PendingAsk(trial_id, 99)
+        service._dispatch_batch(handle, [item])
+        assert item.error is None and item.done.is_set()
+        assert set(item.params) == {"x", "y"}
+        assert acquired == [True]
+    finally:
+        service.close()
+
+
+def test_reject_shed_carries_retry_after_and_client_converges():
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage,
+        ready_ahead=0,
+        shed_policy=ShedPolicy(degrade_depth=0, independent_depth=0, reject_depth=1,
+                               retry_after_s=0.001),
+    )
+    try:
+        sleeps: list[float] = []
+        study, sampler = _client_study(
+            mounted, rpc, seed=2, max_shed_retries=2, sleep=sleeps.append
+        )
+        _run_trials(study, 3)
+        # Every ask was rejected; the client honored retry-after, then
+        # converged via the local independent path — the study never aborts.
+        assert sampler.sheds_seen >= 3
+        assert sleeps and all(s == 0.001 for s in sleeps)
+        assert all(t.state == TrialState.COMPLETE for t in study.trials)
+        assert all(set(t.params) == {"x", "y"} for t in study.trials)
+        assert telemetry.snapshot()["counters"]["serve.shed.reject"] >= 3
+    finally:
+        service.close()
+
+
+def test_stale_queue_shed_serves_retained_proposals():
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage, ready_ahead=4, invalidate_after=100, max_stale_epochs=0
+    )
+    try:
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        sid = storage.get_study_id_from_name("served")
+        service.refill_now(sid)
+        handle = service._handle(sid)
+        handle.queue.invalidate()  # strict mode: entries stale immediately
+        assert handle.queue.fresh_len() == 0 and handle.queue.stale_len() == 4
+        service.shed_policy = ShedPolicy(
+            degrade_depth=0, independent_depth=64, reject_depth=128
+        )
+        telemetry.reset()
+        study, sampler = _client_study(mounted, rpc, seed=2)
+        trial = study.ask()
+        study.tell(trial, _objective(trial))
+        assert sampler.served_sources[-1] == "stale_queue"
+        assert set(trial.params) == {"x", "y"}
+        assert telemetry.snapshot()["counters"]["serve.shed.stale_queue"] == 1
+    finally:
+        service.close()
+
+
+def test_independent_shed_serves_empty_relative_proposal():
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage,
+        ready_ahead=0,
+        shed_policy=ShedPolicy(degrade_depth=0, independent_depth=1, reject_depth=999),
+    )
+    try:
+        telemetry.reset()
+        study, sampler = _client_study(mounted, rpc, seed=2)
+        trial = study.ask()
+        study.tell(trial, _objective(trial))
+        assert sampler.served_sources[-1] == "independent"
+        assert study.trials[-1].state == TrialState.COMPLETE
+        assert set(study.trials[-1].params) == {"x", "y"}
+        assert telemetry.snapshot()["counters"]["serve.shed.independent"] == 1
+    finally:
+        service.close()
+
+
+# ---------------------------------------------------------- degrade + skew
+
+
+def test_thin_client_degrades_against_a_pre_service_server():
+    """A storage-only hub answers service_ask with 'Unknown method'; the
+    thin client downgrades permanently to local independent sampling and
+    the study still completes."""
+    storage = InMemoryStorage()
+    handler = _make_handler(storage)  # NO suggest service mounted
+    method_handler = handler.service(
+        types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/x")
+    )
+
+    def rpc(method, *args, **kwargs):
+        ok, payload = wire.decode_response(
+            method_handler.unary_unary(wire.encode_request(method, args, kwargs), None)
+        )
+        if not ok:
+            raise payload
+        return payload
+
+    optuna_tpu.create_study(storage=storage, study_name="plain", direction="minimize")
+    sampler = ThinClientSampler(_thin_ask(rpc), seed=5)
+    study = optuna_tpu.load_study(study_name="plain", storage=storage, sampler=sampler)
+    _run_trials(study, 4)
+    assert sampler._service_unsupported
+    assert all(t.state == TrialState.COMPLETE for t in study.trials)
+    assert all(set(t.params) == {"x", "y"} for t in study.trials)
+
+
+def test_service_ask_op_token_replay_is_exactly_once():
+    """A transport-level replay of the SAME encoded ask returns the recorded
+    proposal: one serve, one ready-queue pop, identical bytes."""
+    storage = InMemoryStorage()
+    service, mounted, rpc, rpc_bytes = _serve_stack(
+        storage, ready_ahead=4, invalidate_after=100
+    )
+    try:
+        warm, _ = _client_study(mounted, rpc, seed=1)
+        _run_trials(warm, 6)
+        sid = storage.get_study_id_from_name("served")
+        service.refill_now(sid)
+        depth_before = len(service._handle(sid).queue)
+        telemetry.reset()
+
+        trial_id = storage.create_new_trial(sid)
+        request = wire.encode_request(
+            "service_ask", (sid, trial_id, 0), {wire.OP_TOKEN_KEY: "ask-tok-1"}
+        )
+        first = rpc_bytes(request)
+        second = rpc_bytes(request)
+        assert first == second  # the recorded response replayed verbatim
+        ok, resp = wire.decode_response(first)
+        assert ok and set(resp["params"]) == {"x", "y"}
+        # Exactly one serve: one queue entry consumed, one ask span, and the
+        # replay was deduped.
+        assert len(service._handle(sid).queue) == depth_before - 1
+        snap = telemetry.snapshot()
+        assert snap["histograms"]["phase.serve.ask"]["count"] == 1
+        assert snap["counters"]["grpc.op_token_dedup"] == 1
+    finally:
+        service.close()
+
+
+# ----------------------------------------------------------------- contract
+
+
+def _local_twin_trials(storage_factory, n_trials):
+    storage = storage_factory()
+    optuna_tpu.create_study(
+        storage=storage, study_name="twin", direction="minimize"
+    )
+    study = optuna_tpu.load_study(
+        study_name="twin", storage=storage,
+        sampler=TPESampler(multivariate=True, n_startup_trials=4, seed=SPACE_SEED),
+    )
+    _run_trials(study, n_trials)
+    return study.trials
+
+
+@pytest.mark.parametrize("backend", ["inmemory", "rdb", "journal"])
+def test_thin_client_trials_identical_to_local_sampler(backend, tmp_path):
+    """The thin-client contract, against all three backing storages: a
+    sequential thin-client study is logically identical to the same seeded
+    sampler running locally — params under the same distributions, same
+    values, same states."""
+    def storage_factory():
+        if backend == "inmemory":
+            return InMemoryStorage()
+        if backend == "rdb":
+            import uuid as _uuid
+
+            from optuna_tpu.storages._rdb.storage import RDBStorage
+
+            return RDBStorage(f"sqlite:///{tmp_path}/{_uuid.uuid4().hex}.db")
+        from optuna_tpu.storages.journal import JournalFileBackend, JournalStorage
+        import uuid as _uuid
+
+        return JournalStorage(
+            JournalFileBackend(str(tmp_path / f"{_uuid.uuid4().hex}.log"))
+        )
+
+    n_trials = 10
+    expected = _local_twin_trials(storage_factory, n_trials)
+
+    storage = storage_factory()
+    # Width-1 deterministic-parity configuration: no speculation.
+    service = SuggestService(
+        storage, _tpe_factory(), ready_ahead=0, health_reporting=False
+    )
+    mounted, rpc, _ = _mount(storage, service)
+    try:
+        optuna_tpu.create_study(
+            storage=mounted, study_name="twin", direction="minimize"
+        )
+        study, sampler = _client_study(mounted, rpc, study_name="twin", seed=SPACE_SEED)
+        _run_trials(study, n_trials)
+        got = study.trials
+        assert len(got) == len(expected) == n_trials
+        for ours, ref in zip(got, expected):
+            assert ours.state == ref.state == TrialState.COMPLETE
+            assert ours.params == ref.params  # bit-identical draw sequence
+            assert ours.distributions == ref.distributions
+            assert ours.values == ref.values
+    finally:
+        service.close()
+
+
+def test_fallback_attr_roundtrips_to_the_client(tmp_path):
+    """A poisoned server-resident sampler degrades under GuardedSampler and
+    the ``sampler_fallback:`` system attr is visible client-side through the
+    storage — the trial completes on the independent path."""
+    from optuna_tpu.testing.fault_injection import FaultySampler
+
+    storage = InMemoryStorage()
+    faulty = FaultySampler(
+        RandomSampler(seed=3), raise_at=(0, 1, 2, 3, 4, 5), force_relative=True
+    )
+    service = SuggestService(
+        storage, lambda: faulty, ready_ahead=0, health_reporting=False
+    )
+    mounted, rpc, _ = _mount(storage, service)
+    try:
+        optuna_tpu.create_study(
+            storage=mounted, study_name="served", direction="minimize"
+        )
+        study, _ = _client_study(mounted, rpc, seed=5)
+        # First trials have no intersection space; later ones force the
+        # relative path and hit the injected raise.
+        _run_trials(study, 4)
+        assert faulty.suggests >= 1
+        flagged = [
+            t
+            for t in study.trials
+            if any(k.startswith("sampler_fallback:") for k in t.system_attrs)
+        ]
+        assert flagged, "expected served fallback attrs on degraded trials"
+        assert all(t.state == TrialState.COMPLETE for t in study.trials)
+        assert all(set(t.params) == {"x", "y"} for t in study.trials)
+    finally:
+        service.close()
