@@ -365,11 +365,17 @@ def run_ours_tpe_serve(
         coalesce_window_s=0.002,
         # The bench measures serving capacity at exactly n_clients, so the
         # ladder is sized to absorb that concurrency (shedding under it
-        # would measure the policy, not the server).
+        # would measure the policy, not the server), and the SLO feed is
+        # severed for the same reason: a default 5ms target burning on a
+        # slow CPU box would halve the thresholds mid-window and the
+        # committed number would measure the policy reacting, not the
+        # server serving. The sketch still records — see the slo block in
+        # the emitted detail.
         shed_policy=ShedPolicy(
             degrade_depth=n_clients,
             independent_depth=2 * n_clients,
             reject_depth=4 * n_clients,
+            slo_source=lambda: (),
         ),
         health_reporting=False,
     )
@@ -490,6 +496,13 @@ def run_ours_tpe_serve(
         return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
 
     _reset_phase_telemetry()
+    # Arm the SLO engine over the timed window (fresh engine, shipped
+    # objectives): the P² sketch's serve.ask p50/p99 land in the detail
+    # beside the wall-clock percentiles — the two must agree, and the
+    # trajectory's `sk99=`/`slo=` columns make a lying sketch visible.
+    from optuna_tpu import slo as _slo
+
+    _slo.enable(specs=_slo.DEFAULT_SLOS)
     # Phase A — saturation throughput: zero think time, the most adversarial
     # closed loop. The headline asks/s is the server's serving capacity; at
     # saturation tail latency is queueing-bound (Little's law), so the p99
@@ -515,6 +528,11 @@ def run_ours_tpe_serve(
 
     gauges = _telemetry.snapshot()["gauges"]
     counters = _telemetry.snapshot()["counters"]
+    slo_report = _slo.export_report()
+    _slo.disable()
+    serve_ask_slo = next(
+        (s for s in slo_report["slos"] if s["id"] == "serve.ask.latency"), None
+    )
     service.close()
     n_asks = n_clients * asks_per_client
     detail = {
@@ -534,6 +552,15 @@ def run_ours_tpe_serve(
         ),
         "best": round(min(best), 6),
     }
+    if serve_ask_slo is not None:
+        # The sketch-derived percentiles beside the wall-clock ones: the
+        # sketch sees every serve.ask span (both phases, server-side); the
+        # wall-clock lists are client-side and phase-scoped, so the numbers
+        # bracket rather than equal each other.
+        quantiles = serve_ask_slo.get("quantiles_s", {})
+        detail["sketch_p50_ms"] = round(1e3 * float(quantiles.get("0.5", 0.0)), 3)
+        detail["sketch_p99_ms"] = round(1e3 * float(quantiles.get("0.99", 0.0)), 3)
+        detail["slo"] = "burn" if slo_report.get("burning") else "ok"
     return n_asks / sat_wall, detail
 
 

@@ -16,6 +16,7 @@ OBS001   telemetry/flight/device-stats/logging call inside a jit trace of a devi
 OBS002   flight-recorder event vocabularies drifted from the canonical one
 OBS003   device-stat vocabularies drifted from the canonical one
 OBS004   study-doctor check vocabularies drifted from the canonical one
+OBS005   SLO objective vocabularies drifted from the canonical one
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 SRV001   suggestion-service shed policy sets drifted from the canonical one
@@ -51,6 +52,7 @@ def all_rules() -> list[Rule]:
         OBS002FlightEventSync,
         OBS003DeviceStatSync,
         OBS004HealthCheckSync,
+        OBS005SloRegistrySync,
         TPU001HostSyncInJit,
         TPU002RecompileHazard,
         TPU003DtypeDrift,
@@ -77,6 +79,7 @@ def all_rules() -> list[Rule]:
         OBS002FlightEventSync(),
         OBS003DeviceStatSync(),
         OBS004HealthCheckSync(),
+        OBS005SloRegistrySync(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
         SRV001ShedPolicySync(),

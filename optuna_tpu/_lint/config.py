@@ -97,6 +97,10 @@ class Config:
     obs004_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.HEALTH_CHECK_REGISTRY
     )
+    obs005_targets: tuple[tuple[str, str, str], ...] = registry.OBS005_TARGETS
+    obs005_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.SLO_REGISTRY
+    )
     srv001_targets: tuple[tuple[str, str, str], ...] = registry.SRV001_TARGETS
     srv001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.SHED_POLICY_REGISTRY

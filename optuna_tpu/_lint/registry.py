@@ -177,6 +177,7 @@ FLIGHT_EVENT_REGISTRY: dict[str, str] = {
     "jit.retrace": "a jit wrapper's cache grew after its first entry (runtime TPU002)",
     "gauge": "a sampled runtime device gauge (HBM high-water, cache sizes)",
     "postmortem": "the recorder tail was flushed to a bounded JSON dump",
+    "flow": "a causal flow-edge endpoint (fan-in to a coalesced dispatch / fan-out from a refill), rendered as a Perfetto flow arrow",
 }
 
 #: The hand-maintained copies OBS002 cross-checks, as
@@ -254,6 +255,7 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
     "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
+    "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
 }
 
 #: The hand-maintained copies OBS004 cross-checks, as
@@ -297,6 +299,39 @@ SRV001_TARGETS: tuple[tuple[str, str, str], ...] = (
         "optuna_tpu/testing/fault_injection.py",
         "SHED_CHAOS_POLICIES",
         "chaos matrix: every shed rung must have an overload scenario that forces it",
+    ),
+)
+
+#: The SLO id vocabulary: every objective the SLO engine can evaluate
+#: (``optuna_tpu/slo.py``) — and every ``service.slo_burn`` finding, shed
+#: decision, and ``optuna_tpu_slo_*`` gauge derived from one — carries one
+#: of these ids. Canonical mirror of ``slo.py::SLO_SPECS`` (rule **OBS005**,
+#: the STO001 machinery pointed at the objectives themselves). Values
+#: describe the shipped parameterization; every id must have a burn
+#: scenario in ``testing/fault_injection.py::SLO_CHAOS_MATRIX`` (same rule)
+#: — an objective nobody has proven can burn certifies a violated promise
+#: as kept.
+SLO_REGISTRY: dict[str, str] = {
+    "serve.ask.latency": "serve.ask p99 <= 5ms over 1h at 99% (the suggestion service's per-ask contract)",
+    "storage.op.latency": "storage.op p99 <= 50ms over 1h at 99.9% (one logical storage op incl. retries)",
+    "dispatch.latency": "dispatch p99 <= 30s over 1h at 99% (one objective dispatch, serial or batched)",
+    "tell.latency": "tell p99 <= 100ms over 1h at 99.9% (result commit + callbacks)",
+    "scan.chunk.latency": "scan.chunk p99 <= 10s over 1h at 99% (one HBM-resident scan-chunk dispatch)",
+}
+
+#: The hand-maintained copies OBS005 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+OBS005_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/slo.py",
+        "SLO_SPECS",
+        "the engine's declared objectives (validated at spec construction)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "SLO_CHAOS_MATRIX",
+        "chaos matrix: every SLO must have a burn scenario that trips it",
     ),
 )
 

@@ -314,6 +314,26 @@ class OBS004HealthCheckSync(_RegistrySyncRule):
         return config.obs004_targets
 
 
+class OBS005SloRegistrySync(_RegistrySyncRule):
+    """The STO001/.../OBS004 anti-drift machinery pointed at the SLO
+    engine's objective vocabulary: ``slo.py::SLO_SPECS`` and the chaos
+    matrix ``fault_injection.py::SLO_CHAOS_MATRIX`` must both equal the
+    canonical ``registry.SLO_REGISTRY`` — an objective added without a burn
+    scenario proving it can trip is a lint failure, not a review comment:
+    an SLO nobody has shown burning certifies a violated promise as kept,
+    which is strictly worse than having no SLO at all."""
+
+    id = "OBS005"
+    title = "SLO objective vocabularies out of sync"
+    noun = "SLO objectives"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.obs005_registry)
+
+    def _targets(self, config):
+        return config.obs005_targets
+
+
 class OBS003DeviceStatSync(_RegistrySyncRule):
     """The STO001/EXE001/SMP001/OBS002 anti-drift machinery pointed at the
     device-stat vocabulary: ``device_stats.py::DEVICE_STATS`` and the chaos

@@ -7,8 +7,9 @@ surfaces with no reference analog: the ``metrics`` dump of the telemetry
 registry (``optuna_tpu/telemetry.py``), the ``trace`` dump of the flight
 recorder's Chrome-trace timeline (``optuna_tpu/flight.py``), the ``doctor``
 report of the study doctor's fleet diagnostics (``optuna_tpu/health.py``),
-and the ``trajectory`` rendering of the committed perf ledger
-(``BENCH_TRAJECTORY.json``).
+the ``slo`` report of the SLO engine's quantiles and burn rates
+(``optuna_tpu/slo.py``), and the ``trajectory`` rendering of the committed
+perf ledger (``BENCH_TRAJECTORY.json``).
 
 Entry points: ``python -m optuna_tpu.cli ...`` or the ``optuna-tpu`` console
 script.
@@ -353,6 +354,33 @@ def _cmd_doctor(args: argparse.Namespace) -> None:
         print(health.render_text(report))
 
 
+def _cmd_slo(args: argparse.Namespace) -> None:
+    """The SLO engine's report (see :mod:`optuna_tpu.slo`).
+
+    Without ``--endpoint`` the report is this process's engine — disabled
+    unless ``OPTUNA_TPU_SLO`` was set or the invoked workflow armed it;
+    with ``--endpoint`` it is fetched from a serving process's ``/slo.json``
+    (the gRPC proxy's ``metrics_port``), which is where a live serving
+    hub's quantiles and burn rates actually accumulate — byte-for-byte the
+    same shape either way.
+    """
+    from optuna_tpu import slo
+
+    if args.endpoint:
+        import urllib.request
+
+        base = args.endpoint.rstrip("/")
+        url = base if base.endswith("/slo.json") else base + "/slo.json"
+        with urllib.request.urlopen(url, timeout=10) as response:
+            report = json.loads(response.read().decode())
+    else:
+        report = slo.export_report()
+    if args.format == "json":
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(slo.render_text(report))
+
+
 def _find_trajectory_file() -> str | None:
     """Walk up from the working directory looking for the committed
     ``BENCH_TRAJECTORY.json`` (the pyproject-discovery pattern): the CLI is
@@ -429,6 +457,12 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
                 parts.append(f"w={serve['coalesce_width_max']}")
             if serve.get("sheds"):
                 parts.append(f"shed={serve['sheds']}")
+            if serve.get("sketch_p99_ms") is not None:
+                # The SLO engine's P²-sketch tail beside the wall-clock one
+                # (they should agree; drift means the sketch lies).
+                parts.append(f"sk99={serve['sketch_p99_ms']}ms")
+            if serve.get("slo"):
+                parts.append(f"slo={serve['slo']}")
         if mesh:
             # Sharded-loop entries (bench --loop=sharded) lead with the mesh
             # geometry the number was captured on.
@@ -569,6 +603,15 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="fetch /health.json from a serving process (e.g. http://host:9090) "
         "instead of aggregating from --storage in this process",
+    )
+
+    p = add("slo", _cmd_slo)
+    p.add_argument("-f", "--format", default="text", choices=["text", "json"])
+    p.add_argument(
+        "--endpoint",
+        default=None,
+        help="fetch /slo.json from a serving process (e.g. http://host:9090) "
+        "instead of this process's SLO engine",
     )
 
     p = add("trajectory", _cmd_trajectory)

@@ -78,10 +78,12 @@ __all__ = [
     "events",
     "filter_chrome_trace",
     "filter_trial",
+    "flow",
     "get_recorder",
     "instrument_jit",
     "jit_totals",
     "last_postmortem_path",
+    "new_flow_id",
     "new_span_id",
     "postmortem",
     "reset_jit_totals",
@@ -114,6 +116,7 @@ EVENT_KINDS: dict[str, str] = {
     "jit.retrace": "a jit wrapper's cache grew after its first entry (runtime TPU002)",
     "gauge": "a sampled runtime device gauge (HBM high-water, cache sizes)",
     "postmortem": "the recorder tail was flushed to a bounded JSON dump",
+    "flow": "a causal flow-edge endpoint (fan-in to a coalesced dispatch / fan-out from a refill), rendered as a Perfetto flow arrow",
 }
 
 #: Ring capacity when the environment/enable() doesn't say otherwise: deep
@@ -390,12 +393,16 @@ def clear() -> None:
     _postmortem_keys.clear()
 
 
-def _containment_sink(name: str, n: int) -> None:
+def _containment_sink(name: str, n: int, meta: dict | None = None) -> None:
     """The ``telemetry.count`` hook: every containment counter increment is
     also an ordered timeline event (kind ``containment``), so the chaos
     postmortem can show *when* a quarantine/bisection/retry fired relative
-    to the trial lifecycle — the counters alone only say that it did."""
-    _RECORDER.record("containment", name, meta=None if n == 1 else {"n": n})
+    to the trial lifecycle — the counters alone only say that it did.
+    ``meta`` is the call site's structured decision context (the shed
+    ladder's rung/depth/stale), carried onto the event verbatim."""
+    if n != 1:
+        meta = {**(meta or {}), "n": n}
+    _RECORDER.record("containment", name, meta=meta)
 
 
 # ----------------------------------------------------------- record entry
@@ -420,6 +427,38 @@ def event(
     if not _enabled:
         return
     _RECORDER.record(kind, name, trial=trial, meta=meta)
+
+
+def new_flow_id() -> str:
+    """Mint a process-unique flow id (one per causal edge: a parked ask, a
+    minted ready-queue proposal). The span-id sequence is reused — both are
+    opaque per-recorder identifiers."""
+    return _RECORDER.new_span_id()
+
+
+def flow(
+    name: str,
+    flow_id: str,
+    direction: str,
+    trial: int | None = None,
+    meta: dict | None = None,
+) -> None:
+    """Record one causal flow-edge endpoint; a no-op while disabled.
+
+    ``direction`` is ``"out"`` at the edge's source (a parked ask about to
+    fan into a coalesced dispatch; a refill dispatch minting a proposal) and
+    ``"in"`` at its destination (the dispatch serving the parked ask; the
+    queue pop consuming the proposal). Both endpoints carry the same
+    ``flow_id`` and render as one Perfetto flow arrow in
+    :func:`chrome_trace` (``ph: "s"``/``"f"``), bound to the enclosing
+    phase span on each side — record endpoints *inside* the span they
+    belong to, on the thread that owns it."""
+    if not _enabled:
+        return
+    full_meta = {"flow_id": flow_id, "dir": direction}
+    if meta:
+        full_meta.update(meta)
+    _RECORDER.record("flow", name, trial=trial, meta=full_meta)
 
 
 def trial_event(name: str, number: int, state: str | None = None) -> None:
@@ -739,6 +778,16 @@ def chrome_trace(event_list: Iterable[FlightEvent] | None = None) -> dict:
         elif ev.kind == "gauge":
             entry["ph"] = "C"
             entry["args"] = {"value": args.get("value", 0)}
+        elif ev.kind == "flow" and ev.meta and "flow_id" in ev.meta:
+            # Perfetto flow arrows: "s" starts an arrow at the enclosing
+            # slice of the source endpoint, "f" (binding point "e": the
+            # enclosing slice, not the next one) lands it on the
+            # destination's slice. Matching ids + category stitch the pair.
+            entry["ph"] = "s" if ev.meta.get("dir") == "out" else "f"
+            entry["id"] = str(ev.meta["flow_id"])
+            if entry["ph"] == "f":
+                entry["bp"] = "e"
+            entry["args"] = args
         else:
             entry["ph"] = "i"
             entry["s"] = "t"

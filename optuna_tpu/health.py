@@ -111,6 +111,7 @@ HEALTH_CHECKS: dict[str, str] = {
     "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
     "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
+    "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
 }
 
 #: Finding severities, mildest first. CRITICAL findings are additionally
@@ -118,10 +119,13 @@ HEALTH_CHECKS: dict[str, str] = {
 #: budget on something the operator would stop if they saw it).
 SEVERITIES: tuple[str, ...] = ("INFO", "WARNING", "CRITICAL")
 
-#: The fixed severity each check reports at (one check = one severity, so
-#: the hot path can know which checks *can* go CRITICAL without running
-#: them all — see :func:`_warn_critical_findings`). Keyed exactly by
-#: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py``).
+#: The severity *ceiling* each check reports at — for every check but one
+#: this is its fixed severity; ``service.slo_burn`` escalates WARNING ->
+#: CRITICAL with the burn rate (a slow leak is a warning, a fast burn is a
+#: page) and the table records its ceiling. The hot path derives its
+#: CRITICAL-capable subset from this map (see
+#: :func:`_warn_critical_findings`) without running every check. Keyed
+#: exactly by :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py``).
 CHECK_SEVERITIES: dict[str, str] = {
     "study.stagnation": "WARNING",
     "sampler.fallback_storm": "CRITICAL",
@@ -134,6 +138,7 @@ CHECK_SEVERITIES: dict[str, str] = {
     "shard.imbalance": "WARNING",
     "service.backpressure": "WARNING",
     "service.ready_queue_starved": "WARNING",
+    "service.slo_burn": "CRITICAL",
 }
 
 #: Study system-attr namespace the reporter publishes under; one attr per
@@ -169,6 +174,7 @@ SHARD_IMBALANCE_MIN_TRIALS = 8  # ...once the BEST shard has done this much
 BACKPRESSURE_SHED_MIN = 3  # shed asks before the service is flagged overloaded
 READY_QUEUE_MISS_MIN = 8  # ready-queue misses before starvation can flag
 READY_QUEUE_MISS_RATE = 0.5  # ...and misses must be this share of lookups
+SLO_BURN_MIN_VIOLATIONS = 3  # fleet-wide long-window violations before slo_burn can flag
 
 #: Gauge prefixes a worker snapshot carries (bounded: the device-stat,
 #: jit-label and mesh-coordinate vocabularies are small by construction;
@@ -257,11 +263,14 @@ class HealthReporter:
         # The delta baseline: everything the process-global registry held
         # when this reporter attached to its study belongs to whatever ran
         # before, not to this study's fleet rates.
+        from optuna_tpu import slo
+
         baseline = telemetry.snapshot()
         self._baseline_counters: dict[str, int] = dict(baseline.get("counters", {}))
         self._baseline_gauges: dict[str, float] = dict(baseline.get("gauges", {}))
         self._baseline_histograms: dict[str, dict] = baseline.get("histograms", {})
         self._baseline_jit: dict[str, dict] = flight.jit_totals()
+        self._baseline_slo: dict[str, tuple[int, int]] = slo.cumulative_counts()
 
     def snapshot(self, *, final: bool = False, observed_gap: float = 0.0) -> dict[str, Any]:
         """This worker's bounded health snapshot: the JSON-able dict the
@@ -338,6 +347,15 @@ class HealthReporter:
             "histograms": histograms,
             "jit": jit,
         }
+        from optuna_tpu import slo as slo_module
+
+        # The SLO engine's verdicts ride the same fleet channel: good/bad
+        # deltas vs the attach baseline plus this worker's current windowed
+        # burn rates, so the doctor's service.slo_burn check sees a burning
+        # serving hub from any process that can read the storage.
+        slo_block = slo_module.worker_snapshot(self._baseline_slo)
+        if slo_block:
+            out["slo"] = slo_block
         if final:
             out["final"] = True
         return out
@@ -624,6 +642,7 @@ def fleet_snapshot(
     gauges: dict[str, float] = {}
     histograms: dict[str, dict] = {}
     jit: dict[str, dict[str, float]] = {}
+    slo: dict[str, dict[str, Any]] = {}
     for worker_id, snap in sorted(worker_snapshots(storage, study_id).items()):
         last_seen = float(snap.get("last_seen_unix", 0.0))
         interval = float(snap.get("interval_s", DEFAULT_INTERVAL_S)) or DEFAULT_INTERVAL_S
@@ -670,6 +689,30 @@ def fleet_snapshot(
                 agg["compile_seconds"] + float(totals.get("compile_seconds", 0.0)), 6
             )
             agg["retraces_after_first"] += int(totals.get("retraces_after_first", 0))
+        for spec_id, entry in (snap.get("slo") or {}).items():
+            # Counts are additive work across the fleet; burn rates and the
+            # quantile estimate merge by max — the worst worker's windowed
+            # burn is the story (a healthy replica must not dilute a
+            # burning hub's verdict), mirroring `.max` gauge semantics.
+            # The burning/critical VERDICTS merge by OR of the per-worker
+            # booleans, not by re-ANDing the maxed windows: one worker's
+            # long-window spike plus another's short-window blip must not
+            # combine into a verdict no single worker holds.
+            agg = slo.setdefault(
+                spec_id,
+                {"good": 0, "bad": 0, "burn_long": 0.0, "burn_short": 0.0,
+                 "estimate_s": 0.0, "burning": False, "critical": False},
+            )
+            agg["good"] += int(entry.get("good", 0))
+            agg["bad"] += int(entry.get("bad", 0))
+            agg["burn_long"] = max(agg["burn_long"], float(entry.get("burn_long", 0.0)))
+            agg["burn_short"] = max(agg["burn_short"], float(entry.get("burn_short", 0.0)))
+            agg["estimate_s"] = max(agg["estimate_s"], float(entry.get("estimate_s", 0.0)))
+            agg["burning"] = agg["burning"] or bool(entry.get("burning"))
+            agg["critical"] = agg["critical"] or bool(entry.get("critical"))
+            for key in ("objective", "target_s", "quantile"):
+                if key in entry:
+                    agg[key] = entry[key]
     return {
         "workers": workers,
         "n_workers": len(workers),
@@ -678,6 +721,7 @@ def fleet_snapshot(
         "gauges": gauges,
         "histograms": histograms,
         "jit": jit,
+        "slo": slo,
     }
 
 
@@ -1042,6 +1086,73 @@ def _check_ready_queue_starved(
     )
 
 
+def _check_slo_burn(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    """The SLO engine's verdicts through the fleet channel: a spec some
+    worker reports as *burning* (the two-window AND evaluated per worker,
+    merged by OR) with the fleet-wide violation floor met. Severity
+    escalates with the burn rate (the one check whose severity is not
+    fixed): WARNING at a sustainable-rate leak, CRITICAL once some worker's
+    windows cross ``BURN_CRITICAL`` (budget gone in window/6 — the
+    fast-burn page). Legacy snapshots without the per-worker booleans fall
+    back to re-deriving the AND from the (then single-worker) windows."""
+    from optuna_tpu import slo as slo_module
+
+    burning: dict[str, dict[str, Any]] = {}
+    any_critical = False
+    for spec_id, entry in (fleet.get("slo") or {}).items():
+        bad = int(entry.get("bad", 0))
+        burn_long = float(entry.get("burn_long", 0.0))
+        burn_short = float(entry.get("burn_short", 0.0))
+        if bad < kw.get("slo_burn_min_violations", SLO_BURN_MIN_VIOLATIONS):
+            continue
+        is_burning = entry.get("burning")
+        if is_burning is None:  # pre-verdict snapshot shape
+            is_burning = (
+                burn_long >= slo_module.BURN_WARN
+                and burn_short >= slo_module.BURN_WARN
+            )
+        if not is_burning:
+            continue
+        burning[spec_id] = {
+            "good": int(entry.get("good", 0)),
+            "bad": bad,
+            "burn_long": burn_long,
+            "burn_short": burn_short,
+            "target_s": entry.get("target_s"),
+            "objective": entry.get("objective"),
+        }
+        is_critical = entry.get("critical")
+        if is_critical is None:
+            is_critical = (
+                burn_long >= slo_module.BURN_CRITICAL
+                and burn_short >= slo_module.BURN_CRITICAL
+            )
+        if is_critical:
+            any_critical = True
+    if not burning:
+        return None
+    worst = max(burning.items(), key=lambda kv: kv[1]["burn_long"])
+    return HealthFinding(
+        check="service.slo_burn",
+        severity="CRITICAL" if any_critical else "WARNING",
+        summary=(
+            f"{len(burning)} SLO(s) burning error budget, worst "
+            f"{worst[0]} at {worst[1]['burn_long']:g}x long-window / "
+            f"{worst[1]['burn_short']:g}x short-window burn"
+        ),
+        evidence={"slos": {k: burning[k] for k in sorted(burning)}},
+        remediation=(
+            "the system is violating its own latency objectives while budget "
+            "remains: shed earlier (the ShedPolicy SLO feed already halves "
+            "thresholds), add serving capacity (max_coalesce/ready_ahead or a "
+            "second hub), or re-negotiate the target in slo.DEFAULT_SLOS — "
+            "`optuna-tpu slo` shows the live quantiles per phase"
+        ),
+    )
+
+
 #: The rule table: one function per check id, keyed exactly by
 #: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py`` — a check in
 #: the vocabulary without a rule, or vice versa, is a test failure).
@@ -1057,6 +1168,7 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "shard.imbalance": _check_shard_imbalance,
     "service.backpressure": _check_backpressure,
     "service.ready_queue_starved": _check_ready_queue_starved,
+    "service.slo_burn": _check_slo_burn,
 }
 
 _SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}
@@ -1126,6 +1238,7 @@ def health_report(
             "gauges": fleet["gauges"],
             "histograms": fleet["histograms"],
             "jit": fleet["jit"],
+            "slo": fleet.get("slo", {}),
         },
         "findings": [f.to_dict() for f in findings],
         "healthy": not findings,

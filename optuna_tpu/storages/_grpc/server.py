@@ -180,7 +180,9 @@ def run_grpc_proxy_server(
     metrics AND the flight recorder, whose Chrome-trace export is served at
     ``/trace.json`` beside them, AND the study doctor's ``/health.json``
     (per-study fleet reports aggregated from the worker snapshots in the
-    backing storage — :func:`optuna_tpu.health.storage_health_reports`):
+    backing storage — :func:`optuna_tpu.health.storage_health_reports`),
+    AND the SLO engine, whose quantile/compliance/burn report is served at
+    ``/slo.json`` (and as ``optuna_tpu_slo_*`` gauges inside ``/metrics``):
     the storage hub is where op-token dedup hits, server-side storage
     latencies live, every worker's trace ids cross, and every worker's
     health snapshot lands, so this one endpoint watches a fleet.
@@ -189,11 +191,18 @@ def run_grpc_proxy_server(
 
     from optuna_tpu import health
 
+    from optuna_tpu import slo
+
     server = make_grpc_server(storage, host, port, thread_pool_size, suggest_service)
     metrics_server = None
     if metrics_port is not None:
         telemetry.enable()
         flight.enable()
+        # The hub is exactly the process whose latency promises the SLO
+        # engine binds (serve.ask, storage.op), so the metrics knob arms it
+        # too — /slo.json answers with live burn rates, and the shed
+        # policy's default SLO feed starts reacting.
+        slo.enable()
         metrics_server = telemetry.serve_metrics(
             metrics_port,
             host=host,
@@ -202,6 +211,7 @@ def run_grpc_proxy_server(
         _logger.info(f"Telemetry endpoint at http://{host}:{metrics_port}/metrics")
         _logger.info(f"Flight-trace endpoint at http://{host}:{metrics_port}/trace.json")
         _logger.info(f"Study-doctor endpoint at http://{host}:{metrics_port}/health.json")
+        _logger.info(f"SLO endpoint at http://{host}:{metrics_port}/slo.json")
     server.start()
     _logger.info(f"Server started at {host}:{port}")
     _logger.info("Listening...")

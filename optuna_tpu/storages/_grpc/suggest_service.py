@@ -125,6 +125,15 @@ def _bucket_width(n: int) -> int:
 # ------------------------------------------------------------- shed policy
 
 
+def _default_slo_source() -> Sequence[str]:
+    """The default :class:`ShedPolicy` SLO feed: the in-process SLO
+    engine's burning spec ids (empty while the engine is off, so the
+    default wiring costs nothing until an operator arms it)."""
+    from optuna_tpu import slo
+
+    return slo.burning_slo_ids()
+
+
 class ShedPolicy:
     """The load-shedding ladder: maps the server's instantaneous ask queue
     depth (and, optionally, the study doctor's verdict) to a
@@ -146,6 +155,16 @@ class ShedPolicy:
     any CRITICAL finding stands — a fallback storm, a dead worker — the
     thresholds HALVE: a fleet that is already drowning sheds earlier
     instead of piling asks onto a degrading sampler.
+
+    ``slo_source`` is the same mechanism one rung earlier in time: a
+    callable returning the ids of SLOs currently *burning* their error
+    budget (default: the in-process SLO engine,
+    :func:`optuna_tpu.slo.burning_slo_ids` — empty while the engine is
+    off). A burning SLO halves the thresholds exactly like a CRITICAL
+    finding, so shedding engages while the system is merely violating its
+    latency promise — *before* the fleet degrades far enough to mint a
+    CRITICAL doctor finding. Pass ``slo_source=lambda: ()`` to sever the
+    feed (the bench does: it measures the server, not the policy).
     """
 
     def __init__(
@@ -157,6 +176,7 @@ class ShedPolicy:
         retry_after_s: float = 0.05,
         findings_source: Callable[[], Sequence[str]] | None = None,
         findings_ttl_s: float = 5.0,
+        slo_source: Callable[[], Sequence[str]] | None = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if not (0 <= degrade_depth <= independent_depth <= reject_depth):
@@ -171,6 +191,7 @@ class ShedPolicy:
         self.retry_after_s = retry_after_s
         self._findings_source = findings_source
         self._findings_ttl_s = findings_ttl_s
+        self._slo_source = slo_source if slo_source is not None else _default_slo_source
         self._clock = clock
         self._findings_cached_at: float | None = None
         self._findings_critical = False
@@ -179,7 +200,19 @@ class ShedPolicy:
 
     def _fleet_critical(self) -> bool:
         if self._findings_source is None:
-            return False
+            if self._slo_source is None:
+                return False
+            if self._slo_source is _default_slo_source:
+                from optuna_tpu import slo
+
+                if not slo.enabled():
+                    # The common default configuration (no doctor feed, SLO
+                    # engine not armed) keeps its pre-SLO lock-free fast
+                    # path: decide() runs on every miss-path ask under
+                    # saturation, and taking the policy lock to learn the
+                    # disabled engine has nothing to say would tax exactly
+                    # the load being measured.
+                    return False
         with self._lock:
             now = self._clock()
             expired = (
@@ -194,13 +227,25 @@ class ShedPolicy:
                 return self._findings_critical
             self._findings_refreshing = True
         critical = False
-        try:
-            critical = bool(tuple(self._findings_source()))
-        except Exception as err:  # graphlint: ignore[PY001] -- the doctor feed is advisory: a storage blip while reading findings must not take the shed policy (or the ask path) down with it
-            _logger.warning(
-                f"shed policy findings source raised {err!r}; "
-                "treating the fleet as healthy this round."
-            )
+        if self._findings_source is not None:
+            try:
+                critical = bool(tuple(self._findings_source()))
+            except Exception as err:  # graphlint: ignore[PY001] -- the doctor feed is advisory: a storage blip while reading findings must not take the shed policy (or the ask path) down with it
+                _logger.warning(
+                    f"shed policy findings source raised {err!r}; "
+                    "treating the fleet as healthy this round."
+                )
+        if not critical and self._slo_source is not None:
+            try:
+                # A burning SLO is the earlier signal: the system is already
+                # violating its latency promise even though no fleet-level
+                # CRITICAL finding has minted yet — shed on it first.
+                critical = bool(tuple(self._slo_source()))
+            except Exception as err:  # graphlint: ignore[PY001] -- the SLO feed is advisory too: an engine error must not take the shed policy down with it
+                _logger.warning(
+                    f"shed policy SLO source raised {err!r}; "
+                    "treating the objectives as met this round."
+                )
         with self._lock:
             self._findings_critical = critical
             self._findings_cached_at = self._clock()
@@ -224,9 +269,12 @@ class ShedPolicy:
 
 
 class _PendingAsk:
-    """One asker parked in the coalescer, and its eventual proposal."""
+    """One asker parked in the coalescer, and its eventual proposal.
+    ``flow`` is the flight-recorder flow id stitching this parked ask to
+    the fused dispatch that serves it (the fan-in arrow); None while the
+    recorder is off."""
 
-    __slots__ = ("trial_id", "number", "done", "params", "dists", "fallback", "error")
+    __slots__ = ("trial_id", "number", "done", "params", "dists", "fallback", "error", "flow")
 
     def __init__(self, trial_id: int, number: int) -> None:
         self.trial_id = trial_id
@@ -236,6 +284,7 @@ class _PendingAsk:
         self.dists: dict[str, str] = {}
         self.fallback: str | None = None
         self.error: BaseException | None = None
+        self.flow: str | None = None
 
 
 class _AskCoalescer:
@@ -347,12 +396,24 @@ class _AskCoalescer:
 
 
 class _ReadyEntry:
-    __slots__ = ("params", "dists", "epoch")
+    """``flow`` is the flight-recorder flow id minted by the refill (or
+    coalesce-surplus) dispatch that produced this proposal: the queue pop
+    that consumes it closes the fan-out arrow, so a served ask's provenance
+    — which dispatch, which epoch — is one arrow in Perfetto."""
 
-    def __init__(self, params: dict[str, Any], dists: dict[str, str], epoch: int) -> None:
+    __slots__ = ("params", "dists", "epoch", "flow")
+
+    def __init__(
+        self,
+        params: dict[str, Any],
+        dists: dict[str, str],
+        epoch: int,
+        flow: str | None = None,
+    ) -> None:
         self.params = params
         self.dists = dists
         self.epoch = epoch
+        self.flow = flow
 
 
 class _ReadyQueue:
@@ -609,9 +670,18 @@ class SuggestService:
     def _ask_impl(self, study_id: int, trial_id: int, trial_number: int) -> dict:
         handle = self._handle(study_id)
         handle.asks_since_fill += 1
+        self._publish_depth_gauges(study_id, handle)
         entry = handle.queue.pop_fresh(self.max_stale_epochs)
         if entry is not None:
             telemetry.count("serve.ready_queue.hit")
+            if entry.flow is not None:
+                # Fan-out provenance: close the arrow the minting refill
+                # dispatch opened — "this ask was served by THAT dispatch,
+                # minted at THAT epoch", one hop in Perfetto.
+                flight.flow(
+                    "serve.ready_queue.fanout", entry.flow, "in",
+                    trial=trial_number, meta={"epoch": entry.epoch},
+                )
             self._maybe_request_refill(study_id, handle, demand=True)
             return {
                 "params": entry.params,
@@ -625,15 +695,17 @@ class SuggestService:
             self._inflight += 1
             depth = self._inflight
         try:
-            rung = self.shed_policy.decide(
-                depth, handle.queue.stale_len(self.max_stale_epochs)
-            )
+            stale_available = handle.queue.stale_len(self.max_stale_epochs)
+            rung = self.shed_policy.decide(depth, stale_available)
             if self._draining:
                 # The flush answers what was already parked; a NEW ask during
                 # wind-down is refused so the client re-dials the successor.
                 rung = "reject"
             if rung == "reject":
-                telemetry.count("serve.shed.reject")
+                telemetry.count(
+                    "serve.shed.reject",
+                    meta={"rung": "reject", "depth": depth, "stale": stale_available},
+                )
                 return {
                     "params": {},
                     "dists": {},
@@ -646,7 +718,19 @@ class SuggestService:
             if rung == "stale_queue":
                 stale = handle.queue.pop_any()
                 if stale is not None:
-                    telemetry.count("serve.shed.stale_queue")
+                    telemetry.count(
+                        "serve.shed.stale_queue",
+                        meta={
+                            "rung": "stale_queue",
+                            "depth": depth,
+                            "stale": stale_available,
+                        },
+                    )
+                    if stale.flow is not None:
+                        flight.flow(
+                            "serve.ready_queue.fanout", stale.flow, "in",
+                            trial=trial_number, meta={"epoch": stale.epoch},
+                        )
                     self._maybe_request_refill(study_id, handle, demand=True)
                     return {
                         "params": stale.params,
@@ -657,7 +741,14 @@ class SuggestService:
                     }
                 rung = "independent"
             if rung == "independent":
-                telemetry.count("serve.shed.independent")
+                telemetry.count(
+                    "serve.shed.independent",
+                    meta={
+                        "rung": "independent",
+                        "depth": depth,
+                        "stale": stale_available,
+                    },
+                )
                 return {
                     "params": {},
                     "dists": {},
@@ -666,6 +757,12 @@ class SuggestService:
                     "source": "shed",
                 }
             item = _PendingAsk(trial_id, trial_number)
+            if flight.enabled():
+                # Fan-in: open the arrow inside THIS ask's serve.ask span;
+                # the leader's fused dispatch closes it — N parked asks, N
+                # arrows converging on the one serve.coalesce slice.
+                item.flow = flight.new_flow_id()
+                flight.flow("serve.ask.fanin", item.flow, "out", trial=trial_number)
             handle.coalescer.submit(
                 item, lambda batch: self._dispatch_batch(handle, batch)
             )
@@ -690,6 +787,16 @@ class SuggestService:
         telemetry.max_gauge("serve.coalesce.width.max", len(batch))
         try:
             with telemetry.span("serve.coalesce"), flight.span("serve.coalesce"):
+                for item in batch:
+                    if item.flow is not None:
+                        # Close every parked asker's fan-in arrow inside
+                        # this dispatch's slice: "why was this ask slow"
+                        # walks the arrow to the one dispatch that served
+                        # the whole batch.
+                        flight.flow(
+                            "serve.ask.fanin", item.flow, "in",
+                            trial=item.number, meta={"width": len(batch)},
+                        )
                 # handle.lock serializes this dispatch against the refill
                 # worker (refill_now) and prewarm: all three drive the ONE
                 # server-resident GuardedSampler, whose fit state, RNG, and
@@ -744,7 +851,10 @@ class SuggestService:
             if surplus and self.ready_ahead > 0 and not self._draining:
                 epoch = handle.queue.epoch
                 handle.queue.push_fresh(
-                    [_ReadyEntry(dict(p), dists, epoch) for p in surplus]
+                    [
+                        _ReadyEntry(dict(p), dists, epoch, flow=self._mint_fanout(epoch))
+                        for p in surplus
+                    ]
                 )
             return
         reason = guarded.last_batch_fallback_reason
@@ -778,6 +888,46 @@ class SuggestService:
             params = guarded.sample_relative(study, frozen, space)
             item.params = dict(params)
             item.dists = dists
+
+    @staticmethod
+    def _mint_fanout(epoch: int) -> str | None:
+        """Open a fan-out arrow for one minted proposal (inside the minting
+        dispatch's span, on its thread — the enclosing-slice binding rule);
+        None while the recorder is off."""
+        if not flight.enabled():
+            return None
+        flow_id = flight.new_flow_id()
+        flight.flow(
+            "serve.ready_queue.fanout", flow_id, "out", meta={"epoch": epoch}
+        )
+        return flow_id
+
+    #: Per-study gauge suffixes publish only while the service holds at
+    #: most this many study handles: gauge names are never evicted from the
+    #: registry (and ride every health snapshot), so a hub cycling through
+    #: thousands of short-lived studies must not mint an unbounded series
+    #: set. Past the cap, the un-suffixed gauges (most recently touched
+    #: study) keep reporting levels; `state()` keeps the full breakdown.
+    _PER_STUDY_GAUGE_CAP = 32
+
+    def _publish_depth_gauges(self, study_id: int, handle: _StudyHandle) -> None:
+        """Live backpressure levels as telemetry gauges (the ``state()``
+        introspection surface, exported): inflight miss-path asks, coalesce
+        window occupancy, ready-queue depth + epoch (per-study while the
+        handle count stays under :data:`_PER_STUDY_GAUGE_CAP`). ``/metrics``
+        then shows *levels*, not just shed counters — an operator sees the
+        queue draining before the first shed fires. One enabled check, a
+        few lock-guarded reads; nothing while telemetry is off."""
+        if not telemetry.enabled():
+            return
+        telemetry.set_gauge("serve.inflight.last", self._inflight)
+        telemetry.set_gauge("serve.coalesce.depth.last", handle.coalescer.depth)
+        depth, epoch = len(handle.queue), handle.queue.epoch
+        telemetry.set_gauge("serve.ready_queue.depth.last", depth)
+        telemetry.set_gauge("serve.ready_queue.epoch.last", epoch)
+        if len(self._handles) <= self._PER_STUDY_GAUGE_CAP:
+            telemetry.set_gauge(f"serve.ready_queue.depth.s{study_id}.last", depth)
+            telemetry.set_gauge(f"serve.ready_queue.epoch.s{study_id}.last", epoch)
 
     # ----------------------------------------------------------- ask-ahead
 
@@ -853,7 +1003,7 @@ class SuggestService:
                 epoch = handle.queue.epoch
                 handle.queue.refill(
                     [
-                        _ReadyEntry(dict(params), dists, epoch)
+                        _ReadyEntry(dict(params), dists, epoch, flow=self._mint_fanout(epoch))
                         for params in proposals
                     ]
                 )
@@ -861,6 +1011,7 @@ class SuggestService:
                 handle.asks_since_fill = 0
             telemetry.count("serve.ready_queue.refill")
             telemetry.set_gauge("serve.ready_queue.depth.last", len(handle.queue))
+            self._publish_depth_gauges(study_id, handle)
             return len(handle.queue)
 
     def prewarm(self, study_id: int) -> int:

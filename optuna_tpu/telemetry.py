@@ -48,7 +48,9 @@ import time
 from typing import Callable, Iterator, Mapping
 
 __all__ = [
+    "BUCKET_BOUNDS",
     "COUNTERS",
+    "HistogramState",
     "PHASES",
     "MetricsRegistry",
     "add_gauge",
@@ -58,6 +60,7 @@ __all__ = [
     "enabled",
     "export_snapshot",
     "get_registry",
+    "histogram_quantile",
     "max_gauge",
     "observe",
     "observe_phase",
@@ -128,14 +131,20 @@ def trace_name(phase: str) -> str:
 
 # ------------------------------------------------------------ histograms
 
-#: Fixed log-spaced latency buckets (seconds): half-decade steps from 100 µs
-#: to ~100 s, the span between one in-process dict write and a hung-dispatch
-#: deadline. Fixed (not configurable per histogram) so every phase histogram
-#: is cross-comparable and the Prometheus series set stays bounded.
-BUCKET_BOUNDS: tuple[float, ...] = tuple(10.0 ** (k / 2.0) for k in range(-8, 5))
+#: Fixed log-spaced latency buckets (seconds): half-decade steps from 10 µs
+#: to ~100 s, the span between one served ready-queue pop and a
+#: hung-dispatch deadline. The bottom decade (10 µs / ~32 µs) exists for the
+#: suggestion service's serve path — a ~1 ms ask and a ~50 µs queue pop must
+#: not floor into one bucket. Fixed (not configurable per histogram) so
+#: every phase histogram is cross-comparable and the Prometheus series set
+#: stays bounded.
+BUCKET_BOUNDS: tuple[float, ...] = tuple(10.0 ** (k / 2.0) for k in range(-10, 5))
 
 
-class _Histogram:
+class HistogramState:
+    """One histogram's live state: total count/sum plus raw per-bucket
+    counts over the fixed :data:`BUCKET_BOUNDS` ladder (+Inf tail last)."""
+
     __slots__ = ("count", "total", "bucket_counts")
 
     def __init__(self) -> None:
@@ -151,6 +160,55 @@ class _Histogram:
                 self.bucket_counts[i] += 1
                 return
         self.bucket_counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (Prometheus ``histogram_quantile``
+        semantics): locate the bucket where the cumulative count crosses
+        ``q * count`` and interpolate linearly inside it (the lowest bucket
+        interpolates from 0; observations in the +Inf tail answer with the
+        last finite bound — the histogram cannot resolve past it). An
+        *approximation* bounded by bucket width; the SLO engine's P² sketch
+        is the precise streaming estimator — this helper is for snapshots
+        and fleet merges, where only bucket counts survive."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1]; got {q}.")
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        cumulative = 0
+        for i, bound in enumerate(BUCKET_BOUNDS):
+            in_bucket = self.bucket_counts[i]
+            if in_bucket and cumulative + in_bucket >= rank:
+                lower = BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                fraction = (rank - cumulative) / in_bucket
+                return lower + (bound - lower) * max(0.0, min(1.0, fraction))
+            cumulative += in_bucket
+        return BUCKET_BOUNDS[-1]
+
+
+#: Backwards-compatible private alias (the class went public when the SLO
+#: engine needed the interpolation helper on snapshots).
+_Histogram = HistogramState
+
+
+def histogram_quantile(hist: Mapping, q: float) -> float:
+    """:meth:`HistogramState.quantile` over a *snapshot-shaped* histogram
+    dict (``{"count", "sum", "buckets": {bound_label: raw count}}``) — the
+    form ``/metrics.json`` consumers and the doctor's fleet merges hold.
+    Bucket labels parse back through :func:`_format_bound`'s rendering
+    (``"+Inf"`` for the tail)."""
+    state = HistogramState()
+    buckets = hist.get("buckets", {}) if isinstance(hist, Mapping) else {}
+    by_bound = {}
+    for label, count in buckets.items():
+        by_bound[float("inf") if label == "+Inf" else float(label)] = int(count)
+    for i, bound in enumerate(BUCKET_BOUNDS):
+        # Snapshot labels render via _format_bound; match through the same
+        # formatter so float re-parsing cannot drift.
+        state.bucket_counts[i] = by_bound.get(float(_format_bound(bound)), 0)
+    state.bucket_counts[-1] = by_bound.get(float("inf"), 0)
+    state.count = sum(state.bucket_counts)
+    return state.quantile(q)
 
 
 class _Span:
@@ -233,7 +291,7 @@ class MetricsRegistry:
         with self._lock:
             hist = self._histograms.get(name)
             if hist is None:
-                hist = self._histograms[name] = _Histogram()
+                hist = self._histograms[name] = HistogramState()
             hist.observe(value)
 
     def span(self, name: str) -> _Span:
@@ -412,12 +470,48 @@ _enabled = bool(os.environ.get("OPTUNA_TPU_TELEMETRY"))
 #: ordered timeline event, with zero new instrumentation at the call sites
 #: and zero drift risk between the two surfaces. None (the default) keeps
 #: the disabled hot path at module-global checks with no allocations.
-_count_sink: Callable[[str, int], None] | None = None
+_count_sink: Callable[[str, int, dict | None], None] | None = None
+
+#: Optional phase-duration sink the SLO engine (:mod:`optuna_tpu.slo`)
+#: hooks into :func:`span`/:func:`observe_phase`: every timed phase also
+#: feeds the streaming quantile sketches and burn windows, with zero new
+#: instrumentation at the call sites. Independent of :func:`enabled` — the
+#: SLO engine evaluates even when the metrics registry is off — and None
+#: (the default) keeps the disabled hot path at the shared null span.
+_phase_sink: Callable[[str, float], None] | None = None
 
 
-def _set_count_sink(sink: Callable[[str, int], None] | None) -> None:
+def _set_count_sink(sink: Callable[[str, int, dict | None], None] | None) -> None:
     global _count_sink
     _count_sink = sink
+
+
+def _set_phase_sink(sink: Callable[[str, float], None] | None) -> None:
+    global _phase_sink
+    _phase_sink = sink
+
+
+class _PhaseSpan:
+    """The module-level span: times one block into the enabled registry AND
+    the hooked phase sink. Constructed only when at least one consumer is
+    on — the disabled path stays the shared :data:`_NULL_SPAN` singleton."""
+
+    __slots__ = ("_name", "_start")
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+
+    def __enter__(self) -> "_PhaseSpan":
+        self._start = _REGISTRY._clock()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        seconds = _REGISTRY._clock() - self._start
+        if _enabled:
+            _REGISTRY.observe(_PHASE_METRIC_PREFIX + self._name, seconds)
+        sink = _phase_sink
+        if sink is not None:
+            sink(self._name, seconds)
 
 
 def get_registry() -> MetricsRegistry:
@@ -442,15 +536,18 @@ def disable() -> None:
     _enabled = False
 
 
-def count(name: str, n: int = 1) -> None:
+def count(name: str, n: int = 1, meta: dict | None = None) -> None:
     """Increment a containment counter; a no-op (module-global checks, zero
     allocations) while both telemetry and the flight-recorder sink are
     disabled. ``name`` is a :data:`COUNTERS` family, optionally suffixed
     (``sampler.fallback.relative``). A hooked sink (the flight recorder)
     receives every event even while the metrics registry itself is off —
-    the two surfaces are independently switchable, one vocabulary."""
+    the two surfaces are independently switchable, one vocabulary. ``meta``
+    is structured context for the sink's timeline event only (the shed
+    ladder passes its rung/depth/stale decision); the counter itself stays
+    a bare integer."""
     if _count_sink is not None:
-        _count_sink(name, n)
+        _count_sink(name, n, meta)
     if not _enabled:
         return
     _REGISTRY.inc(name, n)
@@ -468,10 +565,14 @@ def observe_phase(name: str, seconds: float) -> None:
     histogram — for call sites that must stitch one *logical* phase across
     non-contiguous code blocks (the batch executor's ask spans the batch
     creation AND the in-heartbeat suggestion loop), where two ``span()``
-    blocks would double the phase's count and halve its per-op latency."""
-    if not _enabled:
-        return
-    _REGISTRY.observe(_PHASE_METRIC_PREFIX + name, seconds)
+    blocks would double the phase's count and halve its per-op latency.
+    A hooked phase sink (the SLO engine) receives the observation even
+    while the registry is off."""
+    if _enabled:
+        _REGISTRY.observe(_PHASE_METRIC_PREFIX + name, seconds)
+    sink = _phase_sink
+    if sink is not None:
+        sink(name, seconds)
 
 
 def set_gauge(name: str, value: float) -> None:
@@ -495,12 +596,13 @@ def max_gauge(name: str, value: float) -> None:
 
 
 def span(name: str):
-    """Time a ``with`` block into the ``phase.<name>`` histogram. Returns a
-    shared do-nothing singleton while disabled — the hot path pays one
-    global check and allocates nothing."""
-    if not _enabled:
+    """Time a ``with`` block into the ``phase.<name>`` histogram (and the
+    hooked SLO phase sink). Returns a shared do-nothing singleton while
+    both consumers are off — the hot path pays two global checks and
+    allocates nothing."""
+    if not _enabled and _phase_sink is None:
         return _NULL_SPAN
-    return _REGISTRY.span(name)
+    return _PhaseSpan(name)
 
 
 def snapshot() -> dict:
@@ -524,7 +626,12 @@ def export_snapshot() -> dict:
 
 
 def render_prometheus() -> str:
-    return _REGISTRY.render_prometheus()
+    """The registry's exposition plus the SLO engine's ``optuna_tpu_slo_*``
+    quantile/compliance/burn gauges (empty while the engine is off) — one
+    scrape carries counters, histograms, and objective verdicts."""
+    from optuna_tpu import slo
+
+    return _REGISTRY.render_prometheus() + slo.prometheus_lines()
 
 
 def reset() -> None:
@@ -554,9 +661,12 @@ def serve_metrics(
 ):
     """Serve the registry over HTTP on a daemon thread and return the server
     (call ``.shutdown()`` to stop it). Endpoints: ``/metrics`` (Prometheus
-    text), ``/metrics.json`` (the :func:`snapshot` dict), ``/trace.json``
+    text, with the SLO engine's ``optuna_tpu_slo_*`` gauges appended while
+    it runs), ``/metrics.json`` (the :func:`snapshot` dict), ``/trace.json``
     (the flight recorder's Chrome-trace export — empty ``traceEvents``
-    while flight recording is off), and — when ``health_source`` is given —
+    while flight recording is off), ``/slo.json`` (the SLO engine's
+    quantile/compliance/burn report — ``enabled: false`` while off), and —
+    when ``health_source`` is given —
     ``/health.json`` (the study doctor's fleet reports; the gRPC proxy
     server passes :func:`optuna_tpu.health.storage_health_reports` over its
     backing storage, the one process that can see the whole fleet). Without
@@ -578,6 +688,14 @@ def serve_metrics(
                 from optuna_tpu import flight
 
                 body = json.dumps(flight.chrome_trace()).encode()
+                content_type = "application/json"
+            elif self.path.split("?")[0] == "/slo.json":
+                from optuna_tpu import slo
+
+                # Served even while the engine is off (`enabled: false`,
+                # empty spec list): a dashboard probing a hub must see "not
+                # armed", not a 404 indistinguishable from a typo'd path.
+                body = json.dumps(slo.export_report()).encode()
                 content_type = "application/json"
             elif self.path.split("?")[0] == "/health.json":
                 if health_source is None:

@@ -215,6 +215,8 @@ FLIGHT_EVENT_CHAOS_MATRIX: dict[str, str] = {
     "jit.retrace": "a second batch shape grows the cache again; retrace event + gauge",
     "gauge": "device-gauge sample records HBM stats where the backend exposes them",
     "postmortem": "terminal batch failure / sampler degrade flushes a bounded dump",
+    "flow": "coalesced ask burst + ready-queue pops; the Chrome export carries matched "
+    "fan-in and fan-out arrow endpoints (ph s/f, same id), schema-validated",
 }
 
 
@@ -335,6 +337,9 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "burst (ServiceChaosPlan); the doctor reports the exact per-policy shed counts",
     "service.ready_queue_starved": "drive asks with ask-ahead disabled (or perpetually "
     "invalidated); the miss rate crosses the starvation threshold, the speculating twin stays clean",
+    "service.slo_burn": "overload burst under a floor-level serve.ask target (SLOChaosPlan): "
+    "every ask violates, both burn windows cross critical, the finding carries the exact "
+    "violation counts through the fleet channel, and the compliant twin stays clean",
 }
 
 
@@ -423,6 +428,77 @@ def plant_dead_worker(
         study._study_id, WORKER_ATTR_PREFIX + worker_id, snapshot
     )
     return snapshot
+
+
+# ------------------------------------------------------------------ SLO chaos
+
+
+# Chaos matrix for the SLO engine's objectives: every id the engine can
+# evaluate (``slo.py::SLO_SPECS``) maps to the burn scenario the chaos suite
+# must force against it. Deliberately a hand-written literal (not an import
+# of ``slo.SLO_SPECS``): graphlint rule OBS005 cross-checks both against
+# ``_lint/registry.py::SLO_REGISTRY`` — adding an objective without a burn
+# scenario proving it can trip is a lint failure (the STO001 pattern),
+# because an SLO nobody has shown burning certifies a violated promise as
+# kept.
+SLO_CHAOS_MATRIX: dict[str, str] = {
+    "serve.ask.latency": "overload burst under a floor-level target: every serve.ask "
+    "observation violates, burn crosses critical, service.slo_burn fires with the exact "
+    "violation count and the shed thresholds halve",
+    "storage.op.latency": "latency-injected storage ops (FaultPlan latency_rate) under a "
+    "floor-level target burn the budget; the uninjected twin stays compliant",
+    "dispatch.latency": "a slow objective dispatch under a floor-level target burns; the "
+    "default 30s target stays compliant on the same run",
+    "tell.latency": "slow tells under a floor-level target burn the budget; the fault-free "
+    "twin at the default target stays compliant",
+    "scan.chunk.latency": "a scan chunk under a floor-level target burns; the default "
+    "target stays compliant on the same chunk timings",
+}
+
+
+@dataclass(frozen=True)
+class SLOChaosPlan:
+    """One deterministic SLO-burn chaos scenario: an overload burst of
+    serve-path asks evaluated against a *floor-level* latency target
+    (every real observation violates — no sleeps, no timing races), and
+    the exact outcome the acceptance test asserts
+    (``tests/test_slo_chaos.py``): the sketch p99 crosses the spec, both
+    burn windows cross :data:`optuna_tpu.slo.BURN_CRITICAL`, the doctor
+    reports ``service.slo_burn`` with ``bad == burst_asks`` through the
+    fleet channel, the shed thresholds halve via the policy's SLO feed, the
+    shed events carry rung/depth/stale, and the Perfetto export holds at
+    least one fan-in and one fan-out flow edge. The fault-free twin runs
+    the same burst against the *default* targets and reports every SLO
+    compliant; the disabled twin records nothing over
+    ``disabled_calls`` span entries with a bounded heap.
+    """
+
+    n_clients: int = 4
+    burst_asks: int = 12
+    harsh_target_s: float = 1e-9
+    window_s: float = 60.0
+    objective: float = 0.99
+    quantile: float = 0.99
+    disabled_calls: int = 10_000
+
+    def harsh_spec(self):
+        """The floor-level ``serve.ask.latency`` spec the burst must burn."""
+        from optuna_tpu.slo import SLOSpec
+
+        return SLOSpec(
+            "serve.ask.latency",
+            "serve.ask",
+            self.quantile,
+            self.harsh_target_s,
+            self.objective,
+            self.window_s,
+        )
+
+
+def slo_chaos_plan() -> SLOChaosPlan:
+    """The default :class:`SLOChaosPlan` the chaos suite runs — a 12-ask
+    burst from 4 clients against a 1ns serve.ask target."""
+    return SLOChaosPlan()
 
 
 # ------------------------------------------------------ suggestion-service chaos

@@ -1,0 +1,5 @@
+# graphlint fixture: OBS005 negative — both copies agree with the registry.
+SLO_CHAOS_MATRIX = {
+    "serve.fast": "overload burst under a floor-level target; the spec burns",
+    "tell.quick": "slow tells under a floor-level target; the spec burns",
+}

@@ -1,0 +1,5 @@
+# graphlint fixture: OBS005 negative — both copies agree with the registry.
+SLO_SPECS = {
+    "serve.fast": "what the objective binds",
+    "tell.quick": "what the objective binds",
+}

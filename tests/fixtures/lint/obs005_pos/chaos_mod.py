@@ -1,0 +1,2 @@
+# graphlint fixture: OBS005 — this copy DRIFTED: 'tell.quick' is missing.
+SLO_CHAOS_MATRIX = {"serve.fast": "burn scenario"}  # EXPECT: OBS005

@@ -1,0 +1,6 @@
+# graphlint fixture: OBS005 — this copy DRIFTED: 'serve.phantom_slo' is extra.
+SLO_SPECS = {  # EXPECT: OBS005
+    "serve.fast": "description",
+    "tell.quick": "description",
+    "serve.phantom_slo": "description",
+}

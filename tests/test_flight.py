@@ -25,7 +25,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO_ROOT, "optuna_tpu")
 
 #: Chrome trace-event phases the exporter may emit (trace-event format spec).
-_ALLOWED_PH = {"X", "i", "C", "M"}
+_ALLOWED_PH = {"X", "i", "C", "M", "s", "f"}
 
 
 @pytest.fixture(autouse=True)
@@ -296,6 +296,12 @@ def _validate_chrome_trace(data: dict) -> None:
             assert all(
                 isinstance(v, (int, float)) for v in entry["args"].values()
             ), entry
+        if entry["ph"] in ("s", "f"):
+            # Flow endpoints: a matching id stitches the arrow; the end
+            # binds to its enclosing slice (bp "e").
+            assert isinstance(entry["id"], str) and entry["id"], entry
+            if entry["ph"] == "f":
+                assert entry.get("bp") == "e", entry
 
 
 def test_chrome_trace_export_is_schema_valid_and_ordered():

@@ -582,7 +582,8 @@ def _trajectory_file(tmp_path):
                     "n_clients": 64, "serve_ask_p99_ms": 2.16,
                     "single_client_ask_ms": 23.4, "ready_queue_hits": 250,
                     "ready_queue_misses": 6, "coalesce_width_max": 48,
-                    "sheds": 0,
+                    "sheds": 0, "sketch_p50_ms": 0.4, "sketch_p99_ms": 2.3,
+                    "slo": "ok",
                 },
             },
         ],
@@ -602,8 +603,10 @@ def test_trajectory_cli_table_and_json(tmp_path, capsys):
     assert "123456789*" in table  # short sha + dirty marker
     assert "partial" in table
     # Serve-loop entries condense the latency contract + queue health
-    # (bench --loop=serve, ISSUE 13).
+    # (bench --loop=serve, ISSUE 13), plus the SLO engine's sketch p99 and
+    # ok|burn verdict beside the wall-clock figures (ISSUE 14).
     assert "p99=2.16ms/1cl=23.4ms q=250/6 w=48" in table
+    assert "sk99=2.3ms" in table and "slo=ok" in table
 
     assert cli_main(["trajectory", "--path", path, "-f", "json"]) == 0
     payload = json.loads(capsys.readouterr().out)

@@ -336,6 +336,69 @@ def test_obs004_fixture_in_sync_is_silent():
     assert not result.findings, [f.format() for f in result.findings]
 
 
+def test_obs005_registry_matches_runtime_sets():
+    """The canonical SLO registry equals the *runtime* values of both
+    hand-written copies (the lint compares them statically) — and the
+    shipped spec set covers exactly the vocabulary."""
+    from optuna_tpu import slo
+    from optuna_tpu.testing.fault_injection import SLO_CHAOS_MATRIX
+
+    canonical = set(lint_registry.SLO_REGISTRY)
+    assert set(slo.SLO_SPECS) == canonical
+    assert set(SLO_CHAOS_MATRIX) == canonical
+    assert {spec.id for spec in slo.DEFAULT_SLOS} == canonical
+
+
+def test_obs005_gate_rejects_drift():
+    """Point OBS005 at the real files with a registry containing an
+    objective the code does not know: both copies must be reported as
+    drifted — adding an SLO without a burn scenario proving it can trip is
+    a lint failure (the STO001/.../OBS004 discipline)."""
+    fat_registry = dict(lint_registry.SLO_REGISTRY)
+    fat_registry["serve.phantom_slo"] = "made-up objective to prove the gate is live"
+    config = Config(obs005_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.obs005_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "OBS005"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("serve.phantom_slo" in f.message for f in drifted)
+
+
+_OBS005_FIXTURE_REGISTRY = {
+    "serve.fast": "serve p99 under a millisecond",
+    "tell.quick": "tell p99 under ten milliseconds",
+}
+
+
+def _obs005_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        obs005_registry=_OBS005_FIXTURE_REGISTRY,
+        obs005_targets=(
+            (f"fixtures/lint/{tree}/slo_mod.py", "SLO_SPECS", "objective vocabulary"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "SLO_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_obs005_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "obs005_pos")
+    result = run_lint([tree], _obs005_config("obs005_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "serve.phantom_slo" in by_file["slo_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_obs005_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "obs005_neg")
+    result = run_lint([tree], _obs005_config("obs005_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 _OBS003_FIXTURE_REGISTRY = {
     "gp.rung": "jitter escalations the factor needed",
     "exec.quarantined": "non-finite slots in one dispatch",

@@ -1,0 +1,351 @@
+"""SLO engine unit tests (ISSUE 14): the P² sketch's accuracy, the burn
+windows' time semantics under an injected clock, spec validation, the
+telemetry phase-sink integration (zero new instrumentation at call sites),
+and the export surfaces (Prometheus lines, /slo.json, CLI).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import slo, telemetry
+from optuna_tpu._lint import registry as lint_registry
+
+from test_telemetry import _parse_exposition  # the shared grammar parser
+
+
+@pytest.fixture(autouse=True)
+def _isolated_slo():
+    """Each test gets a fresh registry; slo ends disabled with its sink
+    unhooked (the shared-null-span contract other suites rely on)."""
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    slo.disable()
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _spec(**overrides):
+    kwargs = dict(
+        id="serve.ask.latency",
+        phase="serve.ask",
+        quantile=0.99,
+        target_s=0.1,
+        objective=0.9,
+        window_s=60.0,
+    )
+    kwargs.update(overrides)
+    return slo.SLOSpec(**kwargs)
+
+
+# ------------------------------------------------------------------ sketch
+
+
+def test_p2_matches_sorted_percentiles_on_heavy_tails():
+    """The P² estimator tracks true percentiles of a lognormal stream (the
+    latency-shaped distribution) within a few percent at n=20k, retaining
+    five floats instead of 20k samples."""
+    rng = random.Random(7)
+    values = [rng.lognormvariate(0.0, 1.0) for _ in range(20_000)]
+    estimators = {q: slo.P2Quantile(q) for q in (0.5, 0.9, 0.99)}
+    for v in values:
+        for est in estimators.values():
+            est.observe(v)
+    ordered = sorted(values)
+    for q, est in estimators.items():
+        true = ordered[int(q * len(ordered))]
+        assert est.value() == pytest.approx(true, rel=0.08), q
+
+
+def test_p2_is_exact_below_six_observations_and_empty_is_zero():
+    est = slo.P2Quantile(0.5)
+    assert est.value() == 0.0
+    for v in (5.0, 1.0, 3.0):
+        est.observe(v)
+    assert est.value() == 3.0  # exact order statistic while n <= 5
+    assert slo.P2Quantile(0.99).count == 0
+
+
+def test_p2_survives_constant_streams():
+    """Degenerate input (every observation identical — the zero-variance
+    pathology the resilience rings know well): markers collapse without
+    dividing by zero and the estimate is the constant."""
+    est = slo.P2Quantile(0.9)
+    for _ in range(100):
+        est.observe(2.5)
+    assert est.value() == 2.5
+
+
+# ------------------------------------------------------------------- specs
+
+
+def test_spec_validation_rejects_bad_parameters():
+    with pytest.raises(ValueError, match="unknown SLO id"):
+        _spec(id="serve.phantom")
+    with pytest.raises(ValueError, match="unknown phase"):
+        _spec(phase="not.a.phase")
+    with pytest.raises(ValueError, match="quantile"):
+        _spec(quantile=1.5)
+    with pytest.raises(ValueError, match="objective"):
+        _spec(objective=1.0)  # no budget to burn
+    with pytest.raises(ValueError, match="target_s"):
+        _spec(target_s=0.0)
+    with pytest.raises(ValueError, match="duplicate SLO id"):
+        slo.SLOEngine([_spec(), _spec(target_s=0.2)])
+
+
+def test_default_slos_cover_the_vocabulary_exactly():
+    assert {spec.id for spec in slo.DEFAULT_SLOS} == set(slo.SLO_SPECS)
+    assert slo.SLO_SPECS == lint_registry.SLO_REGISTRY
+    # ...and every default spec's phase really is a telemetry phase.
+    for spec in slo.DEFAULT_SLOS:
+        assert spec.phase in telemetry.PHASES
+
+
+# ----------------------------------------------------------- burn windows
+
+
+def test_burn_math_is_exact():
+    clock = [0.0]
+    engine = slo.SLOEngine([_spec()], clock=lambda: clock[0])
+    for _ in range(8):
+        engine.observe("serve.ask", 0.01)  # good: under the 0.1s target
+    for _ in range(2):
+        engine.observe("serve.ask", 0.5)  # bad
+    status = engine.status()[0]
+    assert (status.good_long, status.bad_long) == (8, 2)
+    assert status.compliance_long == pytest.approx(0.8)
+    # budget = 1 - 0.9 = 0.1; ratio 0.2 -> burn 2.0 on both windows.
+    assert status.burn_long == pytest.approx(2.0)
+    assert status.burn_short == pytest.approx(2.0)
+    assert not status.burning  # 2 violations sit under the evidence floor
+    engine.observe("serve.ask", 0.5)  # the third violation crosses it
+    assert engine.status()[0].burning
+
+
+def test_burning_requires_the_violation_floor():
+    clock = [0.0]
+    engine = slo.SLOEngine([_spec()], clock=lambda: clock[0])
+    engine.observe("serve.ask", 0.5)
+    engine.observe("serve.ask", 0.5)
+    status = engine.status()[0]
+    assert status.burn_long > slo.BURN_CRITICAL  # the rate is extreme...
+    assert not status.burning  # ...but 2 violations < the evidence floor
+    engine.observe("serve.ask", 0.5)
+    status = engine.status()[0]
+    assert status.burning and status.critical
+
+
+def test_windows_expire_on_the_injected_clock():
+    """The multi-window semantics without real waiting: violations age out
+    of the short window (window/12) first, then out of the long window."""
+    clock = [0.0]
+    engine = slo.SLOEngine([_spec(window_s=60.0)], clock=lambda: clock[0])
+    for _ in range(4):
+        engine.observe("serve.ask", 0.5)  # bad at t=0
+    status = engine.status()[0]
+    assert status.bad_short == 4 and status.bad_long == 4
+    assert status.burning
+    clock[0] = 10.0  # past the 5s short window, inside the 60s long one
+    status = engine.status()[0]
+    assert status.bad_short == 0 and status.bad_long == 4
+    assert not status.burning  # the short window recovered: no flap
+    clock[0] = 70.0  # past the long window: everything expired
+    status = engine.status()[0]
+    assert status.bad_long == 0 and status.good_long == 0
+    assert status.burn_long == 0.0
+
+
+def test_non_sketched_phases_are_ignored_cheaply():
+    engine = slo.SLOEngine([_spec()])
+    engine.observe("ask", 1e9)  # not a spec'd phase
+    status = engine.status()[0]
+    assert status.good_long == 0 and status.bad_long == 0
+
+
+def test_engine_observe_is_thread_safe():
+    engine = slo.SLOEngine([_spec()])
+    start = threading.Barrier(8)
+    errors: list[BaseException] = []
+
+    def hammer():
+        try:
+            start.wait()
+            for _ in range(500):
+                engine.observe("serve.ask", 0.01)
+        except BaseException as err:  # pragma: no cover - asserted below
+            errors.append(err)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    status = engine.status()[0]
+    assert status.good_long == 8 * 500  # zero lost updates
+
+
+# ------------------------------------------------------------ sink wiring
+
+
+def test_span_feeds_the_engine_even_with_telemetry_disabled():
+    """The sink contract: the SLO engine sees every phase span with zero
+    new instrumentation, independent of the metrics registry's switch."""
+    ticks = iter([10.0, 10.25])
+    telemetry.enable(telemetry.MetricsRegistry(clock=lambda: next(ticks)))
+    telemetry.disable()  # registry off; only the slo sink is armed
+    slo.enable(specs=[_spec()], clock=lambda: 0.0)
+    with telemetry.span("serve.ask"):
+        pass
+    status = slo.get_engine().status()[0]
+    assert (status.good_long, status.bad_long) == (0, 1)  # 0.25s > 0.1s
+    # The registry recorded nothing: it was off.
+    assert telemetry.snapshot()["histograms"] == {}
+
+
+def test_observe_phase_feeds_the_engine():
+    slo.enable(specs=[_spec(id="tell.latency", phase="tell", target_s=1.0)],
+               clock=lambda: 0.0)
+    telemetry.observe_phase("tell", 0.5)
+    telemetry.observe_phase("tell", 2.0)
+    status = slo.get_engine().status()[0]
+    assert (status.good_long, status.bad_long) == (1, 1)
+
+
+def test_disabled_slo_restores_the_shared_null_span():
+    slo.enable(specs=[_spec()])
+    telemetry.disable()
+    assert telemetry.span("serve.ask") is not telemetry.span("tell")  # live
+    slo.disable()
+    assert telemetry.span("serve.ask") is telemetry.span("tell")  # null again
+    with telemetry.span("serve.ask"):
+        pass
+    assert slo.burning_slo_ids() == ()
+    assert slo.export_report()["enabled"] is False
+
+
+# ----------------------------------------------------------------- exports
+
+
+def test_prometheus_lines_join_the_exposition_and_parse():
+    slo.enable(specs=[_spec()], clock=lambda: 0.0)
+    telemetry.count("storage.retry")
+    with telemetry.span("serve.ask"):
+        pass
+    text = telemetry.render_prometheus()
+    samples = _parse_exposition(text)
+    by_key = {(name, tuple(sorted(labels.items()))): value
+              for name, labels, value in samples}
+    quantile_key = (
+        "optuna_tpu_slo_quantile_seconds",
+        (("phase", "serve.ask"), ("quantile", "0.99"), ("slo", "serve.ask.latency")),
+    )
+    assert quantile_key in by_key
+    assert (
+        "optuna_tpu_slo_burn_rate",
+        (("phase", "serve.ask"), ("slo", "serve.ask.latency"), ("window", "long")),
+    ) in by_key
+    assert by_key[
+        ("optuna_tpu_slo_compliance_ratio",
+         (("phase", "serve.ask"), ("slo", "serve.ask.latency"), ("window", "long")))
+    ] in (0.0, 1.0)
+    # The registry's own series still render beside them.
+    assert by_key[("optuna_tpu_storage_retry_total", ())] == 1
+    slo.disable()
+    assert "optuna_tpu_slo_" not in telemetry.render_prometheus()
+
+
+def test_slo_json_endpoint_beside_metrics():
+    slo.enable(specs=[_spec()], clock=lambda: 0.0)
+    with telemetry.span("serve.ask"):
+        pass
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        payload = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/slo.json", timeout=10
+            ).read().decode()
+        )
+        assert payload["enabled"] is True
+        assert [entry["id"] for entry in payload["slos"]] == ["serve.ask.latency"]
+        assert payload["slos"][0]["observations"]["long"]["good"] + (
+            payload["slos"][0]["observations"]["long"]["bad"]
+        ) == 1
+    finally:
+        server.shutdown()
+
+
+def test_worker_snapshot_publishes_deltas():
+    slo.enable(specs=[_spec()], clock=lambda: 0.0)
+    engine = slo.get_engine()
+    engine.observe("serve.ask", 0.5)
+    baseline = slo.cumulative_counts()
+    assert baseline == {"serve.ask.latency": (0, 1)}
+    engine.observe("serve.ask", 0.01)
+    engine.observe("serve.ask", 0.5)
+    block = slo.worker_snapshot(baseline)
+    assert block["serve.ask.latency"]["good"] == 1
+    assert block["serve.ask.latency"]["bad"] == 1  # delta, not cumulative
+    assert "burn_long" in block["serve.ask.latency"]
+    # Nothing moved since a fresh baseline + not burning -> omitted.
+    assert slo.worker_snapshot(slo.cumulative_counts()) == {}
+
+
+def test_cli_slo_smoke(capsys):
+    from optuna_tpu.cli import main as cli_main
+
+    slo.enable(specs=[_spec()], clock=lambda: 0.0)
+    with telemetry.span("serve.ask"):
+        pass
+    assert cli_main(["slo", "-f", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["enabled"] is True
+    assert payload["slos"][0]["id"] == "serve.ask.latency"
+    assert cli_main(["slo"]) == 0
+    text = capsys.readouterr().out
+    assert "serve.ask.latency" in text
+
+
+def test_cli_slo_endpoint(capsys):
+    from optuna_tpu.cli import main as cli_main
+
+    slo.enable(specs=[_spec()], clock=lambda: 0.0)
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        assert cli_main(["slo", "-f", "json", "--endpoint",
+                         f"http://localhost:{port}"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["enabled"] is True
+    finally:
+        server.shutdown()
+
+
+def test_reset_forgets_observations_but_keeps_specs():
+    slo.enable(specs=[_spec()], clock=lambda: 0.0, quantiles=(0.5, 0.999))
+    slo.get_engine().observe("serve.ask", 0.5)
+    slo.reset()
+    status = slo.get_engine().status()[0]
+    assert status.bad_long == 0
+    assert slo.get_engine().specs[0].id == "serve.ask.latency"
+    # Custom quantiles survive the reset (a fresh engine, not a default one).
+    assert 0.999 in status.quantiles_s
+    # The fresh engine is re-hooked: new spans still feed it.
+    with telemetry.span("serve.ask"):
+        pass
+    assert sum(slo.get_engine().status()[0].quantiles_s.values()) >= 0.0
+    assert slo.get_engine().status()[0].good_long + (
+        slo.get_engine().status()[0].bad_long
+    ) == 1

@@ -53,15 +53,64 @@ def test_counters_and_gauges():
 
 def test_histogram_bucket_placement():
     registry = telemetry.get_registry()
-    registry.observe("latency", 0.00005)  # below the first bound (1e-4)
+    registry.observe("latency", 0.000005)  # below the first bound (1e-5)
     registry.observe("latency", 0.02)  # within the ladder
     registry.observe("latency", 1e6)  # beyond the last bound -> +Inf
     hist = registry.snapshot()["histograms"]["latency"]
     assert hist["count"] == 3
-    assert hist["sum"] == pytest.approx(0.02005 + 1e6)
+    assert hist["sum"] == pytest.approx(0.020005 + 1e6)
     assert hist["buckets"]["+Inf"] == 1
     assert hist["buckets"][f"{telemetry.BUCKET_BOUNDS[0]:.6g}"] == 1
     assert sum(hist["buckets"].values()) == 3
+
+
+def test_bucket_ladder_resolves_the_serve_decade():
+    """The ISSUE-14 satellite: the ladder reaches one decade below 100 µs
+    (10 µs / ~32 µs bounds), so a ~50 µs ready-queue pop and a ~1 ms
+    coalesced ask land in distinct buckets instead of flooring together."""
+    assert telemetry.BUCKET_BOUNDS[0] == pytest.approx(1e-5)
+    assert telemetry.BUCKET_BOUNDS[1] == pytest.approx(10 ** -4.5)
+    registry = telemetry.get_registry()
+    registry.observe("serve", 50e-6)  # a queue pop
+    registry.observe("serve", 1e-3)  # a coalesced ask
+    hist = registry.snapshot()["histograms"]["serve"]
+    occupied = [bound for bound, n in hist["buckets"].items() if n]
+    assert len(occupied) == 2  # distinct buckets, not one floor
+
+
+def test_histogram_state_quantile_interpolates_within_buckets():
+    """`HistogramState.quantile` (and the snapshot-dict helper): Prometheus
+    histogram_quantile semantics — linear inside the crossing bucket, the
+    lowest bucket interpolating from 0, +Inf answering the last bound."""
+    state = telemetry.HistogramState()
+    for _ in range(3):
+        state.observe(2e-5)  # bucket (1e-5, 10^-4.5]
+    state.observe(0.5)  # bucket (0.316, 1]
+    # rank(0.5) = 2 of 4 -> 2/3 through the first occupied bucket.
+    lower, upper = telemetry.BUCKET_BOUNDS[0], telemetry.BUCKET_BOUNDS[1]
+    assert state.quantile(0.5) == pytest.approx(lower + (upper - lower) * (2 / 3))
+    # rank(1.0) = 4 -> fully through the (0.316, 1] bucket.
+    assert state.quantile(1.0) == pytest.approx(1.0)
+    # The dict-shaped twin (snapshot form) answers identically.
+    snap_hist = {
+        "count": state.count,
+        "sum": state.total,
+        "buckets": {
+            f"{bound:.6g}": state.bucket_counts[i]
+            for i, bound in enumerate(telemetry.BUCKET_BOUNDS)
+        } | {"+Inf": state.bucket_counts[-1]},
+    }
+    assert telemetry.histogram_quantile(snap_hist, 0.5) == pytest.approx(
+        state.quantile(0.5)
+    )
+    # Sub-100µs observations are no longer floored: the p50 of pure 20 µs
+    # traffic reads in the 10–32 µs bucket, not at 100 µs.
+    assert state.quantile(0.4) < 1e-4
+    # Empty histogram and +Inf tail edge cases.
+    assert telemetry.HistogramState().quantile(0.99) == 0.0
+    tail = telemetry.HistogramState()
+    tail.observe(1e9)
+    assert tail.quantile(0.99) == telemetry.BUCKET_BOUNDS[-1]
 
 
 def test_span_times_with_injected_clock():
