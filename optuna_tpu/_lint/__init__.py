@@ -20,6 +20,7 @@ OBS005   SLO objective vocabularies drifted from the canonical one
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 SRV001   suggestion-service shed policy sets drifted from the canonical one
+ACT001   autopilot action vocabularies drifted from the canonical one
 EXE001   non-finite quarantine policy sets drifted from the canonical one
 SMP001   sampler fallback policy sets drifted from the canonical one
 SMP002   bare Cholesky in sampler code (route through ladder_cholesky)
@@ -64,6 +65,7 @@ def all_rules() -> list[Rule]:
         SMP002LadderCholeskyOnly,
     )
     from optuna_tpu._lint.rules_storage import (
+        ACT001ActionRegistrySync,
         EXE001NonFinitePolicySync,
         SRV001ShedPolicySync,
         STO001ReplayRegistrySync,
@@ -83,6 +85,7 @@ def all_rules() -> list[Rule]:
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
         SRV001ShedPolicySync(),
+        ACT001ActionRegistrySync(),
         EXE001NonFinitePolicySync(),
         SMP001FallbackPolicySync(),
         SMP002LadderCholeskyOnly(),

@@ -105,6 +105,10 @@ class Config:
     srv001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.SHED_POLICY_REGISTRY
     )
+    act001_targets: tuple[tuple[str, str, str], ...] = registry.ACT001_TARGETS
+    act001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.AUTOPILOT_ACTION_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

@@ -157,6 +157,7 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
     "serve.shed": "(suffixed by policy) an overloaded ask was degraded or refused by the shed ladder",
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
+    "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
 }
 
 #: The flight recorder's event-kind vocabulary: canonical mirror of
@@ -332,6 +333,41 @@ OBS005_TARGETS: tuple[tuple[str, str, str], ...] = (
         "optuna_tpu/testing/fault_injection.py",
         "SLO_CHAOS_MATRIX",
         "chaos matrix: every SLO must have a burn scenario that trips it",
+    ),
+)
+
+#: The autopilot's guarded-action vocabulary: every remediation the
+#: doctor-driven control loop (``optuna_tpu/autopilot.py``) can decide —
+#: and every ``autopilot.action.*`` counter, flight event, and
+#: ``autopilot:action:*`` study attr derived from one — carries one of
+#: these ids. Canonical mirror of ``autopilot.ACTIONS`` (rule **ACT001**,
+#: the STO001 machinery pointed at the actuators themselves). Values say
+#: which doctor finding triggers the action and what knob it turns; every
+#: id must have a chaos scenario in ``testing/fault_injection.py::
+#: AUTOPILOT_CHAOS_MATRIX`` (same rule) — an action nobody has proven
+#: fires, executes, and rolls back is a remediation that may fire for the
+#: first time in production, unattended.
+AUTOPILOT_ACTION_REGISTRY: dict[str, str] = {
+    "sampler.restart": "study.stagnation -> reseed + a bounded independent exploration burst via GuardedSampler",
+    "sampler.pin_independent": "sampler.fallback_storm -> pre-emptively pin the independent path for N trials (skip the failing fit)",
+    "executor.pin_shapes": "jit.retrace_churn -> freeze the executor's batch width at the dominant compiled width",
+    "executor.tighten_regrowth": "executor.quarantine_rate -> stretch the executor's probationary batch-regrowth streak",
+    "service.shed_earlier": "service.slo_burn/service.backpressure -> halve the shed thresholds and widen ready-queue prewarm",
+}
+
+#: The hand-maintained copies ACT001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+ACT001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/autopilot.py",
+        "ACTIONS",
+        "the control loop's accepted action ids (validated on every decision)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "AUTOPILOT_CHAOS_MATRIX",
+        "chaos matrix: every guarded action must have a fault scenario that forces it",
     ),
 )
 

@@ -190,6 +190,27 @@ class SRV001ShedPolicySync(_RegistrySyncRule):
         return config.srv001_targets
 
 
+class ACT001ActionRegistrySync(_RegistrySyncRule):
+    """The STO001/.../SRV001 anti-drift machinery pointed at the autopilot's
+    guarded-action vocabulary: ``autopilot.py::ACTIONS`` and the chaos
+    matrix ``fault_injection.py::AUTOPILOT_CHAOS_MATRIX`` must both equal
+    the canonical ``registry.AUTOPILOT_ACTION_REGISTRY`` — a remediation
+    added without a chaos scenario proving it fires, executes, and rolls
+    back is a lint failure, not a review comment: an unproven action fires
+    for the first time in production, unattended, on a study nobody is
+    watching."""
+
+    id = "ACT001"
+    title = "autopilot action vocabularies out of sync"
+    noun = "autopilot actions"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.act001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.act001_targets
+
+
 # --------------------------------------------------------------------- STO002
 
 

@@ -8,8 +8,9 @@ registry (``optuna_tpu/telemetry.py``), the ``trace`` dump of the flight
 recorder's Chrome-trace timeline (``optuna_tpu/flight.py``), the ``doctor``
 report of the study doctor's fleet diagnostics (``optuna_tpu/health.py``),
 the ``slo`` report of the SLO engine's quantiles and burn rates
-(``optuna_tpu/slo.py``), and the ``trajectory`` rendering of the committed
-perf ledger (``BENCH_TRAJECTORY.json``).
+(``optuna_tpu/slo.py``), the ``autopilot`` action log of the doctor-driven
+remediation loop (``optuna_tpu/autopilot.py``), and the ``trajectory``
+rendering of the committed perf ledger (``BENCH_TRAJECTORY.json``).
 
 Entry points: ``python -m optuna_tpu.cli ...`` or the ``optuna-tpu`` console
 script.
@@ -332,6 +333,14 @@ def _cmd_doctor(args: argparse.Namespace) -> None:
         url = base if base.endswith("/health.json") else base + "/health.json"
         with urllib.request.urlopen(url, timeout=10) as response:
             payload = json.loads(response.read().decode())
+        if payload.get("enabled") is False:
+            # The structured not-armed payload (vs a 404 for a typo'd
+            # path): the process is reachable but has no storage to
+            # aggregate fleet reports over.
+            raise CLIUsageError(
+                "the endpoint's doctor is not armed: "
+                + payload.get("reason", "no health_source on that process")
+            )
         reports = payload.get("reports", [])
         report = next(
             (r for r in reports if r.get("study") == args.study_name), None
@@ -351,7 +360,96 @@ def _cmd_doctor(args: argparse.Namespace) -> None:
     if args.format == "json":
         print(json.dumps(report, sort_keys=True))
     else:
-        print(health.render_text(report))
+        from optuna_tpu import autopilot
+
+        # "would act" column: when an autopilot policy is configured in
+        # this process (OPTUNA_TPU_AUTOPILOT / autopilot.enable()), each
+        # finding shows the guarded action the control loop would take.
+        would_act = (
+            {check: autopilot.action_for(check) for check in health.HEALTH_CHECKS}
+            if autopilot.enabled()
+            else None
+        )
+        print(health.render_text(report, would_act=would_act))
+
+
+def _cmd_autopilot(args: argparse.Namespace) -> None:
+    """The autopilot's action log (see :mod:`optuna_tpu.autopilot`).
+
+    Without ``--endpoint`` the log is reconstructed from the study's
+    ``autopilot:action:*`` system attrs in ``--storage`` (the act-mode
+    audit mirror, so any operator shell can read what an unattended run
+    did); with ``--endpoint`` it is fetched live from a serving process's
+    ``/autopilot.json``, which additionally carries budget and cooldown
+    clocks only the owning process knows.
+    """
+    from optuna_tpu import autopilot
+
+    if args.endpoint:
+        import urllib.request
+
+        base = args.endpoint.rstrip("/")
+        url = base if base.endswith("/autopilot.json") else base + "/autopilot.json"
+        with urllib.request.urlopen(url, timeout=10) as response:
+            report = json.loads(response.read().decode())
+        if args.study_name:
+            report["autopilots"] = [
+                p for p in report.get("autopilots", [])
+                if p.get("study") == args.study_name
+            ]
+    else:
+        if not args.study_name:
+            raise CLIUsageError(
+                "--study-name is required without --endpoint (the storage "
+                "mirror is per-study)."
+            )
+        storage = _storage(args)
+        study_id = storage.get_study_id_from_name(args.study_name)
+        records = sorted(
+            (
+                value
+                for key, value in storage.get_study_system_attrs(study_id).items()
+                if key.startswith(autopilot.ACTION_ATTR_PREFIX)
+                and isinstance(value, dict)
+            ),
+            key=lambda record: record.get("seq", 0),
+        )
+        if not records:
+            # The storage mirror only holds act-mode decisions, so an empty
+            # mirror is ambiguous — no findings fired, the loop ran in
+            # observe mode, or no loop was armed. Say so instead of the
+            # "not armed" hint, which would tell an operator with a healthy
+            # act-mode study to re-enable something already running.
+            message = (
+                f"no autopilot actions recorded for study "
+                f"{args.study_name!r} (no findings fired, the loop ran in "
+                "observe mode, or no autopilot was armed — the storage "
+                "mirror only holds act-mode decisions; use --endpoint for "
+                "the live loop state)"
+            )
+            if args.format == "json":
+                print(json.dumps(
+                    {"enabled": None, "autopilots": [], "note": message},
+                    sort_keys=True,
+                ))
+            else:
+                print(message)
+            return
+        report = {
+            "enabled": True,
+            "generated_unix": None,
+            "autopilots": [
+                {
+                    "study": args.study_name,
+                    "mode": records[-1].get("mode"),
+                    "actions": records,
+                }
+            ],
+        }
+    if args.format == "json":
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(autopilot.render_text(report))
 
 
 def _cmd_slo(args: argparse.Namespace) -> None:
@@ -603,6 +701,21 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="fetch /health.json from a serving process (e.g. http://host:9090) "
         "instead of aggregating from --storage in this process",
+    )
+
+    p = add("autopilot", _cmd_autopilot)
+    p.add_argument(
+        "--study-name",
+        default=None,
+        help="study whose action log to show (required without --endpoint; "
+        "filters the endpoint report otherwise)",
+    )
+    p.add_argument("-f", "--format", default="text", choices=["text", "json"])
+    p.add_argument(
+        "--endpoint",
+        default=None,
+        help="fetch /autopilot.json from a serving process (e.g. "
+        "http://host:9090) instead of reading the audit mirror from --storage",
     )
 
     p = add("slo", _cmd_slo)

@@ -160,6 +160,13 @@ LIVENESS_GRACE_FACTOR = 2.5
 # ARCHITECTURE.md's check table, so an operator reading a finding can see
 # exactly what tripped it; `diagnose` takes overrides for tests.
 STAGNATION_WINDOW = 16  # completed tells without a new best before flagging
+# Containment guard on the stagnation check: when the trailing finished
+# window is FAIL-dominated (an active NaN burst being quarantined), the
+# sampler never got a fair run of tells, so "no new best" is containment
+# evidence (executor.quarantine_rate's story), not stagnation — flagging it
+# would make the autopilot restart a sampler mid-containment.
+STAGNATION_CONTAINMENT_MIN = 4  # FAILs in the trailing window, and...
+STAGNATION_CONTAINMENT_FRACTION = 0.5  # ...at least this share of it
 FALLBACK_STORM_RATE = 0.25  # fallbacks per finished trial
 FALLBACK_STORM_MIN = 4  # ...and at least this many in absolute terms
 QUARANTINE_RATE = 0.10  # quarantines+reaps per finished trial
@@ -751,6 +758,21 @@ def _check_stagnation(
     if len(completed) <= window:
         return None
     completed.sort(key=lambda t: t.number)
+    # Containment-heavy trailing window: while active NaN containment is
+    # quarantining a FAIL-dominated stretch of tells, the no-new-best
+    # window is measuring the containment layers, not the sampler — skip
+    # (executor.quarantine_rate owns that story; an autopilot restarting
+    # the sampler mid-containment would remediate the wrong layer).
+    finished = sorted(
+        (t for t in trials if t.state.is_finished()), key=lambda t: t.number
+    )
+    recent = finished[-window:]
+    recent_fails = sum(1 for t in recent if t.state == TrialState.FAIL)
+    if (
+        recent_fails >= STAGNATION_CONTAINMENT_MIN
+        and recent_fails >= STAGNATION_CONTAINMENT_FRACTION * len(recent)
+    ):
+        return None
     maximize = directions[0] == StudyDirection.MAXIMIZE
     best_before = None
     for t in completed[:-window]:
@@ -1266,13 +1288,22 @@ def storage_health_reports(
                 storage, frozen._study_id, study_name=frozen.study_name, now=now
             )
         )
-    return {"generated_unix": now, "reports": reports}
+    # ``enabled`` distinguishes an armed doctor (this payload) from the
+    # structured not-armed payload a source-less metrics server serves for
+    # /health.json — the /slo.json contract, so a scraper can always tell
+    # "no doctor wired" from "fleet healthy" from "typo'd path".
+    return {"enabled": True, "generated_unix": now, "reports": reports}
 
 
-def render_text(report: Mapping[str, Any]) -> str:
+def render_text(
+    report: Mapping[str, Any], *, would_act: Mapping[str, str] | None = None
+) -> str:
     """The ``optuna-tpu doctor`` table rendering of one report: verdict
     line, worker liveness, fleet containment counters, then one block per
-    finding with evidence and remediation."""
+    finding with evidence and remediation. ``would_act`` maps check ids to
+    autopilot action ids — when an autopilot policy is configured the CLI
+    passes :data:`optuna_tpu.autopilot.ACTION_TRIGGERS`' reverse map, and
+    each actionable finding gains a "would act" line."""
     lines: list[str] = []
     verdict = "HEALTHY" if report["healthy"] else (
         f"{len(report['findings'])} finding(s)"
@@ -1310,6 +1341,13 @@ def render_text(report: Mapping[str, Any]) -> str:
             lines.append(f"    {key}: {finding['evidence'][key]}")
         if finding["remediation"]:
             lines.append(f"    -> {finding['remediation']}")
+        if would_act is not None:
+            action = would_act.get(finding["check"])
+            lines.append(
+                f"    would act: {action}"
+                if action
+                else "    would act: (no autopilot action for this check)"
+            )
     return "\n".join(lines)
 
 

@@ -41,7 +41,7 @@ from typing import TYPE_CHECKING, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, device_stats, flight, health, telemetry
+from optuna_tpu import _tracing, autopilot, device_stats, flight, health, telemetry
 from optuna_tpu.exceptions import OptunaTPUError, UpdateFinishedTrialError
 from optuna_tpu.logging import get_logger, warn_once
 from optuna_tpu.storages._callbacks import EXECUTOR_ATTR_PREFIX
@@ -62,6 +62,7 @@ from optuna_tpu.trial._trial import Trial
 if TYPE_CHECKING:
     import jax
 
+    from optuna_tpu.autopilot import AutopilotPolicy
     from optuna_tpu.parallel.vectorized import VectorizedObjective
     from optuna_tpu.study.study import Study
     from optuna_tpu.trial._frozen import FrozenTrial
@@ -201,6 +202,7 @@ class ResilientBatchExecutor:
         bisect_on_error: bool = True,
         retry_policy: RetryPolicy | None = None,
         dispatch_deadline_s: float | None = None,
+        autopilot: "str | AutopilotPolicy | None" = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if non_finite not in NON_FINITE_POLICIES:
@@ -251,6 +253,11 @@ class ResilientBatchExecutor:
         self._batch_size = batch_size
         self._requested_batch_size = batch_size
         self._grow_streak = 0
+        # Probationary regrowth: clean full-width batches needed per
+        # doubling back toward the requested size. The autopilot's
+        # tighten_regrowth action stretches this under a quarantine storm.
+        self._grow_streak_required = 2
+        self._autopilot_request = autopilot
         self._oom_seen = False
         self._oom_attempts = 0
         self._timeout_strikes = 0
@@ -285,6 +292,10 @@ class ResilientBatchExecutor:
         # anything, so its delta baseline excludes an earlier study's
         # counters (no-op while the reporter is off).
         health.attach(study)
+        # Attach the autopilot before the first batch too (same baseline
+        # rationale); a no-op unless this run, the study, or the module
+        # switch opted in — the disabled path allocates nothing per batch.
+        autopilot.attach(study, config=self._autopilot_request)
         try:
             done = 0
             # OPTUNA_TPU_TRACE covers the vectorized loop the same way
@@ -378,6 +389,10 @@ class ResilientBatchExecutor:
         # Batch-boundary health publish (rate-limited; one module-global
         # check while the reporter is disabled).
         health.maybe_report(study)
+        # Batch-boundary autopilot step (rate-limited; one dict lookup
+        # while no control loop is attached): this executor is the action
+        # target for the batch-width actuators.
+        autopilot.maybe_step(study, executor=self)
         return len(trials)
 
     def _suggest_and_run(
@@ -413,13 +428,49 @@ class ResilientBatchExecutor:
         ):
             return
         self._grow_streak += 1
-        if self._grow_streak >= 2:
+        if self._grow_streak >= self._grow_streak_required:
             self._grow_streak = 0
             self._batch_size = min(self._requested_batch_size, self._batch_size * 2)
             _logger.info(
-                f"two clean batches at the clamped width; growing batch_size "
-                f"back to {self._batch_size}."
+                f"{self._grow_streak_required} clean batches at the clamped "
+                f"width; growing batch_size back to {self._batch_size}."
             )
+
+    # ------------------------------------------------- autopilot actuators
+
+    def autopilot_pin_batch_width(self) -> Callable[[], None]:
+        """Freeze the dispatch width at the current (dominant compiled)
+        batch size: regrowth probes stop, so every later batch re-dispatches
+        at a width the device has already compiled — the autopilot's
+        ``executor.pin_shapes`` remediation for runtime retrace churn. OOM
+        halving still shrinks below the pin (safety beats shape stability).
+        Returns the undo that restores the requested width."""
+        previous = self._requested_batch_size
+        self._requested_batch_size = self._batch_size
+        self._grow_streak = 0
+
+        def undo() -> None:
+            self._requested_batch_size = previous
+
+        return undo
+
+    def autopilot_tighten_regrowth(self, streak: int = 8) -> Callable[[], None]:
+        """Stretch the probationary batch-regrowth schedule: ``streak``
+        clean full-width batches (instead of 2) buy each doubling back
+        toward the requested size — the autopilot's
+        ``executor.tighten_regrowth`` remediation while quarantines/OOMs
+        are eating the budget. Returns the undo that restores the previous
+        schedule."""
+        if streak < 1:
+            raise ValueError(f"streak must be >= 1; got {streak}.")
+        previous = self._grow_streak_required
+        self._grow_streak_required = int(streak)
+        self._grow_streak = 0
+
+        def undo() -> None:
+            self._grow_streak_required = previous
+
+        return undo
 
     def _ask_batch(self, b: int) -> tuple[list[Trial], list | None]:
         """Create the batch's trials (one storage commit). A sampler that

@@ -62,7 +62,7 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 
 import numpy as np
 
-from optuna_tpu import _tracing, device_stats, flight, health, telemetry
+from optuna_tpu import _tracing, autopilot, device_stats, flight, health, telemetry
 from optuna_tpu.distributions import (
     BaseDistribution,
     CategoricalDistribution,
@@ -436,6 +436,10 @@ def optimize_scan(
     study._stop_flag = False
     study._thread_local.in_optimize_loop = True
     health.attach(study)
+    # Attach the autopilot at the loop's entry (no-op unless opted in): the
+    # scan loop has no sampler/executor actuators, but an attached observe
+    # loop still diagnoses and logs at every chunk sync.
+    autopilot.attach(study)
     try:
         with _tracing.maybe_trace_from_env():
             _run_scan(
@@ -686,6 +690,8 @@ def _sync_results(study, space, space_dict, xs, vals, fins, callbacks) -> None:
         raise
     finally:
         health.maybe_report(study)
+        # Chunk-boundary autopilot step (one dict lookup while disabled).
+        autopilot.maybe_step(study)
 
 
 def _fail_remaining(study, trials, reason: str) -> None:

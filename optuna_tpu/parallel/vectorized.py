@@ -150,6 +150,7 @@ def optimize_vectorized(
     bisect_on_error: bool = True,
     retry_policy: "RetryPolicy | None" = None,
     dispatch_deadline_s: float | None = None,
+    autopilot: "str | Any | None" = None,
 ) -> None:
     """Run ``n_trials`` in device-wide batches, fault-tolerantly.
 
@@ -167,8 +168,11 @@ def optimize_vectorized(
     ``'raise'`` surfaces it; ``None`` — the default — inherits a
     ``GuardedSampler`` study's own policy), ``bisect_on_error`` isolates poison
     trials by batch bisection instead of failing the whole dispatch,
-    ``retry_policy`` paces OOM batch-halving, and ``dispatch_deadline_s``
-    bounds a hung device dispatch.
+    ``retry_policy`` paces OOM batch-halving, ``dispatch_deadline_s``
+    bounds a hung device dispatch, and ``autopilot``
+    (``"observe"``/``"act"`` or an
+    :class:`~optuna_tpu.autopilot.AutopilotPolicy`) arms the doctor-driven
+    remediation control loop at this run's batch boundaries.
     """
     from optuna_tpu.parallel.executor import ResilientBatchExecutor
 
@@ -184,4 +188,5 @@ def optimize_vectorized(
         bisect_on_error=bisect_on_error,
         retry_policy=retry_policy,
         dispatch_deadline_s=dispatch_deadline_s,
+        autopilot=autopilot,
     ).run(n_trials)

@@ -334,6 +334,18 @@ class GuardedSampler(BaseSampler):
         self._clock = clock
         self._warn_token = next(_guard_instance_seq)
         self._fallback_random: BaseSampler | None = None
+        # Autopilot actuator (see optuna_tpu/autopilot.py): while any pin
+        # holds suggestions, the next relative suggestions skip the wrapped
+        # sampler entirely and resolve every dimension through the
+        # independent path — the pre-emptive form of the per-trial fallback
+        # this wrapper already contains reactively (one decision instead of
+        # N failed fits). Pins are tokened so two concurrent actions (a
+        # stagnation burst and a storm pin) hold independent reservations:
+        # undoing one must not cancel the other's. Active pins run
+        # concurrently (each suggestion consumes one from every pin), they
+        # do not stack into a longer horizon.
+        self._pins: dict[int, int] = {}
+        self._pin_reasons: dict[int, str] = {}
         #: Why the most recent ``sample_relative_batch`` call *failed* (None
         #: when it succeeded or merely declined). The batch executor reads
         #: this to tell the two Nones apart: a decline routes to per-trial
@@ -355,6 +367,56 @@ class GuardedSampler(BaseSampler):
 
     def __str__(self) -> str:
         return f"GuardedSampler({self._sampler})"
+
+    # -------------------------------------------------- autopilot actuator
+
+    @property
+    def pinned_remaining(self) -> int:
+        """Relative suggestions still pinned to the independent path (the
+        widest active reservation; 0 when unpinned)."""
+        return max(self._pins.values(), default=0)
+
+    def pin_independent(self, n_trials: int, reason: str = "pinned") -> int:
+        """Pin the next ``n_trials`` relative suggestions to the independent
+        path: the wrapped sampler's relative fit is skipped entirely (an
+        empty relative proposal resolves every dimension independently).
+        The autopilot's ``sampler.pin_independent`` / ``sampler.restart``
+        actions call this — one decision instead of paying a failed (or
+        pointless) fit per trial. Returns a token for
+        :meth:`unpin_independent`; concurrent pins hold independent
+        reservations (undoing one leaves the others standing) and run
+        concurrently rather than stacking."""
+        if n_trials < 1:
+            raise ValueError(f"n_trials must be >= 1; got {n_trials}.")
+        token = next(_guard_instance_seq)
+        self._pins[token] = int(n_trials)
+        self._pin_reasons[token] = reason
+        return token
+
+    def unpin_independent(self, token: int | None = None) -> int:
+        """Cancel one pin (or, with no token, every pin) — the autopilot's
+        undo; returns how many pinned suggestions were still outstanding."""
+        if token is None:
+            remaining = self.pinned_remaining
+            self._pins.clear()
+            self._pin_reasons.clear()
+            return remaining
+        self._pin_reasons.pop(token, None)
+        return self._pins.pop(token, 0)
+
+    def _consume_pin(self, n: int) -> bool:
+        """Advance every active pin by ``n`` suggestions; True while any
+        was active (the suggestions are pinned)."""
+        if not self._pins:
+            return False
+        for token in list(self._pins):
+            left = self._pins[token] - n
+            if left > 0:
+                self._pins[token] = left
+            else:
+                self._pins.pop(token)
+                self._pin_reasons.pop(token, None)
+        return True
 
     # -------------------------------------------------------------- plumbing
 
@@ -445,6 +507,12 @@ class GuardedSampler(BaseSampler):
         trial: FrozenTrial,
         search_space: dict[str, BaseDistribution],
     ) -> dict[str, Any]:
+        if self._consume_pin(1):
+            # Autopilot pin: skip the wrapped sampler's fit for this trial —
+            # an empty relative proposal routes every dimension through the
+            # independent path (exactly the contained-fallback result,
+            # decided up front instead of paid for per failed fit).
+            return {}
         try:
             params = self._timed(
                 lambda: self._sampler.sample_relative(study, trial, search_space),
@@ -477,6 +545,13 @@ class GuardedSampler(BaseSampler):
         wrapper guards trial by trial — when the wrapped sampler lacks the
         hook, declines, or fails."""
         self.last_batch_fallback_reason = None
+        if self._consume_pin(batch_size):
+            # Autopilot pin, batch form: answer the whole batch with empty
+            # relative proposals in one decision (each consumes one pinned
+            # suggestion; a pin narrower than the batch still covers it —
+            # partial pins would split one dispatch into two sampling
+            # regimes for no containment benefit).
+            return [{} for _ in range(batch_size)]
         inner = getattr(self._sampler, "sample_relative_batch", None)
         if inner is None:
             return None

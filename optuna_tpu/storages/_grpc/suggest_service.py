@@ -606,6 +606,12 @@ class SuggestService:
         self._refill_demand: set[int] = set()
         self._refill_cond = threading.Condition()
         self._refill_thread: threading.Thread | None = None
+        # Register as an autopilot action target: the service.shed_earlier
+        # remediation drives this hub's shed thresholds + ready-queue
+        # prewarm (one weakref write; nothing runs while autopilot is off).
+        from optuna_tpu import autopilot
+
+        autopilot.note_service(self)
 
     # ------------------------------------------------------------ plumbing
 
@@ -644,6 +650,14 @@ class SuggestService:
             # channel under a service-suffixed worker id, so the doctor's
             # backpressure/starvation checks can see them from anywhere.
             health.attach(study, worker_id=health.default_worker_id() + "-serve")
+        if existing is handle:
+            from optuna_tpu import autopilot
+
+            # The hub's own control loop (no-op unless opted in): the
+            # service.* findings have their one actuator here, so the hub
+            # attaches at handle creation the way optimize loops attach at
+            # entry.
+            autopilot.attach(study)
         return existing
 
     def _fresh_trials_view(self, handle: _StudyHandle) -> None:
@@ -1079,6 +1093,13 @@ class SuggestService:
                 from optuna_tpu import health
 
                 health.maybe_report(handle.study)
+            # Tell-boundary autopilot step for the hub's own loop (one dict
+            # lookup while disabled): the hub is where the service.* checks
+            # have their actuator, so its control loop steps on the tells
+            # its thin clients land.
+            from optuna_tpu import autopilot
+
+            autopilot.maybe_step(handle.study, service=self)
 
     # ------------------------------------------------------------ lifecycle
 

@@ -21,7 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable, Sequence
 
-from optuna_tpu import exceptions, flight, health, logging as logging_module, telemetry
+from optuna_tpu import autopilot, exceptions, flight, health, logging as logging_module, telemetry
 from optuna_tpu.progress_bar import _ProgressBar
 from optuna_tpu.study._tell import _tell_with_warning
 from optuna_tpu.trial._frozen import FrozenTrial
@@ -216,6 +216,9 @@ def _worker(
             # Trial-boundary health publish (rate-limited; one module-global
             # check while the reporter is disabled).
             health.maybe_report(study)
+            # Trial-boundary autopilot step (rate-limited; one dict lookup
+            # while no control loop is attached).
+            autopilot.maybe_step(study)
         except BaseException:  # graphlint: ignore[PY001] -- halt-then-reraise: the trial budget must stop even on SimulatedWorkerDeath/SystemExit; nothing is swallowed
             budget.halt()
             raise
@@ -251,6 +254,9 @@ def _optimize(
     # so its delta baseline excludes whatever an earlier study left in the
     # process-global registry (no-op while the reporter is off).
     health.attach(study)
+    # Attach the autopilot too (same baseline rationale; no-op unless the
+    # study or the module switch opted in).
+    autopilot.attach(study)
 
     try:
         if n_jobs == 1:

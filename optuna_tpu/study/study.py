@@ -51,6 +51,7 @@ class Study:
         pruner: "BasePruner | None" = None,
         *,
         sampler_fallback: str | None = None,
+        autopilot: "str | Any | None" = None,
     ) -> None:
         from optuna_tpu.pruners import MedianPruner
         from optuna_tpu.storages import get_storage
@@ -73,6 +74,12 @@ class Study:
             if not isinstance(self.sampler, GuardedSampler):
                 self.sampler = GuardedSampler(self.sampler, fallback=sampler_fallback)
         self.pruner = pruner or MedianPruner()
+        if autopilot is not None:
+            # Doctor-driven remediation control loop (optuna_tpu/autopilot):
+            # "observe" logs would-have-acted decisions, "act" executes
+            # guarded actions; an AutopilotPolicy carries the full knob set.
+            # The loop itself attaches lazily at each optimize loop's entry.
+            self._autopilot_request = autopilot
 
         self._thread_local = _ThreadLocalStudyAttribute()
         self._stop_flag = False
@@ -84,6 +91,10 @@ class Study:
         # by identity — its worker id embeds this pid and it holds a lock —
         # so an unpickled study mints a fresh one on its first report.
         state.pop("_health_reporter", None)
+        # Same for the autopilot: its baselines, locks, and action targets
+        # are all per-process; the `_autopilot_request` config survives, so
+        # an unpickled study re-attaches a fresh loop at its next optimize.
+        state.pop("_autopilot", None)
         return state
 
     def __setstate__(self, state: dict[str, Any]) -> None:

@@ -116,6 +116,7 @@ COUNTERS: dict[str, str] = {
     "journal.lock_contention": "a journal lock acquire found the lock held and backed off",
     "serve.shed": "(suffixed by policy) an overloaded ask was degraded or refused by the shed ladder",
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
+    "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
 }
 
 _PHASE_METRIC_PREFIX = "phase."
@@ -665,15 +666,18 @@ def serve_metrics(
     it runs), ``/metrics.json`` (the :func:`snapshot` dict), ``/trace.json``
     (the flight recorder's Chrome-trace export — empty ``traceEvents``
     while flight recording is off), ``/slo.json`` (the SLO engine's
-    quantile/compliance/burn report — ``enabled: false`` while off), and —
-    when ``health_source`` is given —
+    quantile/compliance/burn report — ``enabled: false`` while off),
+    ``/autopilot.json`` (the autopilot's action log and cooldown clocks —
+    ``enabled: false`` while no control loop is attached), and
     ``/health.json`` (the study doctor's fleet reports; the gRPC proxy
     server passes :func:`optuna_tpu.health.storage_health_reports` over its
     backing storage, the one process that can see the whole fleet). Without
-    a source, ``/health.json`` is 404: this process has no storage to
-    aggregate over. Stdlib-only; used by the gRPC proxy server's
-    ``metrics_port=`` knob so a fleet scraper can watch the storage hub
-    without extra dependencies."""
+    a ``health_source``, ``/health.json`` serves a structured
+    ``{"enabled": false, ...}`` payload — the ``/slo.json`` contract — so a
+    dashboard probing a source-less process sees "not armed", never a 404
+    indistinguishable from a typo'd path. Stdlib-only; used by the gRPC
+    proxy server's ``metrics_port=`` knob so a fleet scraper can watch the
+    storage hub without extra dependencies."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Handler(BaseHTTPRequestHandler):
@@ -697,17 +701,39 @@ def serve_metrics(
                 # armed", not a 404 indistinguishable from a typo'd path.
                 body = json.dumps(slo.export_report()).encode()
                 content_type = "application/json"
+            elif self.path.split("?")[0] == "/autopilot.json":
+                from optuna_tpu import autopilot
+
+                # Same contract as /slo.json: a probing dashboard must see
+                # "not armed" (enabled: false), never a 404.
+                body = json.dumps(autopilot.export_report()).encode()
+                content_type = "application/json"
             elif self.path.split("?")[0] == "/health.json":
                 if health_source is None:
-                    self.send_error(404)
-                    return
-                try:
-                    payload = health_source()
-                except Exception as err:  # graphlint: ignore[PY001] -- HTTP boundary: a storage blip while aggregating must come back as a 500 to the scraper, never kill the serving thread
-                    self.send_error(500, f"health aggregation failed: {err!r}")
-                    return
-                body = json.dumps(payload).encode()
-                content_type = "application/json"
+                    # The /slo.json contract: a source-less process answers
+                    # with a structured "not armed" payload — a 404 here is
+                    # indistinguishable from a typo'd path, and a scraper
+                    # cannot tell "doctor not wired" from "wrong URL".
+                    body = json.dumps(
+                        {
+                            "enabled": False,
+                            "generated_unix": time.time(),
+                            "reports": [],
+                            "reason": (
+                                "no health_source: this process has no "
+                                "storage to aggregate fleet reports over"
+                            ),
+                        }
+                    ).encode()
+                    content_type = "application/json"
+                else:
+                    try:
+                        payload = health_source()
+                    except Exception as err:  # graphlint: ignore[PY001] -- HTTP boundary: a storage blip while aggregating must come back as a 500 to the scraper, never kill the serving thread
+                        self.send_error(500, f"health aggregation failed: {err!r}")
+                        return
+                    body = json.dumps(payload).encode()
+                    content_type = "application/json"
             else:
                 self.send_error(404)
                 return

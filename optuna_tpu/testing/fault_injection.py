@@ -430,6 +430,94 @@ def plant_dead_worker(
     return snapshot
 
 
+# -------------------------------------------------------------- autopilot chaos
+
+
+# Chaos matrix for the autopilot's guarded actions: every action id the
+# control loop accepts (``autopilot.py::ACTIONS``) maps to the fault
+# scenario ``tests/test_autopilot_chaos.py`` must prove forces it — fires
+# exactly once under cooldown, executes in ``mode="act"``, is recorded in
+# ``mode="observe"`` without mutating anything, and rolls back when its
+# finding does not improve. Deliberately a hand-written literal (not an
+# import of ``autopilot.ACTIONS``): graphlint rule ACT001 cross-checks both
+# against ``_lint/registry.py::AUTOPILOT_ACTION_REGISTRY`` — adding a
+# remediation without deciding how to chaos-prove it is a lint failure
+# (the STO001/.../OBS005 pattern), because an unproven action fires for the
+# first time in production, unattended.
+AUTOPILOT_CHAOS_MATRIX: dict[str, str] = {
+    "sampler.restart": "seed a constant history + a never-improving objective past the "
+    "stagnation window; the action fires once, pins an exploration burst, and — the "
+    "objective never improving — rolls back after rollback_after finished trials",
+    "sampler.pin_independent": "inject NaN proposals at storm rate via FaultySampler under "
+    "GuardedSampler; the action fires once and the pin provably stops the storm (fewer "
+    "inner-sampler suggests than the schedule would have poisoned)",
+    "executor.pin_shapes": "record retrace churn past the threshold (jit totals channel); "
+    "the action freezes the executor's requested width at the compiled width and the undo "
+    "restores it",
+    "executor.tighten_regrowth": "inject NaN batch slots past the quarantine-rate "
+    "threshold; the action stretches the probationary regrowth streak on the live executor",
+    "service.shed_earlier": "count shed asks past the backpressure threshold against a "
+    "live hub; the action halves the ShedPolicy thresholds, doubles ready-queue prewarm, "
+    "and the undo restores both exactly",
+}
+
+
+@dataclass(frozen=True)
+class AutopilotChaosPlan:
+    """One deterministic autopilot chaos scenario: the
+    :class:`HealthChaosPlan` fault mix trimmed to the checks with actuators
+    (stagnation via seeded constant history + never-improving objective,
+    fallback storm via scheduled NaN proposals, an OOM/quarantine pattern
+    via NaN batch slots) plus per-action expectations —
+    ``tests/test_autopilot_chaos.py`` asserts, under ``mode="act"``, that
+    exactly :attr:`expected_actions` fire (once each: the cooldown is the
+    storm guard), each is flight-recorded/attr-mirrored, the never-helped
+    stagnation action rolls back, and the study drains with zero RUNNING;
+    the ``mode="observe"`` twin records the identical decision set while
+    staying bit-identical to the autopilot-off twin; the disabled twin
+    allocates nothing over 10k boundary calls.
+
+    Thresholds cleared with margin: ``n_trials`` never-improving completes
+    over a constant seeded history cross ``stagnation_window``;
+    ``sampler_nan_at`` crosses the fallback-storm rate while leaving most
+    of its schedule unspent for the pin to provably cancel; ``nan_slots``
+    cross the quarantine rate without dominating the stagnation window
+    (the containment guard must not suppress the stagnation finding here).
+    """
+
+    n_trials: int = 24
+    batch_size: int = 8
+    seeded_history_plan: int = 1  # PATHOLOGICAL_HISTORY_PLANS index: constant_values
+    stagnation_window: int = 8
+    nan_slots: Mapping[int, Sequence[int]] = field(
+        default_factory=lambda: {0: (1, 2), 1: (0,)}
+    )
+    sampler_nan_at: tuple[int, ...] = tuple(range(2, 40))
+    cooldown_s: float = 3600.0
+    rollback_after: int = 8
+    pin_trials: int = 64
+    budget: int = 8
+    expected_actions: tuple[str, ...] = (
+        "sampler.restart",
+        "sampler.pin_independent",
+        "executor.tighten_regrowth",
+    )
+    #: The action whose finding provably cannot improve (the objective
+    #: never improves), so the acceptance test asserts its rollback.
+    rollback_action: str = "sampler.restart"
+
+    @property
+    def expected_quarantined(self) -> int:
+        return sum(len(slots) for slots in self.nan_slots.values())
+
+
+def autopilot_chaos_plan() -> AutopilotChaosPlan:
+    """The default :class:`AutopilotChaosPlan` the chaos suite runs — a
+    constant seeded history under a never-improving objective, a 38-deep
+    NaN-proposal schedule, three NaN batch slots, hour-long cooldowns."""
+    return AutopilotChaosPlan()
+
+
 # ------------------------------------------------------------------ SLO chaos
 
 

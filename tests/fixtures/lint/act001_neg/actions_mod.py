@@ -1,0 +1,5 @@
+# graphlint fixture: ACT001 negative — both copies agree with the registry.
+ACTIONS = {
+    "sampler.nudge": "what the action turns",
+    "executor.brake": "what the action turns",
+}

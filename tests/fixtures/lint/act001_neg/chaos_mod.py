@@ -1,0 +1,5 @@
+# graphlint fixture: ACT001 negative — both copies agree with the registry.
+AUTOPILOT_CHAOS_MATRIX = {
+    "sampler.nudge": "inject the drift; the action fires and rolls back",
+    "executor.brake": "inject the storm; the action clamps and the undo restores",
+}

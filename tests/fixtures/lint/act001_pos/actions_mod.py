@@ -1,0 +1,6 @@
+# graphlint fixture: ACT001 — this copy DRIFTED: 'sampler.phantom_action' is extra.
+ACTIONS = {  # EXPECT: ACT001
+    "sampler.nudge": "scenario",
+    "executor.brake": "scenario",
+    "sampler.phantom_action": "scenario",
+}

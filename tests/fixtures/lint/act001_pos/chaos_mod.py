@@ -1,0 +1,2 @@
+# graphlint fixture: ACT001 — this copy DRIFTED: 'executor.brake' is missing.
+AUTOPILOT_CHAOS_MATRIX = {"sampler.nudge": "scenario"}  # EXPECT: ACT001

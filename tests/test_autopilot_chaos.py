@@ -1,0 +1,561 @@
+"""Autopilot chaos acceptance (ISSUE 15): one study under the extended
+fault plan — stagnation (constant seeded history + never-improving
+objective) + fallback storm (scheduled NaN proposals) + an OOM/quarantine
+pattern (NaN batch slots) — driven in ``mode="act"`` must fire each planned
+guarded action exactly once (cooldowns prevent action storms), flight-record
+and attr-mirror every decision, roll back the action whose finding provably
+cannot improve, and drain with zero RUNNING; the ``mode="observe"`` twin
+must record the identical decision set while staying bit-identical to the
+autopilot-off twin; the disabled twin must allocate nothing over 10k
+boundary calls.
+
+Per-action scenarios below the centerpiece give every entry of
+``AUTOPILOT_CHAOS_MATRIX`` its own fault (the chaos-matrix discipline
+graphlint rule ACT001 enforces on the vocabulary).
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import autopilot, flight, health, telemetry
+from optuna_tpu.autopilot import AutopilotPolicy
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.parallel import optimize_vectorized
+from optuna_tpu.samplers import RandomSampler
+from optuna_tpu.samplers._resilience import GuardedSampler
+from optuna_tpu.testing.fault_injection import (
+    AUTOPILOT_CHAOS_MATRIX,
+    PATHOLOGICAL_HISTORY_PLANS,
+    AutopilotChaosPlan,
+    FaultySampler,
+    FaultyVectorizedObjective,
+    autopilot_chaos_plan,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE = {"x": FloatDistribution(0.0, 1.0)}
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    saved_registry = telemetry.get_registry()
+    saved_telemetry = telemetry.enabled()
+    saved_autopilot = autopilot.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    flight.reset_jit_totals()
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_telemetry:
+        telemetry.disable()
+    if not saved_autopilot:
+        autopilot.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _never_improving(params):
+    # >= 1.0 always: the seeded constant-0.0 history stays the best forever,
+    # so study.stagnation holds no matter what the sampler tries — the
+    # provably-unhelpable finding the rollback contract needs.
+    return (params["x"] - 0.3) ** 2 + 1.0
+
+
+def _policy(plan: AutopilotChaosPlan, mode: str) -> AutopilotPolicy:
+    return AutopilotPolicy(
+        mode=mode,
+        interval_s=0.0,  # step at every batch boundary
+        cooldown_s=plan.cooldown_s,
+        budget=plan.budget,
+        rollback_after=plan.rollback_after,
+        pin_trials=plan.pin_trials,
+        overrides={"stagnation_window": plan.stagnation_window},
+    )
+
+
+def _run_twin(plan: AutopilotChaosPlan, mode: str | None):
+    """One fully-faulted study under the plan; ``mode`` None = autopilot
+    off. Every twin shares layering and seeds and differs only in the
+    autopilot knob. Returns (study, faulty objective, final snapshot)."""
+    telemetry.enable(telemetry.MetricsRegistry())
+    flight.reset_jit_totals()
+    optuna_tpu.logging.reset_warn_once()
+    sampler = GuardedSampler(
+        FaultySampler(
+            RandomSampler(seed=0),
+            nan_at=set(plan.sampler_nan_at),
+            force_relative=True,
+        )
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    PATHOLOGICAL_HISTORY_PLANS[plan.seeded_history_plan].populate(
+        study, SPACE, seed=0
+    )
+    obj = FaultyVectorizedObjective(
+        _never_improving, SPACE, nan_at=dict(plan.nan_slots)
+    )
+    kwargs = {} if mode is None else {"autopilot": _policy(plan, mode)}
+    optimize_vectorized(
+        study, obj, n_trials=plan.n_trials, batch_size=plan.batch_size, **kwargs
+    )
+    return study, obj, telemetry.snapshot()
+
+
+def _fingerprint(study) -> list[tuple]:
+    """The bit-identity view of a study's trials: number, state, params,
+    values — everything the autopilot-off contract promises unchanged."""
+    return [
+        (t.number, t.state.name, tuple(sorted(t.params.items())), tuple(t.values or ()))
+        for t in sorted(study.get_trials(deepcopy=False), key=lambda t: t.number)
+    ]
+
+
+def test_act_mode_fires_each_planned_action_once_and_rolls_back():
+    """The centerpiece: stagnation + fallback storm + quarantine pattern in
+    ONE study under mode="act" -> exactly the planned actions fire, once
+    each, flight-recorded and attr-mirrored; the never-helped stagnation
+    action rolls back; the helpful pin is held; the study drains clean."""
+    plan = autopilot_chaos_plan()
+    recorder = flight.FlightRecorder()
+    saved_flight = flight.enabled()
+    flight.enable(recorder)
+    try:
+        study, obj, snap = _run_twin(plan, "act")
+    finally:
+        if not saved_flight:
+            flight.disable()
+    pilot = study.__dict__["_autopilot"]
+    report = pilot.report()
+    actions = report["actions"]
+
+    # Each planned action fired exactly once — the hour-long per-check
+    # cooldown is what keeps a finding that persists across boundaries
+    # from minting an action storm.
+    assert sorted(r["action"] for r in actions) == sorted(plan.expected_actions)
+    by_action = {r["action"]: r for r in actions}
+    assert by_action["sampler.restart"]["check"] == "study.stagnation"
+    assert by_action["sampler.pin_independent"]["check"] == "sampler.fallback_storm"
+    assert by_action["executor.tighten_regrowth"]["check"] == "executor.quarantine_rate"
+
+    # Reversibility: the objective never improves, so the stagnation
+    # restart had no effect and rolled back after rollback_after finished
+    # trials; the storm pin measurably lowered the fallback rate and the
+    # quarantine finding cleared, so both are held.
+    assert by_action["sampler.restart"]["state"] == "rolled_back"
+    assert by_action["sampler.pin_independent"]["state"] == "held"
+    assert by_action["executor.tighten_regrowth"]["state"] == "held"
+
+    # Counted in telemetry, one per decision, plus the lifecycle counters.
+    counters = snap["counters"]
+    for action in plan.expected_actions:
+        assert counters["autopilot.action." + action] == 1
+    assert counters["autopilot.action.rollback"] == 1
+    assert counters["autopilot.action.held"] == 2
+
+    # Flight-recorded: every decision landed as a containment event through
+    # the counter sink while the recorder ran.
+    recorded = [
+        ev.name
+        for ev in recorder.events()
+        if ev.kind == "containment" and ev.name.startswith("autopilot.action.")
+    ]
+    for action in plan.expected_actions:
+        assert "autopilot.action." + action in recorded
+
+    # Attr-mirrored for post-hoc audit, terminal states included.
+    mirrored = {
+        key: value
+        for key, value in study.system_attrs.items()
+        if key.startswith(autopilot.ACTION_ATTR_PREFIX)
+    }
+    assert len(mirrored) == len(plan.expected_actions)
+    assert {v["action"]: v["state"] for v in mirrored.values()} == {
+        "sampler.restart": "rolled_back",
+        "sampler.pin_independent": "held",
+        "executor.tighten_regrowth": "held",
+    }
+
+    # The pin provably stopped the storm: the inner sampler stopped being
+    # consulted after the first batch, so only that batch's schedule
+    # poisoned anything and the fallback count stays far below the
+    # schedule's depth.
+    faulty = study.sampler.sampler
+    assert faulty.suggests == plan.batch_size
+    fallbacks = sum(
+        v for k, v in counters.items() if k.startswith("sampler.fallback")
+    )
+    assert fallbacks < len(plan.sampler_nan_at)
+
+    # The trial ledger survived the whole plan: quarantined slots FAILed,
+    # nothing stranded RUNNING, budget respected.
+    states = [t.state for t in study.trials]
+    assert states.count(TrialState.RUNNING) == 0
+    assert states.count(TrialState.FAIL) == plan.expected_quarantined
+    assert report["budget_left"] == plan.budget - len(plan.expected_actions)
+
+
+def test_observe_twin_records_identical_decisions_and_mutates_nothing():
+    """The dry-run contract: the observe twin's decision set equals the act
+    twin's, nothing is attr-mirrored, no knob moves (the inner sampler
+    keeps being consulted), and the trials are bit-identical to the
+    autopilot-off twin."""
+    plan = autopilot_chaos_plan()
+    act_study, _, _ = _run_twin(plan, "act")
+    observe_study, _, observe_snap = _run_twin(plan, "observe")
+    off_study, _, _ = _run_twin(plan, None)
+
+    observe_pilot = observe_study.__dict__["_autopilot"]
+    act_decisions = {
+        (r["action"], r["check"])
+        for r in act_study.__dict__["_autopilot"].report()["actions"]
+    }
+    observe_records = observe_pilot.report()["actions"]
+    assert {(r["action"], r["check"]) for r in observe_records} == act_decisions
+    # Observe decisions never execute, so they carry no undo and never
+    # transition to held/rolled_back.
+    assert {r["state"] for r in observe_records} == {"observed"}
+    assert not any(r["undo_pending"] for r in observe_records)
+
+    # Mutates nothing: no audit attrs, no pin consumed (the inner sampler
+    # was consulted for every non-pinned suggestion the off twin made).
+    assert not any(
+        key.startswith(autopilot.ACTION_ATTR_PREFIX)
+        for key in observe_study.system_attrs
+    )
+    assert observe_study.sampler.pinned_remaining == 0
+    assert observe_study.sampler.sampler.suggests == off_study.sampler.sampler.suggests
+
+    # Decisions are still counted (the observe log predicts the act log).
+    for action in plan.expected_actions:
+        assert observe_snap["counters"]["autopilot.action." + action] == 1
+
+    # Bit-identical trials to the autopilot-off twin.
+    assert _fingerprint(observe_study) == _fingerprint(off_study)
+
+
+def test_disabled_twin_allocates_nothing_over_boundary_calls():
+    """The zero-per-trial-allocation disabled contract, extended to the
+    autopilot: containment still works with the loop disabled, no loop is
+    ever attached, and 10k maybe_step boundary calls stay allocation-free."""
+    autopilot.disable()
+    plan = autopilot_chaos_plan()
+    study, _, snap = _run_twin(plan, None)
+    assert "_autopilot" not in study.__dict__
+    assert not any(k.startswith("autopilot.action") for k in snap["counters"])
+
+    for _ in range(200):
+        autopilot.maybe_step(study)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        autopilot.maybe_step(study)
+    gc.collect()
+    assert sys.getallocatedblocks() - before < 500
+
+
+# ---------------------------------------------------- per-action scenarios
+#
+# The centerpiece covers the sampler/executor actions end to end through a
+# live optimize loop; the remaining matrix rows are exercised against their
+# real actuators driven directly (their trigger signals ride channels — jit
+# totals, serve counters — a live hub would mint).
+
+
+def _direct_pilot(study, mode="act", **overrides):
+    policy = AutopilotPolicy(
+        mode=mode, interval_s=0.0, cooldown_s=3600.0, rollback_after=2,
+        **overrides,
+    )
+    return autopilot.attach(study, config=policy)
+
+
+def _complete_trials(study, n, value=1.0):
+    from optuna_tpu.trial._frozen import create_trial
+
+    for _ in range(n):
+        study.add_trial(
+            create_trial(
+                state=TrialState.COMPLETE,
+                params={"x": 0.5},
+                distributions=dict(SPACE),
+                values=[value],
+            )
+        )
+
+
+def test_pin_shapes_freezes_the_executor_width_and_undo_restores():
+    """executor.pin_shapes: retrace churn past the threshold freezes the
+    executor's requested width at the compiled width; continuing churn
+    (pinning could not stop an input-driven shape walk) rolls it back."""
+    from optuna_tpu.parallel.executor import ResilientBatchExecutor
+    from optuna_tpu.parallel.vectorized import VectorizedObjective
+
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    pilot = _direct_pilot(study)
+    executor = ResilientBatchExecutor(
+        study, VectorizedObjective(lambda p: p["x"] ** 2, SPACE), batch_size=16
+    )
+    executor._batch_size = 4  # an OOM clamp happened; regrowth would probe
+    for _ in range(health.RETRACE_CHURN_MIN):
+        flight._note_jit_compile("vectorized.guarded", 0.01, retrace=True)
+    decided = pilot.step(executor=executor)
+    assert [r.action for r in decided] == ["executor.pin_shapes"]
+    assert decided[0].state == "executed"
+    assert executor._requested_batch_size == 4  # frozen at the compiled width
+
+    # The churn continues (no improvement): after rollback_after finished
+    # trials the pin rolls back and the requested width is restored.
+    _complete_trials(study, 2)
+    for _ in range(2):
+        flight._note_jit_compile("vectorized.guarded", 0.01, retrace=True)
+    pilot.step(executor=executor)
+    assert decided[0].state == "rolled_back"
+    assert executor._requested_batch_size == 16
+
+
+def test_tighten_regrowth_stretches_the_probation_streak():
+    """executor.tighten_regrowth (direct form): the quarantine-rate trigger
+    stretches the live executor's regrowth streak; a cleared finding holds
+    the action and retires the undo."""
+    from optuna_tpu.parallel.executor import ResilientBatchExecutor
+    from optuna_tpu.parallel.vectorized import VectorizedObjective
+
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _complete_trials(study, 10)
+    pilot = _direct_pilot(study, regrowth_streak=8)
+    executor = ResilientBatchExecutor(
+        study, VectorizedObjective(lambda p: p["x"] ** 2, SPACE), batch_size=8
+    )
+    telemetry.count("executor.quarantine", health.QUARANTINE_MIN)
+    decided = pilot.step(executor=executor)
+    assert [r.action for r in decided] == ["executor.tighten_regrowth"]
+    assert executor._grow_streak_required == 8
+
+    # Enough clean finished trials dilute the rate below the threshold:
+    # the finding clears, the action is held, the tightened schedule stays.
+    _complete_trials(study, 30)
+    pilot.step(executor=executor)
+    assert decided[0].state == "held"
+    assert executor._grow_streak_required == 8
+
+
+def test_shed_earlier_halves_thresholds_and_undo_restores_exactly():
+    """service.shed_earlier: a backpressure burst against a live hub halves
+    the ShedPolicy thresholds and doubles ready-queue prewarm; a burst that
+    keeps growing (shedding earlier did not absorb it) rolls both back to
+    the exact previous values."""
+    from optuna_tpu.storages._grpc.suggest_service import SuggestService
+    from optuna_tpu.storages._in_memory import InMemoryStorage
+
+    storage = InMemoryStorage()
+    study = optuna_tpu.create_study(storage=storage, sampler=RandomSampler(seed=0))
+    service = SuggestService(
+        storage, lambda: RandomSampler(seed=0),
+        ready_ahead=4, health_reporting=False,
+    )
+    try:
+        pilot = _direct_pilot(study)
+        before = (
+            service.shed_policy.degrade_depth,
+            service.shed_policy.independent_depth,
+            service.shed_policy.reject_depth,
+            service.ready_ahead,
+        )
+        telemetry.count("serve.shed.reject", health.BACKPRESSURE_SHED_MIN)
+        # No service passed to the step: the hub registered itself as the
+        # module-level action target at construction (note_service).
+        decided = pilot.step()
+        assert [r.action for r in decided] == ["service.shed_earlier"]
+        assert service.shed_policy.reject_depth == max(1, before[2] // 2)
+        assert service.shed_policy.independent_depth == max(1, before[1] // 2)
+        assert service.shed_policy.degrade_depth == max(1, before[0] // 2)
+        assert service.ready_ahead == before[3] * 2
+
+        # The burst keeps growing: shedding earlier did not absorb it, so
+        # the action rolls back and every knob returns to its exact value.
+        _complete_trials(study, 2)
+        telemetry.count("serve.shed.reject", 5)
+        pilot.step()
+        assert decided[0].state == "rolled_back"
+        assert (
+            service.shed_policy.degrade_depth,
+            service.shed_policy.independent_depth,
+            service.shed_policy.reject_depth,
+            service.ready_ahead,
+        ) == before
+    finally:
+        service.close()
+
+
+def test_no_target_is_recorded_not_guessed_and_is_budget_free():
+    """An action whose actuator is not reachable from the current loop
+    (a bare-sampler study: no GuardedSampler to pin) records no_target —
+    it must never guess at a knob it cannot see, and it consumes NO
+    budget (a knob the loop could not have turned must not starve the
+    ones it can); the cooldown still arms so the log stays quiet."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _complete_trials(study, 10)
+    pilot = _direct_pilot(study)
+    telemetry.count("sampler.fallback.relative", 10)
+    decided = pilot.step()
+    assert [r.action for r in decided] == ["sampler.pin_independent"]
+    assert decided[0].state == "no_target"
+    assert pilot.report()["budget_left"] == pilot.policy.budget
+    assert pilot.step() == []  # cooldown: the persisting finding stays quiet
+
+
+def test_held_action_does_not_ratchet_after_cooldown_expiry():
+    """The anti-ratchet guard: a held action's check is retired for the
+    loop's lifetime — with a cumulative trigger (backpressure never
+    decays) and a zero cooldown, shed_earlier must halve the thresholds
+    exactly ONCE, not once per boundary until the hub rejects at depth 1."""
+    from optuna_tpu.storages._grpc.suggest_service import SuggestService
+    from optuna_tpu.storages._in_memory import InMemoryStorage
+
+    storage = InMemoryStorage()
+    study = optuna_tpu.create_study(storage=storage, sampler=RandomSampler(seed=0))
+    service = SuggestService(
+        storage, lambda: RandomSampler(seed=0),
+        ready_ahead=8, health_reporting=False,
+    )
+    try:
+        pilot = autopilot.attach(
+            study,
+            config=AutopilotPolicy(
+                mode="act", interval_s=0.0, cooldown_s=0.0, rollback_after=1
+            ),
+        )
+        before_reject = service.shed_policy.reject_depth
+        before_ready = service.ready_ahead
+        telemetry.count("serve.shed.reject", health.BACKPRESSURE_SHED_MIN)
+        assert [r.action for r in pilot.step()] == ["service.shed_earlier"]
+        _complete_trials(study, 1)
+        # Sheds stopped growing -> the action is held; with the cooldown
+        # already expired, only the standing-action guard prevents a
+        # second (compounding) halving.
+        assert pilot.step() == []
+        assert pilot.step() == []
+        records = pilot.report()["actions"]
+        assert [r["state"] for r in records] == ["held"]
+        assert service.shed_policy.reject_depth == max(1, before_reject // 2)
+        assert service.ready_ahead == before_ready * 2
+    finally:
+        service.close()
+
+
+# ------------------------------------------------------- audit surfaces
+
+
+def test_autopilot_cli_reads_the_storage_mirror_and_the_endpoint(tmp_path, capsys):
+    """`optuna-tpu autopilot` renders the action log from the act-mode
+    audit mirror in storage (any operator shell) and live from a serving
+    process's /autopilot.json (budget + cooldown clocks included)."""
+    import json
+    import urllib.request
+
+    from optuna_tpu.cli import main as cli_main
+
+    url = f"sqlite:///{tmp_path}/ap.db"
+    study = optuna_tpu.create_study(
+        study_name="ap", storage=url,
+        sampler=GuardedSampler(RandomSampler(seed=0)),
+    )
+    _complete_trials(study, 10)
+    pilot = _direct_pilot(study)
+    telemetry.count("sampler.fallback.relative", 10)
+    decided = pilot.step()
+    assert [r.action for r in decided] == ["sampler.pin_independent"]
+    assert decided[0].state == "executed"
+
+    # Storage mirror: reconstructed per-study from autopilot:action:* attrs.
+    assert cli_main(
+        ["--storage", url, "autopilot", "--study-name", "ap", "-f", "json"]
+    ) == 0
+    payload = json.loads(capsys.readouterr().out)
+    (entry,) = payload["autopilots"]
+    assert entry["study"] == "ap" and entry["mode"] == "act"
+    assert [r["action"] for r in entry["actions"]] == ["sampler.pin_independent"]
+    assert entry["actions"][0]["evidence"]["fallbacks"] == 10
+
+    assert cli_main(
+        ["--storage", url, "autopilot", "--study-name", "ap"]
+    ) == 0
+    text = capsys.readouterr().out
+    assert "sampler.fallback_storm -> sampler.pin_independent" in text
+    assert "executed" in text
+
+    # Live endpoint: the owning process additionally knows budget, undo
+    # state, and cooldown clocks.
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        served = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/autopilot.json", timeout=10
+            ).read().decode()
+        )
+        assert served["enabled"] is True
+        mine = next(p for p in served["autopilots"] if p["study"] == "ap")
+        assert mine["budget_left"] == pilot.policy.budget - 1
+        assert mine["actions"][0]["undo_pending"] is True
+        assert mine["cooldowns"]["sampler.fallback_storm"] > 0
+        assert cli_main(
+            ["autopilot", "--endpoint", f"http://localhost:{port}",
+             "--study-name", "ap"]
+        ) == 0
+        text = capsys.readouterr().out
+        assert "undo pending" in text and "cooldown" in text
+    finally:
+        server.shutdown()
+
+    # Without --endpoint the mirror is per-study: --study-name is required.
+    assert cli_main(["--storage", url, "autopilot"]) == 2
+
+
+def test_render_text_reports_not_armed():
+    assert "not armed" in autopilot.render_text(
+        {"enabled": False, "autopilots": []}
+    )
+
+
+def test_doctor_gains_a_would_act_column_when_autopilot_is_configured(
+    tmp_path, capsys
+):
+    """`optuna-tpu doctor` shows which guarded action the autopilot would
+    take per finding — but only when an autopilot policy is configured in
+    the process (the doctor alone must not advertise remediations nothing
+    would execute)."""
+    from optuna_tpu.cli import main as cli_main
+
+    url = f"sqlite:///{tmp_path}/wa.db"
+    study = optuna_tpu.create_study(
+        study_name="wa", storage=url, sampler=RandomSampler(seed=0)
+    )
+    plan = PATHOLOGICAL_HISTORY_PLANS[1]  # constant values: a plateau
+    for seed in (0, 1, 2):
+        plan.populate(study, SPACE, seed=seed)
+
+    autopilot.enable("observe")
+    assert cli_main(["--storage", url, "doctor", "--study-name", "wa"]) == 0
+    text = capsys.readouterr().out
+    assert "study.stagnation" in text
+    assert "would act: sampler.restart" in text
+
+    autopilot.disable()
+    assert cli_main(["--storage", url, "doctor", "--study-name", "wa"]) == 0
+    assert "would act" not in capsys.readouterr().out
+
+
+def test_chaos_matrix_names_every_action():
+    """Belt and braces beside ACT001's static check: the runtime matrix
+    covers the runtime vocabulary exactly, every trigger is a doctor
+    check, and this module exercises every row."""
+    assert set(AUTOPILOT_CHAOS_MATRIX) == set(autopilot.ACTIONS)
+    assert set(autopilot.ACTION_TRIGGERS) == set(autopilot.ACTIONS)
+    for checks in autopilot.ACTION_TRIGGERS.values():
+        for check in checks:
+            assert check in health.HEALTH_CHECKS
+            assert autopilot.action_for(check) is not None

@@ -332,6 +332,60 @@ def test_stagnation_fires_on_plateau_and_not_on_improvement():
     assert health.diagnose(_fleet(), plateau, directions) == []
 
 
+def test_stagnation_suppressed_during_containment_heavy_window():
+    """A trailing stretch dominated by quarantined FAILs (an active NaN
+    burst) must not count toward the no-new-best window: the sampler never
+    got a fair run of tells, containment owns that story
+    (executor.quarantine_rate), and flagging stagnation here would make the
+    autopilot restart a sampler mid-containment."""
+    window = health.STAGNATION_WINDOW
+    plateau = [_trial(i, 1.0 if i else 0.5) for i in range(window + 5)]
+    # NaN burst: a FAIL-majority trailing window (>= the containment floor).
+    burst = [
+        _trial(len(plateau) + i, None, state=TrialState.FAIL)
+        for i in range(window)
+    ]
+    assert health.diagnose(_fleet(), plateau + burst, MIN) == []
+
+    # A light sprinkle of FAILs below the containment floor is ordinary
+    # attrition, not active containment: the plateau still flags.
+    sprinkle = [
+        _trial(len(plateau) + i, None, state=TrialState.FAIL)
+        for i in range(health.STAGNATION_CONTAINMENT_MIN - 1)
+    ]
+    findings = health.diagnose(_fleet(), plateau + sprinkle, MIN)
+    assert [f.check for f in findings] == ["study.stagnation"]
+
+
+def test_stagnation_nan_burst_regression_through_a_live_study():
+    """The NaN-burst regression end to end: a vectorized study whose
+    recent batches are quarantined wholesale reports quarantine_rate, NOT
+    stagnation — the finding mix the autopilot keys its actions off."""
+    from optuna_tpu.parallel import optimize_vectorized
+    from optuna_tpu.testing.fault_injection import (
+        PATHOLOGICAL_HISTORY_PLANS,
+        FaultyVectorizedObjective,
+    )
+
+    health.enable(interval_s=0.0)  # the quarantine counters ride the fleet channel
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    # 20 completed constant-value tells: a plateau past the window.
+    plan = PATHOLOGICAL_HISTORY_PLANS[1]
+    assert plan.name == "constant_values"
+    for seed in (0, 1):  # 8 trials each; 16 completes before the run
+        plan.populate(study, SPACE, seed=seed)
+    # Then an active NaN burst: every slot of both batches quarantined.
+    obj = FaultyVectorizedObjective(
+        lambda p: (p["x"] - 0.3) ** 2 + 1.0,
+        SPACE,
+        nan_at={0: tuple(range(8)), 1: tuple(range(8))},
+    )
+    optimize_vectorized(study, obj, n_trials=16, batch_size=8)
+    checks = {f["check"] for f in study.health_report()["findings"]}
+    assert "executor.quarantine_rate" in checks
+    assert "study.stagnation" not in checks
+
+
 def test_stagnation_respects_maximize_direction():
     window = health.STAGNATION_WINDOW
     # Values strictly increasing: stagnant for MINIMIZE, healthy for MAXIMIZE.
@@ -513,15 +567,44 @@ def test_doctor_cli_local_storage(tmp_path, capsys):
     assert report["study"] == "local" and report["healthy"] is True
 
 
-def test_health_endpoint_404_without_a_source():
+def test_health_endpoint_serves_not_armed_without_a_source():
+    """Without a health_source, /health.json answers the structured
+    {"enabled": false} payload the /slo.json contract established — a
+    scraper must be able to tell "doctor not wired on this process" from a
+    typo'd path (which stays a real 404)."""
     server = telemetry.serve_metrics(0)
     try:
         port = server.server_address[1]
-        with pytest.raises(urllib.error.HTTPError) as err:
+        payload = json.loads(
             urllib.request.urlopen(
                 f"http://localhost:{port}/health.json", timeout=10
+            ).read().decode()
+        )
+        assert payload["enabled"] is False
+        assert payload["reports"] == []
+        assert "health_source" in payload["reason"]
+        # A typo'd path is still a loud 404 — the ambiguity the structured
+        # payload removes is exactly this distinction.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://localhost:{port}/health.jsno", timeout=10
             )
         assert err.value.code == 404
+    finally:
+        server.shutdown()
+
+
+def test_doctor_cli_explains_a_not_armed_endpoint():
+    """The doctor CLI against a source-less endpoint reports "not armed"
+    as a usage error instead of the old indistinguishable empty-report
+    path."""
+    server = telemetry.serve_metrics(0)
+    try:
+        port = server.server_address[1]
+        assert cli_main(
+            ["doctor", "--study-name", "any",
+             "--endpoint", f"http://localhost:{port}"]
+        ) == 2
     finally:
         server.shutdown()
 

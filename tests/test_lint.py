@@ -215,6 +215,74 @@ def test_srv001_fixture_in_sync_is_silent():
     assert not result.findings, [f.format() for f in result.findings]
 
 
+def test_act001_registry_matches_runtime_sets():
+    """The canonical autopilot-action registry equals the *runtime* values
+    of both hand-written copies (the lint compares them statically) — and
+    the loop's trigger table covers exactly the vocabulary with trigger
+    checks drawn from the doctor's vocabulary."""
+    from optuna_tpu import autopilot, health
+    from optuna_tpu.testing.fault_injection import AUTOPILOT_CHAOS_MATRIX
+
+    canonical = set(lint_registry.AUTOPILOT_ACTION_REGISTRY)
+    assert set(autopilot.ACTIONS) == canonical
+    assert set(AUTOPILOT_CHAOS_MATRIX) == canonical
+    assert set(autopilot.ACTION_TRIGGERS) == canonical
+    for checks in autopilot.ACTION_TRIGGERS.values():
+        assert set(checks) <= set(health.HEALTH_CHECKS)
+
+
+def test_act001_gate_rejects_drift():
+    """Point ACT001 at the real files with a registry containing an action
+    the code does not know: both copies must be reported as drifted —
+    adding a remediation without a chaos scenario proving it fires,
+    executes, and rolls back is a lint failure (the STO001/.../SRV001
+    discipline): an unproven action fires for the first time in
+    production, unattended."""
+    fat_registry = dict(lint_registry.AUTOPILOT_ACTION_REGISTRY)
+    fat_registry["study.phantom_action"] = "made-up action to prove the gate is live"
+    config = Config(act001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.act001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "ACT001"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("study.phantom_action" in f.message for f in drifted)
+
+
+_ACT001_FIXTURE_REGISTRY = {
+    "sampler.nudge": "perturb the sampler",
+    "executor.brake": "clamp the executor",
+}
+
+
+def _act001_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        act001_registry=_ACT001_FIXTURE_REGISTRY,
+        act001_targets=(
+            (f"fixtures/lint/{tree}/actions_mod.py", "ACTIONS", "action vocabulary"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "AUTOPILOT_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_act001_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "act001_pos")
+    result = run_lint([tree], _act001_config("act001_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "sampler.phantom_action" in by_file["actions_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_act001_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "act001_neg")
+    result = run_lint([tree], _act001_config("act001_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 def test_obs002_registry_matches_runtime_sets():
     """The canonical flight event-kind registry equals the *runtime* values
     of both hand-written copies (the lint compares them statically)."""

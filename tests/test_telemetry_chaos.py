@@ -302,6 +302,37 @@ def test_sampler_fallback_counter_families_are_phase_bucketed():
     )
 
 
+def test_autopilot_action_counter_increments_once_per_decision():
+    """The autopilot.action family's scenario: a fallback storm mints
+    exactly one suffixed decision counter; the per-check cooldown keeps a
+    persisting finding from re-counting at the next boundary."""
+    from optuna_tpu import autopilot
+    from optuna_tpu.autopilot import AutopilotPolicy
+    from optuna_tpu.trial._frozen import create_trial
+    from optuna_tpu.trial._state import TrialState
+
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    for _ in range(10):
+        study.add_trial(
+            create_trial(
+                state=TrialState.COMPLETE,
+                params={"x": 0.5},
+                distributions=dict(SPACE),
+                values=[1.0],
+            )
+        )
+    pilot = autopilot.attach(
+        study,
+        config=AutopilotPolicy(mode="observe", interval_s=0.0, cooldown_s=3600.0),
+    )
+    telemetry.count("sampler.fallback.relative", 10)  # a storm's worth
+    decided = pilot.step()
+    assert [record.action for record in decided] == ["sampler.pin_independent"]
+    pilot.step()  # same finding, inside the cooldown: no second decision
+    counters = telemetry.snapshot()["counters"]
+    assert counters["autopilot.action.sampler.pin_independent"] == 1
+
+
 def test_disabled_chaos_records_nothing():
     """Faults with telemetry disabled: containment still works, registry
     stays empty — recording is opt-in, never load-bearing."""
