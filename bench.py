@@ -564,6 +564,199 @@ def run_ours_tpe_serve(
     return n_asks / sat_wall, detail
 
 
+def run_ours_tpe_serve_fleet(
+    n_hubs: int, n_clients: int, asks_per_client: int, warm_trials: int = 40
+) -> tuple[float, dict]:
+    """``--loop=serve --hubs=N``: the hub fleet (ISSUE 16) — N suggestion
+    services over ONE shared journal storage behind real gRPC handlers
+    (handler-direct like the single-hub bench, no sockets: the measurement
+    is the fleet layer + services, not loopback TCP), consistent-hash
+    partitioned with one studied workload owned per hub. The n_clients thin
+    clients round-robin across the N studies through the redialing fleet
+    client, so every ask walks the ring exactly as a production client
+    would — routing, op tokens and replication records included.
+
+    Returns (fleet-wide asks/s over the saturation window, detail dict)."""
+    import threading as _th
+
+    import optuna_tpu
+    from optuna_tpu.samplers import TPESampler
+    from optuna_tpu.storages import InMemoryStorage
+    from optuna_tpu.storages._grpc.suggest_service import ShedPolicy, SuggestService
+    from optuna_tpu.testing.fault_injection import FakeHubFleet
+
+    _silence()
+    storage = InMemoryStorage()
+    # Per-hub knobs: each hub sees ~n_clients/n_hubs of the closed loop, so
+    # each is sized exactly like the single-hub bench at that share — the
+    # fleet number is then comparable to the single-hub committed number
+    # scaled by fan-out, not a retuned configuration.
+    share = max(1, n_clients // n_hubs)
+
+    def factory(name):
+        return SuggestService(
+            storage,
+            lambda: TPESampler(seed=0, **_SERVE_TPE_KWARGS),
+            ready_ahead=4 * share,
+            invalidate_after=2 * share,
+            max_coalesce=share,
+            coalesce_window_s=0.002,
+            shed_policy=ShedPolicy(
+                degrade_depth=share,
+                independent_depth=2 * share,
+                reject_depth=4 * share,
+                slo_source=lambda: (),
+            ),
+            health_reporting=False,
+        )
+
+    names = [f"bench-hub-{i}" for i in range(n_hubs)]
+    # A production liveness TTL: the default 0.0 recomputes the snapshot
+    # scan per ask, which measures the test harness, not the fleet.
+    fleet = FakeHubFleet(storage, names, factory, liveness_ttl_s=0.25)
+    mounted = fleet.mounted[names[0]]
+
+    # One timed study owned per hub: probe names until the ring has given
+    # every hub exactly one (surplus probes stay empty and unused).
+    owned: dict[str, str] = {}
+    probe = 0
+    while len(owned) < n_hubs:
+        study_name = f"serve-fleet-{probe}"
+        probe += 1
+        optuna_tpu.create_study(
+            storage=mounted, study_name=study_name, direction="minimize"
+        )
+        sid = storage.get_study_id_from_name(study_name)
+        owned.setdefault(fleet.router.hub_for(sid), study_name)
+    study_names = [owned[h] for h in names]
+
+    def make_study(seed, study_name):
+        return optuna_tpu.load_study(
+            study_name=study_name,
+            storage=mounted,
+            sampler=fleet.thin_client(seed=seed),
+        )
+
+    # Warm-up, the single-hub bench policy: ONE throwaway study visits every
+    # TPE history bucket any timed study will touch, prewarming the
+    # coalesce-width ladder at each power-of-two crossing — XLA's compile
+    # cache is process-wide (keyed on shapes), so one pass warms ALL hubs
+    # and the measurement excludes compile time exactly like the single-hub
+    # number it is compared against.
+    per_study_timed = (n_clients * asks_per_client) // n_hubs
+    per_study_steady = (n_clients * max(4, asks_per_client // 2)) // n_hubs
+    warm_total = warm_trials + per_study_timed + per_study_steady
+    optuna_tpu.create_study(
+        storage=mounted, study_name="serve-fleet-warm", direction="minimize"
+    )
+    wsid = storage.get_study_id_from_name("serve-fleet-warm")
+    warm_owner = fleet.hubs[fleet.router.hub_for(wsid)]
+    throwaway = make_study(1, "serve-fleet-warm")
+    next_prewarm = 64
+    for i in range(warm_total):
+        t = throwaway.ask()
+        throwaway.tell(t, _serve_objective(t))
+        if i + 1 >= next_prewarm:
+            warm_owner.service.prewarm(wsid)
+            next_prewarm *= 2
+    warm_owner.service.prewarm(wsid)
+    # Each timed study starts fresh past the startup phase, fully warm.
+    for study_name in study_names:
+        study = make_study(2, study_name)
+        for _ in range(warm_trials):
+            t = study.ask()
+            study.tell(t, _serve_objective(t))
+        sid = storage.get_study_id_from_name(study_name)
+        assert fleet.hubs[fleet.router.hub_for(sid)].service.prewarm(sid) > 0
+
+    errors: list[BaseException] = []
+    best: list[float] = []
+
+    def run_phase(phase_asks_per_client: int, think_s: float, seed_base: int):
+        latencies: list[float] = []
+        lat_lock = _th.Lock()
+
+        def client(i):
+            try:
+                study = make_study(seed_base + i, study_names[i % n_hubs])
+                local: list[float] = []
+                if think_s:
+                    time.sleep(think_s * ((i % n_clients) / n_clients))
+                for _ in range(phase_asks_per_client):
+                    t0 = time.perf_counter()
+                    trial = study.ask()
+                    value = _serve_objective(trial)
+                    local.append(time.perf_counter() - t0)
+                    if think_s:
+                        time.sleep(think_s)
+                    study.tell(trial, value)
+                    best.append(value)
+                with lat_lock:
+                    latencies.extend(local)
+            except BaseException as err:  # pragma: no cover - surfaced below
+                errors.append(err)
+
+        threads = [
+            _th.Thread(target=client, args=(i,)) for i in range(n_clients)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        if errors:
+            raise errors[0]
+        latencies.sort()
+        return wall, latencies
+
+    def _pct(sorted_vals, p: float) -> float:
+        return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+    _reset_phase_telemetry()
+    # Phase A — fleet saturation: zero think time. The headline asks/s is
+    # the FLEET's serving capacity (the committed comparable vs the
+    # single-hub number scaled by fan-out).
+    sat_wall, sat_lat = run_phase(asks_per_client, 0.0, 100)
+    for study_name in study_names:
+        sid = storage.get_study_id_from_name(study_name)
+        fleet.hubs[fleet.router.hub_for(sid)].service.refill_now(sid)
+    # Phase B — paced steady state, per-ask p99 contract (as single-hub).
+    steady_think_s = 0.25 if asks_per_client <= 8 else 0.5
+    steady_asks = max(4, asks_per_client // 2)
+    _, steady_lat = run_phase(steady_asks, steady_think_s, 1000)
+    from optuna_tpu import telemetry as _telemetry
+
+    snapshot = _telemetry.snapshot()
+    gauges, counters = snapshot["gauges"], snapshot["counters"]
+    fleet.close()
+    n_asks = n_clients * asks_per_client
+    detail = {
+        "hubs": n_hubs,
+        "n_clients": n_clients,
+        "asks_per_client": asks_per_client,
+        "serve_ask_p50_ms": round(1e3 * _pct(steady_lat, 0.50), 3),
+        "serve_ask_p99_ms": round(1e3 * _pct(steady_lat, 0.99), 3),
+        "steady_think_s": steady_think_s,
+        "steady_asks": steady_asks * n_clients,
+        "saturated_ask_p50_ms": round(1e3 * _pct(sat_lat, 0.50), 3),
+        "saturated_ask_p99_ms": round(1e3 * _pct(sat_lat, 0.99), 3),
+        "coalesce_width_max": int(gauges.get("serve.coalesce.width.max", 0)),
+        "ready_queue_hits": int(counters.get("serve.ready_queue.hit", 0)),
+        "ready_queue_misses": int(counters.get("serve.ready_queue.miss", 0)),
+        "sheds": int(
+            sum(v for k, v in counters.items() if k.startswith("serve.shed."))
+        ),
+        # Fleet health over the window: a fault-free bench must show zero
+        # forwards/replays/re-homes (clients route straight to owners).
+        "fleet_forwards": int(counters.get("serve.fleet.ask_forward", 0)),
+        "fleet_replays": int(counters.get("serve.fleet.ask_replayed", 0)),
+        "fleet_rehomes": int(counters.get("serve.fleet.hub_rehome", 0)),
+        "best": round(min(best), 6),
+    }
+    return n_asks / sat_wall, detail
+
+
 def run_ours_tpe_single_client(warm_trials: int, n_asks: int) -> tuple[float, float]:
     """The unbatched twin for ``--loop=serve``: ONE client running the same
     TPE config locally (the pre-service architecture — every ask pays its
@@ -1332,7 +1525,21 @@ def main() -> None:
         "server, tpe config only) — scan/sharded/serve each carry their "
         "own trajectory metric, so each path gets a distinct gate baseline",
     )
+    parser.add_argument(
+        "--hubs",
+        type=int,
+        default=1,
+        help="serve-loop only: run a hub FLEET of this many suggestion "
+        "services over one shared journal storage (ISSUE 16), clients "
+        "routed by the consistent-hash ring; carries its own metric "
+        "(serve_asks_per_sec_tpe_fleet<N>hubs) so the single-hub gate "
+        "baseline is untouched",
+    )
     args = parser.parse_args()
+    if args.hubs != 1 and args.loop != "serve":
+        parser.error("--hubs is only defined for --loop=serve")
+    if args.hubs < 1:
+        parser.error("--hubs must be >= 1")
     watchdog.phase(f"run:{args.config}:{args.loop}")
     watchdog.update(quick=bool(args.quick))
     provenance = "live"  # how vs_baseline's denominator was obtained
@@ -1356,9 +1563,16 @@ def main() -> None:
         asks_per_client = 8 if args.quick else 24
         _log(
             f"running ours (suggestion service / TPE, {n_clients} clients x "
-            f"{asks_per_client} asks, closed loop)..."
+            f"{asks_per_client} asks, closed loop"
+            + (f", fleet of {args.hubs} hubs" if args.hubs > 1 else "")
+            + ")..."
         )
-        ours_rate, serve_detail = run_ours_tpe_serve(n_clients, asks_per_client)
+        if args.hubs > 1:
+            ours_rate, serve_detail = run_ours_tpe_serve_fleet(
+                args.hubs, n_clients, asks_per_client
+            )
+        else:
+            ours_rate, serve_detail = run_ours_tpe_serve(n_clients, asks_per_client)
         n_timed = n_clients * asks_per_client
         ours_best = serve_detail.pop("best")
         # Capture the serve window's breakdown NOW: the single-client twin
@@ -1383,7 +1597,11 @@ def main() -> None:
         serve_detail["single_client_ask_ms"] = round(1e3 * single_ask_s, 3)
         base = (base_rate, ours_best)
         provenance = "live-ours-single-client-local-sampler"
-        metric = f"serve_asks_per_sec_tpe_{n_clients}clients"
+        metric = (
+            f"serve_asks_per_sec_tpe_fleet{args.hubs}hubs"
+            if args.hubs > 1
+            else f"serve_asks_per_sec_tpe_{n_clients}clients"
+        )
     elif args.loop == "sharded":
         if args.config not in ("gp", "mlp"):
             parser.error(

@@ -21,6 +21,7 @@ STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
 SRV001   suggestion-service shed policy sets drifted from the canonical one
 ACT001   autopilot action vocabularies drifted from the canonical one
+FLT001   hub-fleet event vocabularies drifted from the canonical one
 EXE001   non-finite quarantine policy sets drifted from the canonical one
 SMP001   sampler fallback policy sets drifted from the canonical one
 SMP002   bare Cholesky in sampler code (route through ladder_cholesky)
@@ -67,6 +68,7 @@ def all_rules() -> list[Rule]:
     from optuna_tpu._lint.rules_storage import (
         ACT001ActionRegistrySync,
         EXE001NonFinitePolicySync,
+        FLT001FleetEventSync,
         SRV001ShedPolicySync,
         STO001ReplayRegistrySync,
         STO002LockOrder,
@@ -86,6 +88,7 @@ def all_rules() -> list[Rule]:
         STO002LockOrder(),
         SRV001ShedPolicySync(),
         ACT001ActionRegistrySync(),
+        FLT001FleetEventSync(),
         EXE001NonFinitePolicySync(),
         SMP001FallbackPolicySync(),
         SMP002LadderCholeskyOnly(),

@@ -109,6 +109,10 @@ class Config:
     act001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.AUTOPILOT_ACTION_REGISTRY
     )
+    flt001_targets: tuple[tuple[str, str, str], ...] = registry.FLT001_TARGETS
+    flt001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.FLEET_EVENT_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

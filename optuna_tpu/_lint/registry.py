@@ -158,6 +158,7 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "serve.shed": "(suffixed by policy) an overloaded ask was degraded or refused by the shed ladder",
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
+    "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
 }
 
 #: The flight recorder's event-kind vocabulary: canonical mirror of
@@ -257,6 +258,7 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
     "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
+    "service.hub_dead": "a suggestion hub's -serve snapshot went stale: the fleet re-homes its studies to ring successors",
 }
 
 #: The hand-maintained copies OBS004 cross-checks, as
@@ -368,6 +370,39 @@ ACT001_TARGETS: tuple[tuple[str, str, str], ...] = (
         "optuna_tpu/testing/fault_injection.py",
         "AUTOPILOT_CHAOS_MATRIX",
         "chaos matrix: every guarded action must have a fault scenario that forces it",
+    ),
+)
+
+#: The hub fleet's routing-event vocabulary: every fault-tolerance decision
+#: the fleet layer (``storages/_grpc/fleet.py``) can take — and every
+#: ``serve.fleet.*`` counter and cross-hub flow arrow derived from one —
+#: carries one of these ids. Canonical mirror of ``fleet.FLEET_EVENTS``
+#: (rule **FLT001**, the STO001 machinery pointed at failover itself).
+#: Values say what each event means for an in-flight ask; every id must
+#: have a chaos scenario in ``testing/fault_injection.py::
+#: HUB_CHAOS_MATRIX`` (same rule) — a failover path nobody has killed a hub
+#: through is a path that loses its first real ask in production.
+FLEET_EVENT_REGISTRY: dict[str, str] = {
+    "hub_dead": "a hub's -serve health snapshot went stale past grace: the router stops routing to it",
+    "hub_rehome": "a dead hub's study was adopted by its ring successor, which rebuilds serve state from the shared journal",
+    "ask_forward": "an ask was forwarded to a peer hub (mis-route to the owner, or overload to the least-burning peer)",
+    "ask_replayed": "a redialed ask was answered from the shared replay record instead of re-executing (exactly-once across failover)",
+    "shed_forward": "an overloaded hub forwarded an ask to the least-burning peer one rung before shedding to the client",
+}
+
+#: The hand-maintained copies FLT001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+FLT001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/storages/_grpc/fleet.py",
+        "FLEET_EVENTS",
+        "the fleet layer's accepted routing events (each counted as serve.fleet.<event>)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "HUB_CHAOS_MATRIX",
+        "chaos matrix: every fleet event must have a hub-fault scenario that forces it",
     ),
 )
 

@@ -211,6 +211,26 @@ class ACT001ActionRegistrySync(_RegistrySyncRule):
         return config.act001_targets
 
 
+class FLT001FleetEventSync(_RegistrySyncRule):
+    """The STO001/.../ACT001 anti-drift machinery pointed at the hub fleet's
+    routing-event vocabulary: ``storages/_grpc/fleet.py::FLEET_EVENTS`` and
+    the chaos matrix ``fault_injection.py::HUB_CHAOS_MATRIX`` must both
+    equal the canonical ``registry.FLEET_EVENT_REGISTRY`` — a failover
+    event added without a hub-kill scenario that forces it is a lint
+    failure: an unexercised failover path loses its first real in-flight
+    ask in production, during exactly the hub death it was built for."""
+
+    id = "FLT001"
+    title = "hub-fleet event vocabularies out of sync"
+    noun = "fleet events"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.flt001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.flt001_targets
+
+
 # --------------------------------------------------------------------- STO002
 
 

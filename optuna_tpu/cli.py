@@ -314,6 +314,64 @@ def _cmd_trace(args: argparse.Namespace) -> None:
         print(payload)
 
 
+def _fetch_hub_report(endpoint: str, study_name: str) -> dict:
+    """One hub's ``/health.json`` report for one study, or raise."""
+    import urllib.request
+
+    base = endpoint.rstrip("/")
+    url = base if base.endswith("/health.json") else base + "/health.json"
+    with urllib.request.urlopen(url, timeout=10) as response:
+        payload = json.loads(response.read().decode())
+    if payload.get("enabled") is False:
+        # The structured not-armed payload (vs a 404 for a typo'd
+        # path): the process is reachable but has no storage to
+        # aggregate fleet reports over.
+        raise CLIUsageError(
+            f"the endpoint {endpoint!r} doctor is not armed: "
+            + payload.get("reason", "no health_source on that process")
+        )
+    reports = payload.get("reports", [])
+    report = next((r for r in reports if r.get("study") == study_name), None)
+    if report is None:
+        known = sorted(r.get("study") for r in reports)
+        raise CLIUsageError(
+            f"endpoint {endpoint!r} serves no study named {study_name!r} "
+            f"(it has: {known})."
+        )
+    return report
+
+
+def _merge_hub_reports(
+    by_hub: dict[str, dict], unreachable: list[str]
+) -> dict:
+    """Fold per-hub doctor reports into one fleet-wide report.
+
+    Hubs share the journal storage, so each report is the same computation
+    taken at a slightly different instant — the freshest one is the base.
+    Findings are unioned by check id, each tagged with the hubs that raised
+    it, so a verdict only one hub can see (e.g. the survivor that declared
+    ``service.hub_dead``) is never lost to a staler base report.
+    """
+    base = max(by_hub.values(), key=lambda r: r.get("generated_unix", 0.0))
+    merged = dict(base)
+    findings: dict[str, dict] = {}
+    seen_at: dict[str, list[str]] = {}
+    for hub, report in sorted(by_hub.items()):
+        for finding in report.get("findings", ()):
+            check = finding.get("check", "?")
+            findings.setdefault(check, dict(finding))
+            seen_at.setdefault(check, []).append(hub)
+    for check, finding in findings.items():
+        finding["hubs"] = seen_at[check]
+    merged["findings"] = [findings[c] for c in sorted(findings)]
+    merged["healthy"] = not merged["findings"]
+    merged["hub_endpoints"] = {
+        "reachable": sorted(by_hub),
+        "unreachable": sorted(unreachable),
+    }
+    return merged
+
+
 def _cmd_doctor(args: argparse.Namespace) -> None:
     """The study doctor's report (see :mod:`optuna_tpu.health`).
 
@@ -321,36 +379,42 @@ def _cmd_doctor(args: argparse.Namespace) -> None:
     report computed in this process (the fleet view lives in the study's
     system attrs, so any worker or operator shell can run the doctor);
     with ``--endpoint`` the report is fetched from a serving process's
-    ``/health.json`` (the gRPC proxy's ``metrics_port``) and the matching
-    study's report rendered — byte-for-byte the same shape either way.
+    ``/health.json`` (the gRPC proxy's ``metrics_port``). A single endpoint
+    is that one hub's view; against a hub fleet pass every hub
+    comma-separated (``--endpoint hub-a:8081,hub-b:8081``) and the reports
+    are merged — findings unioned by check and tagged with the hubs that
+    raised them, unreachable hubs listed rather than fatal (the survivors'
+    ``service.hub_dead`` verdict is exactly what you came for).
     """
     from optuna_tpu import health
 
     if args.endpoint:
-        import urllib.request
-
-        base = args.endpoint.rstrip("/")
-        url = base if base.endswith("/health.json") else base + "/health.json"
-        with urllib.request.urlopen(url, timeout=10) as response:
-            payload = json.loads(response.read().decode())
-        if payload.get("enabled") is False:
-            # The structured not-armed payload (vs a 404 for a typo'd
-            # path): the process is reachable but has no storage to
-            # aggregate fleet reports over.
-            raise CLIUsageError(
-                "the endpoint's doctor is not armed: "
-                + payload.get("reason", "no health_source on that process")
-            )
-        reports = payload.get("reports", [])
-        report = next(
-            (r for r in reports if r.get("study") == args.study_name), None
-        )
-        if report is None:
-            known = sorted(r.get("study") for r in reports)
-            raise CLIUsageError(
-                f"endpoint serves no study named {args.study_name!r} "
-                f"(it has: {known})."
-            )
+        endpoints = [e.strip() for e in args.endpoint.split(",") if e.strip()]
+        if len(endpoints) == 1:
+            report = _fetch_hub_report(endpoints[0], args.study_name)
+        else:
+            by_hub: dict[str, dict] = {}
+            unreachable: list[str] = []
+            usage_errors: list[CLIUsageError] = []
+            for endpoint in endpoints:
+                try:
+                    by_hub[endpoint] = _fetch_hub_report(
+                        endpoint, args.study_name
+                    )
+                except CLIUsageError as err:
+                    # Reachable but not serving this study / not armed:
+                    # a configuration problem, not a dead hub.
+                    usage_errors.append(err)
+                except OSError:
+                    unreachable.append(endpoint)
+            if usage_errors:
+                raise usage_errors[0]
+            if not by_hub:
+                raise CLIUsageError(
+                    "no hub endpoint was reachable "
+                    f"(tried: {sorted(unreachable)})."
+                )
+            report = _merge_hub_reports(by_hub, unreachable)
     else:
         storage = _storage(args)
         study_id = storage.get_study_id_from_name(args.study_name)
@@ -542,11 +606,14 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
             # Serve-loop entries (bench --loop=serve) lead with the latency
             # contract: steady-state per-ask p99 vs the single-client twin's
             # mean ask latency (the bar it must meet), then ready-queue
-            # hit/miss, widest observed coalesce, and any sheds.
+            # hit/miss, widest observed coalesce, and any sheds. Fleet runs
+            # (bench --loop=serve --hubs=N) carry the hub count beside them.
             parts.append(
                 f"p99={serve.get('serve_ask_p99_ms')}ms"
                 f"/1cl={serve.get('single_client_ask_ms')}ms"
             )
+            if serve.get("hubs") is not None:
+                parts.append(f"hubs={serve['hubs']}")
             parts.append(
                 f"q={serve.get('ready_queue_hits', 0)}"
                 f"/{serve.get('ready_queue_misses', 0)}"
@@ -700,7 +767,9 @@ def _build_parser() -> argparse.ArgumentParser:
         "--endpoint",
         default=None,
         help="fetch /health.json from a serving process (e.g. http://host:9090) "
-        "instead of aggregating from --storage in this process",
+        "instead of aggregating from --storage in this process; one endpoint "
+        "is that hub's view, comma-separated endpoints merge a hub fleet's "
+        "reports (unreachable hubs are listed, not fatal)",
     )
 
     p = add("autopilot", _cmd_autopilot)

@@ -71,6 +71,7 @@ _logger = get_logger(__name__)
 __all__ = [
     "CHECK_SEVERITIES",
     "HEALTH_CHECKS",
+    "HUB_WORKER_ID_SUFFIX",
     "SEVERITIES",
     "WORKER_ATTR_PREFIX",
     "HealthFinding",
@@ -112,6 +113,7 @@ HEALTH_CHECKS: dict[str, str] = {
     "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
     "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
+    "service.hub_dead": "a suggestion hub's -serve snapshot went stale: the fleet re-homes its studies to ring successors",
 }
 
 #: Finding severities, mildest first. CRITICAL findings are additionally
@@ -139,12 +141,19 @@ CHECK_SEVERITIES: dict[str, str] = {
     "service.backpressure": "WARNING",
     "service.ready_queue_starved": "WARNING",
     "service.slo_burn": "CRITICAL",
+    "service.hub_dead": "CRITICAL",
 }
 
 #: Study system-attr namespace the reporter publishes under; one attr per
 #: worker (``health:worker:<worker id>``), overwritten in place so the
 #: storage holds exactly the latest snapshot per worker, not a history.
 WORKER_ATTR_PREFIX = "health:worker:"
+
+#: Worker-id suffix a suggestion hub publishes under (the service attaches
+#: as ``<hub name>-serve``): the fleet layer and the ``service.hub_dead``
+#: check derive hub liveness from exactly these snapshots — a stale
+#: ``-serve`` snapshot is a dead *hub*, not just a dead worker.
+HUB_WORKER_ID_SUFFIX = "-serve"
 
 #: Default publish cadence. Deliberately coarser than a heartbeat: a health
 #: snapshot is a diagnosis input, not a liveness primitive — the heartbeat
@@ -994,6 +1003,48 @@ def _check_worker_dead(
     )
 
 
+def _check_hub_dead(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    """A dead ``-serve`` worker is a dead suggestion *hub*: beyond the
+    generic ``worker.dead`` story (reapable trials), its parked asks and
+    ready queues are orphaned until the fleet router re-homes its studies —
+    so the finding names the hub, the unit an operator restarts."""
+    dead = [
+        w
+        for w in fleet["workers"]
+        if w["worker"].endswith(HUB_WORKER_ID_SUFFIX)
+        and not w["alive"]
+        and not w.get("exited")
+    ]
+    if not dead:
+        return None
+    hubs = [w["worker"][: -len(HUB_WORKER_ID_SUFFIX)] for w in dead]
+    return HealthFinding(
+        check="service.hub_dead",
+        severity=CHECK_SEVERITIES["service.hub_dead"],
+        summary=(
+            f"{len(hubs)} suggestion hub(s) stale past the liveness grace: "
+            f"{', '.join(hubs)} — the fleet re-homes their studies to ring "
+            f"successors"
+        ),
+        evidence={
+            "dead_hubs": hubs,
+            "ages_s": {
+                w["worker"][: -len(HUB_WORKER_ID_SUFFIX)]: w["age_s"] for w in dead
+            },
+            "n_workers": fleet["n_workers"],
+        },
+        remediation=(
+            "fleet clients redial the ring successor (op tokens dedupe "
+            "re-sent asks through the shared replay records) and successors "
+            "rebuild serve state from the shared journal; restart the hub "
+            "process to restore capacity — on restart it resumes ownership "
+            "automatically"
+        ),
+    )
+
+
 def _check_shard_imbalance(
     fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
 ) -> HealthFinding | None:
@@ -1191,6 +1242,7 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "service.backpressure": _check_backpressure,
     "service.ready_queue_starved": _check_ready_queue_starved,
     "service.slo_burn": _check_slo_burn,
+    "service.hub_dead": _check_hub_dead,
 }
 
 _SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}

@@ -73,6 +73,7 @@ __all__ = [
     "SLOEngine",
     "SLOSpec",
     "SLOStatus",
+    "burn_score",
     "burning_slo_ids",
     "cumulative_counts",
     "disable",
@@ -567,6 +568,24 @@ def burning_slo_ids() -> tuple[str, ...]:
     if not _enabled or _ENGINE is None:
         return ()
     return tuple(status.spec.id for status in _ENGINE.status() if status.burning)
+
+
+def burn_score() -> float:
+    """One scalar "how burnt is this process": ``0.0`` while disabled or
+    healthy, the worst burning spec's long-window burn rate while burning,
+    ``inf`` once any spec is critical. The hub fleet exchanges this over
+    the peer channel (``service_burn_verdict``) to rank shed-forward
+    targets — comparisons only, so the scale just has to be monotone in
+    badness."""
+    if not _enabled or _ENGINE is None:
+        return 0.0
+    score = 0.0
+    for status in _ENGINE.status():
+        if status.critical:
+            return float("inf")
+        if status.burning:
+            score = max(score, status.burn_long)
+    return score
 
 
 def cumulative_counts() -> dict[str, tuple[int, int]]:

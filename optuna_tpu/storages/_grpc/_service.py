@@ -97,7 +97,11 @@ METHODS = (
 # ``service_ask`` always carries an OP_TOKEN_KEY kwarg: a transport-level
 # replay of an ask must return the recorded proposal, not pop a second
 # ready-queue entry or mint a second proposal for the same trial.
-SUGGEST_METHODS = ("service_ask",)
+# ``service_forwarded_ask``/``service_burn_verdict`` are the hub fleet's
+# hub-to-hub channel (ISSUE 16): a hub answers a mis-routed ask for its
+# owner, and hubs exchange SLO burn verdicts to pick a shed-forward target.
+# Same open namespace, so still no WIRE_VERSION bump.
+SUGGEST_METHODS = ("service_ask", "service_forwarded_ask", "service_burn_verdict")
 
 # Exceptions allowed to re-materialize client-side, by name. Anything else
 # becomes a plain RuntimeError carrying the message — never an arbitrary

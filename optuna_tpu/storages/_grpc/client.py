@@ -61,6 +61,25 @@ def _default_retry_policy() -> RetryPolicy:
     return RetryPolicy(max_attempts=5, initial_backoff=0.1, max_backoff=2.0, deadline=60.0)
 
 
+def is_transport_unavailable(err: BaseException) -> bool:
+    """True for the transport-level UNAVAILABLE shape: the peer process is
+    gone (dead, restarting, partitioned away), not merely slow. One
+    classifier shared by this proxy's retry loop and the fleet client's
+    redial-next-replica walk (``fleet.FleetClient``) — the two must agree
+    on what "the hub is unreachable" looks like, or a failover redial and a
+    same-hub retry would race each other."""
+    try:
+        import grpc
+    except ImportError:  # no grpc in this process: nothing transport-shaped
+        return False
+    if not isinstance(err, grpc.RpcError):
+        return False
+    try:
+        return err.code() == grpc.StatusCode.UNAVAILABLE
+    except Exception:  # graphlint: ignore[PY001] -- a half-constructed RpcError without a status code is not classifiable; treat as not-unavailable rather than crash the classifier
+        return False
+
+
 class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
     """BaseStorage over a gRPC channel, resilient to transient transport
     failures: calls that die with UNAVAILABLE / DEADLINE_EXCEEDED are replayed
@@ -119,10 +138,13 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
     def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
         import grpc
 
-        if method in _OP_TOKEN_METHODS:
+        if method in _OP_TOKEN_METHODS and OP_TOKEN_KEY not in kwargs:
             # One token per *logical* call, minted before the retry loop, so
             # every replay carries the same token and the server's dedupe
-            # cache collapses them into one execution.
+            # cache collapses them into one execution. A caller-supplied
+            # token is kept: the fleet client redials a DIFFERENT hub's
+            # proxy with the same token, and the successor's replay-record
+            # lookup depends on it surviving the hop.
             kwargs = {**kwargs, OP_TOKEN_KEY: uuid.uuid4().hex}
         flight_ctx = None
         if flight.enabled() and not self._flight_ctx_unsupported:
@@ -151,9 +173,9 @@ class GrpcStorageProxy(BaseStorage, BaseHeartbeat):
             return rpc(request, timeout=attempt_timeout)
 
         def transient(err: BaseException) -> bool:
-            return isinstance(err, grpc.RpcError) and err.code() in (
-                grpc.StatusCode.UNAVAILABLE,
-                grpc.StatusCode.DEADLINE_EXCEEDED,
+            return is_transport_unavailable(err) or (
+                isinstance(err, grpc.RpcError)
+                and err.code() == grpc.StatusCode.DEADLINE_EXCEEDED
             )
 
         # One logical RPC = one storage.op span (transport retries, re-dials

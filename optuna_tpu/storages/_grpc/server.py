@@ -96,6 +96,12 @@ def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None"
                 # cache (a failed original is not cached — re-loop claims
                 # ownership and re-executes, matching the error semantics).
                 pending.wait(timeout=120.0)
+        if is_suggest and op_token is not None:
+            # The fleet layer replicates suggest answers under the token so
+            # a redialed ask dedupes on a SUCCESSOR hub — this in-process
+            # cache cannot survive a hub death, so the token must reach the
+            # service instead of being stripped here.
+            kwargs["op_token"] = op_token
         if method_name in _HEARTBEAT_DEFAULTS and not hasattr(storage, method_name):
             # Backing storage without heartbeat support: behave as disabled.
             return encode_response(True, _HEARTBEAT_DEFAULTS[method_name])
@@ -165,6 +171,8 @@ def run_grpc_proxy_server(
     drain_grace: float | None = 15.0,
     metrics_port: int | None = None,
     suggest_service: "SuggestService | None" = None,
+    fleet_hubs: "list[str] | None" = None,
+    fleet_name: str | None = None,
 ) -> None:
     """Blocking server entry point (reference ``server.py:38``).
 
@@ -186,6 +194,13 @@ def run_grpc_proxy_server(
     the storage hub is where op-token dedup hits, server-side storage
     latencies live, every worker's trace ids cross, and every worker's
     health snapshot lands, so this one endpoint watches a fleet.
+
+    ``fleet_hubs`` (the full endpoint-named hub list, this hub included)
+    turns this server into a member of a hub fleet: the suggestion service
+    is wrapped in a :class:`~optuna_tpu.storages._grpc.fleet.FleetHub`
+    named ``fleet_name`` (default ``host:port``), which forwards mis-routed
+    asks to their owners, replicates answered asks to the shared storage,
+    and sheds overload to the least-burning peer before rejecting.
     """
     import signal
 
@@ -193,6 +208,15 @@ def run_grpc_proxy_server(
 
     from optuna_tpu import slo
 
+    if fleet_hubs and suggest_service is not None:
+        from optuna_tpu.storages._grpc import fleet as fleet_mod
+
+        suggest_service = fleet_mod.attach_hub(
+            suggest_service,
+            storage,
+            list(fleet_hubs),
+            fleet_name or f"{host}:{port}",
+        )
     server = make_grpc_server(storage, host, port, thread_pool_size, suggest_service)
     metrics_server = None
     if metrics_port is not None:

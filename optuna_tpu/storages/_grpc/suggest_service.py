@@ -86,6 +86,7 @@ from optuna_tpu.samplers._resilience import (
 )
 from optuna_tpu.storages._base import BaseStorage, _ForwardingStorage
 from optuna_tpu.storages._grpc._service import OP_TOKEN_KEY
+from optuna_tpu.storages._retry import RetryPolicy
 from optuna_tpu.trial._state import TrialState
 
 if TYPE_CHECKING:
@@ -568,6 +569,7 @@ class SuggestService:
         shed_policy: ShedPolicy | None = None,
         clock: Callable[[], float] = time.monotonic,
         health_reporting: bool = True,
+        health_worker_id: str | None = None,
     ) -> None:
         self._storage = storage
         self._sampler_factory = sampler_factory
@@ -585,6 +587,11 @@ class SuggestService:
         self.shed_policy = shed_policy if shed_policy is not None else ShedPolicy(clock=clock)
         self._clock = clock
         self._health_reporting = health_reporting
+        #: The worker id this hub's health snapshots publish under. A fleet
+        #: member passes its hub name + the ``-serve`` suffix so hub
+        #: liveness (and the ``service.hub_dead`` check) can tell N hubs in
+        #: a fleet apart; the default keeps the single-hub id.
+        self._health_worker_id = health_worker_id
         self.coalesce_window_s = coalesce_window_s
         self.max_coalesce = max(1, int(max_coalesce))
         self._handles: dict[int, _StudyHandle] = {}
@@ -649,7 +656,10 @@ class SuggestService:
             # The service's containment + serve counters join the fleet
             # channel under a service-suffixed worker id, so the doctor's
             # backpressure/starvation checks can see them from anywhere.
-            health.attach(study, worker_id=health.default_worker_id() + "-serve")
+            worker_id = self._health_worker_id
+            if worker_id is None:
+                worker_id = health.default_worker_id() + health.HUB_WORKER_ID_SUFFIX
+            health.attach(study, worker_id=worker_id)
         if existing is handle:
             from optuna_tpu import autopilot
 
@@ -675,11 +685,41 @@ class SuggestService:
 
     # ----------------------------------------------------------------- ask
 
-    def service_ask(self, study_id: int, trial_id: int, trial_number: int) -> dict:
+    def service_ask(
+        self,
+        study_id: int,
+        trial_id: int,
+        trial_number: int,
+        op_token: str | None = None,
+        fleet_redial: bool = False,
+    ) -> dict:
         """One thin-client ask: ready-queue pop, shed rung, or coalesced
-        fused dispatch — in that order. Returns the wire response dict."""
+        fused dispatch — in that order. Returns the wire response dict.
+
+        ``op_token``/``fleet_redial`` are the fleet-replication hooks (the
+        server re-injects the op token for suggest methods; a fleet client
+        marks redialed attempts): a bare single hub ignores both — its
+        in-process token cache already dedupes same-process retries, and
+        there is no successor to replicate for.
+        """
         with telemetry.span("serve.ask"), flight.span("serve.ask"):
             return self._ask_impl(study_id, trial_id, trial_number)
+
+    def service_burn_verdict(self) -> dict:
+        """This hub's SLO burn verdict + load level, for the fleet's
+        shed-forward peer ranking (:mod:`optuna_tpu.storages._grpc.fleet`).
+        Cheap by construction — a handful of in-memory reads — because
+        peers call it on every shed decision."""
+        from optuna_tpu import slo
+
+        score = slo.burn_score()
+        return {
+            "depth": self._inflight,
+            "score": 0.0 if score == float("inf") else score,
+            "critical": score == float("inf"),
+            "burning": score > 0.0,
+            "draining": self._draining,
+        }
 
     def _ask_impl(self, study_id: int, trial_id: int, trial_number: int) -> dict:
         handle = self._handle(study_id)
@@ -1170,8 +1210,10 @@ class ThinClientSampler(BaseSampler):
     the speculative ready queue). The independent path (startup dims,
     server-shed asks) stays local on ``independent_sampler``.
 
-    Shed handling: a ``reject`` response (``RESOURCE_EXHAUSTED``) sleeps the
-    carried ``retry_after_s`` (injectable ``sleep``) and re-asks, up to
+    Shed handling: a ``reject`` response (``RESOURCE_EXHAUSTED``) sleeps a
+    full-jitter draw over the carried ``retry_after_s`` (``shed_retry``'s
+    :meth:`~optuna_tpu.storages._retry.RetryPolicy.jitter`, injectable
+    ``sleep``) and re-asks, up to
     ``max_shed_retries``; a still-overloaded server then degrades this one
     trial to the local independent path — the study never aborts on
     backpressure. Against a pre-service server the first ask's 'unknown
@@ -1194,6 +1236,7 @@ class ThinClientSampler(BaseSampler):
         seed: int | None = None,
         max_shed_retries: int = 4,
         sleep: Callable[[float], None] = time.sleep,
+        shed_retry: RetryPolicy | None = None,
     ) -> None:
         if (ask is None) == (proxy is None):
             raise ValueError("pass exactly one of `ask` (a callable) or `proxy`.")
@@ -1211,6 +1254,13 @@ class ThinClientSampler(BaseSampler):
         self._independent_sampler = independent_sampler
         self.max_shed_retries = int(max_shed_retries)
         self._sleep = sleep
+        # Full jitter on shed retry-after sleeps, through RetryPolicy's own
+        # draw (per-instance OS-entropy rng by default): a burst of clients
+        # shed on the same tick wakes decorrelated instead of as a
+        # synchronized herd against the recovering hub. Deliberately NOT
+        # derived from ``seed`` — reproducible sampling must not mean
+        # reproducible (synchronized) retry timing.
+        self._shed_retry = shed_retry if shed_retry is not None else RetryPolicy()
         self._service_unsupported = False
         self._warn_token = next(_service_seq)
         self._pending: dict[int, dict] = {}
@@ -1261,7 +1311,9 @@ class ThinClientSampler(BaseSampler):
                 if attempts >= self.max_shed_retries:
                     return None
                 attempts += 1
-                self._sleep(float(resp.get("retry_after_s") or 0.05))
+                self._sleep(
+                    self._shed_retry.jitter(float(resp.get("retry_after_s") or 0.05))
+                )
                 continue
             return resp
 

@@ -121,8 +121,17 @@ class RetryPolicy:
             return self.max_backoff
         return min(self.max_backoff, grown)
 
+    def jitter(self, cap: float) -> float:
+        """A full-jitter delay for an externally-supplied cap — uniform in
+        ``[0, cap]``, the same decorrelation :meth:`next_delay` applies to
+        this policy's own backoff ladder. The thin client's shed
+        retry-after sleeps draw through here so a burst of clients shed on
+        the same tick does not wake as a synchronized herd against the
+        recovering hub."""
+        return self._rng.uniform(0.0, max(0.0, float(cap)))
+
     def next_delay(self, attempt: int) -> float:
-        return self._rng.uniform(0.0, self.backoff_cap(attempt))
+        return self.jitter(self.backoff_cap(attempt))
 
     def backoff(
         self, attempt: int, announce: Callable[[float], None] | None = None

@@ -117,6 +117,7 @@ COUNTERS: dict[str, str] = {
     "serve.shed": "(suffixed by policy) an overloaded ask was degraded or refused by the shed ladder",
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
+    "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
 }
 
 _PHASE_METRIC_PREFIX = "phase."
@@ -414,6 +415,7 @@ _LABELED_COUNTER_FAMILIES: dict[str, str] = {
     "sampler.fallback": "family",
     "serve.shed": "policy",
     "serve.ready_queue": "event",
+    "serve.fleet": "event",
 }
 _LABELED_GAUGE_FAMILIES: dict[str, str] = {
     "jit.compiles": "label",

@@ -44,6 +44,14 @@ the faults they claim to absorb. This module provides:
   (:data:`HEALTH_CHECK_CHAOS_MATRIX` is the matrix, synced by graphlint
   rule OBS004); :func:`plant_dead_worker` leaves behind exactly the stale
   health snapshot a SIGKILL'd worker would.
+* Hub-fleet chaos (:mod:`optuna_tpu.storages._grpc.fleet` is the layer
+  under test): :class:`FakeHubFleet` runs N real fleet hubs behind real
+  gRPC handlers over ONE shared storage without sockets, with kill /
+  heal / drop-response taps, and :class:`HubChaosPlan` /
+  :func:`hub_chaos_plan` names the kill timing and the exactly-once
+  outcome the failover acceptance test asserts
+  (:data:`HUB_CHAOS_MATRIX` is the matrix, synced by graphlint rule
+  FLT001).
 
 Typical chaos test::
 
@@ -340,6 +348,9 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "service.slo_burn": "overload burst under a floor-level serve.ask target (SLOChaosPlan): "
     "every ask violates, both burn windows cross critical, the finding carries the exact "
     "violation counts through the fleet channel, and the compliant twin stays clean",
+    "service.hub_dead": "SIGKILL one FakeHubFleet hub mid-burst (HubChaosPlan): its -serve "
+    "snapshot goes stale past grace, the doctor names the dead hub, and the healthy-fleet "
+    "twin stays clean",
 }
 
 
@@ -662,6 +673,307 @@ def service_chaos_plan() -> ServiceChaosPlan:
     slow-tell clients, three server-side sampler faults, a five-ask reject
     burst plus forced stale/independent rungs."""
     return ServiceChaosPlan()
+
+
+# ------------------------------------------------------------ hub-fleet chaos
+
+
+# Chaos matrix for the hub fleet's routing events: every fault-tolerance
+# decision the fleet layer can take (``storages/_grpc/fleet.py::
+# FLEET_EVENTS``) maps to the hub-fault scenario ``tests/test_fleet_chaos.py``
+# must prove forces it. Deliberately a hand-written literal (not an import of
+# ``fleet.FLEET_EVENTS``): graphlint rule FLT001 cross-checks both against
+# ``_lint/registry.py::FLEET_EVENT_REGISTRY`` — adding a failover event
+# without a hub-kill scenario that forces it is a lint failure (the
+# STO001/.../ACT001 pattern), because an unexercised failover path loses its
+# first real in-flight ask during exactly the hub death it was built for.
+HUB_CHAOS_MATRIX: dict[str, str] = {
+    "hub_dead": "SIGKILL one of four hubs mid-burst (FakeHubFleet.kill leaves the stale "
+    "-serve snapshot a real SIGKILL would); peers declare it dead exactly once and the "
+    "doctor reports service.hub_dead naming the hub",
+    "hub_rehome": "after the kill, asks for the dead hub's studies land on the ring "
+    "successor, which adopts the published epoch watermark and rebuilds serve state from "
+    "the shared journal",
+    "ask_forward": "mis-route an ask at a non-owner hub; it is forwarded to the owner and "
+    "answered (never rejected), with the cross-hub flow arrow recorded at both ends",
+    "ask_replayed": "drop the response of a committed ask (committed-but-unacked), the "
+    "client redials the next replica with the same op token; the successor replays the "
+    "shared record — the trial's params are written exactly once",
+    "shed_forward": "overload one hub into its reject rung while a peer idles; the ask is "
+    "forwarded to the least-burning peer and answered before any client sees "
+    "RESOURCE_EXHAUSTED; a fleet-wide burst still walks the client shed ladder",
+}
+
+
+@dataclass(frozen=True)
+class HubChaosPlan:
+    """One deterministic hub-fleet chaos scenario: ``n_hubs`` in-process
+    fleet members (:class:`FakeHubFleet`) over ONE shared storage, a
+    client burst, and a SIGKILL of one hub mid-burst — plus the exact
+    outcome the acceptance test asserts (``tests/test_fleet_chaos.py``):
+    zero lost asks (every client ask is answered), every in-flight ask of
+    the dead hub is answered exactly once by a successor (op-token +
+    shared replay record dedupe across the failover — the
+    committed-but-unacked drops in ``drop_responses`` are the hard case),
+    every healthy trial completes exactly once with zero RUNNING after the
+    drain, the doctor reports ``service.hub_dead`` naming exactly the
+    killed hub, and the fault-free fleet-of-1 twin is bit-identical to the
+    single-hub service on the same seed.
+    """
+
+    n_hubs: int = 4
+    n_clients: int = 4
+    n_trials: int = 24
+    n_startup_trials: int = 4
+    seed: int = 7
+    #: Trial count (per study) already served when the kill strikes — the
+    #: burst is mid-flight, not cold or drained.
+    kill_after_trials: int = 6
+    #: Committed-but-unacked asks: the hub answers (and replicates) the ask,
+    #: then the transport "dies" before the response reaches the client.
+    #: The client's redial with the same token must hit the replay record.
+    drop_responses: int = 2
+
+    @property
+    def killed_hub_index(self) -> int:
+        """The hub to kill: index 0 of the fleet's hub list (the name is
+        the fleet's choice; killing by index keeps the plan fleet-agnostic)."""
+        return 0
+
+
+def hub_chaos_plan() -> HubChaosPlan:
+    """The default :class:`HubChaosPlan` the chaos suite runs — kill one of
+    four hubs after six trials, with two committed-but-unacked drops."""
+    return HubChaosPlan()
+
+
+class FakeHubFleet:
+    """N in-process fleet hubs over ONE shared storage, without sockets:
+    each hub is a real ``SuggestService`` wrapped in a real
+    :class:`~optuna_tpu.storages._grpc.fleet.FleetHub`, mounted behind the
+    real gRPC handler (``server._make_handler`` — op-token dedup, wire
+    encode/decode, suggest dispatch all live), with hub-to-hub peer calls
+    routed back through the same handlers so a kill severs forwarding too.
+
+    Chaos controls:
+
+    * :meth:`kill` — SIGKILL stand-in: every subsequent RPC to the hub
+      raises :class:`~optuna_tpu.storages._grpc.fleet.HubUnavailableError`,
+      and the hub's ``<name>-serve`` health snapshots are rewritten
+      ``age_s`` into the past (exactly the stale residue a real SIGKILL
+      leaves — the process stops refreshing; nothing cleans up).
+    * :meth:`heal` — the partition heals: RPCs flow again and a fresh
+      snapshot is republished (the hub was alive behind the partition).
+    * :meth:`drop_response` — committed-but-unacked: the hub executes the
+      next ``count`` calls of ``method`` normally (writes commit, the
+      replay record lands) but the response is dropped on the floor and
+      the caller sees ``HubUnavailableError`` — the redial-with-same-token
+      dedupe path's hard case.
+
+    ``client_asks()`` hands a :class:`fleet.FleetClient` the per-hub ask
+    closures (op token + ``fleet_redial`` riding the wire exactly as the
+    thin client sends them); :meth:`thin_client` builds the full
+    ``ThinClientSampler`` on top.
+    """
+
+    def __init__(
+        self,
+        storage: BaseStorage,
+        hub_names: Sequence[str],
+        service_factory: Callable[[str], Any],
+        *,
+        replicas: int = 64,
+        liveness_ttl_s: float = 0.0,
+    ) -> None:
+        import types
+
+        from optuna_tpu.storages._grpc import _service as wire
+        from optuna_tpu.storages._grpc import fleet as fleet_mod
+        from optuna_tpu.storages._grpc.server import _make_handler
+
+        self._wire = wire
+        self._fleet_mod = fleet_mod
+        self.storage = storage
+        self.router = fleet_mod.FleetRouter(hub_names, replicas=replicas)
+        self.hubs: dict[str, Any] = {}
+        self.mounted: dict[str, BaseStorage] = {}
+        self._rpc: dict[str, Callable[..., Any]] = {}
+        self._killed: set[str] = set()
+        self._drops: dict[tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+        for name in hub_names:
+            service = service_factory(name)
+            hub = fleet_mod.FleetHub(
+                name,
+                service,
+                self.router,
+                storage,
+                liveness_ttl_s=liveness_ttl_s,
+            )
+            mounted = hub.wrap_storage(storage)
+            handler = _make_handler(mounted, hub)
+            method_handler = handler.service(
+                types.SimpleNamespace(method=f"/{wire.SERVICE_NAME}/x")
+            )
+
+            def rpc(method, *args, _mh=method_handler, _name=name, **kwargs):
+                self._check_alive(_name)
+                response = _mh.unary_unary(
+                    wire.encode_request(method, args, kwargs), None
+                )
+                self._maybe_drop(_name, method)
+                ok, payload = wire.decode_response(response)
+                if not ok:
+                    raise payload
+                return payload
+
+            self.hubs[name] = hub
+            self.mounted[name] = mounted
+            self._rpc[name] = rpc
+        for name, hub in self.hubs.items():
+            for peer_name in hub_names:
+                if peer_name != name:
+                    hub.set_peer(peer_name, _FleetPeerStub(self, peer_name))
+
+    # ------------------------------------------------------------- chaos taps
+
+    def _check_alive(self, name: str) -> None:
+        with self._lock:
+            killed = name in self._killed
+        if killed:
+            from optuna_tpu.storages._grpc.fleet import HubUnavailableError
+
+            raise HubUnavailableError(f"fleet hub {name!r} is dead (injected kill).")
+
+    def _maybe_drop(self, name: str, method: str) -> None:
+        with self._lock:
+            left = self._drops.get((name, method), 0)
+            if left <= 0:
+                return
+            self._drops[(name, method)] = left - 1
+        from optuna_tpu.storages._grpc.fleet import HubUnavailableError
+
+        raise HubUnavailableError(
+            f"response from hub {name!r} dropped (committed-but-unacked {method})."
+        )
+
+    def kill(self, name: str, *, age_s: float = 3600.0) -> None:
+        """SIGKILL stand-in: sever the hub's RPCs and leave its ``-serve``
+        snapshots ``age_s`` stale (a dead process stops refreshing; the
+        stale record IS the death signal the liveness check reads)."""
+        from optuna_tpu import health
+
+        with self._lock:
+            self._killed.add(name)
+        worker_id = name + health.HUB_WORKER_ID_SUFFIX
+        attr_key = health.WORKER_ATTR_PREFIX + worker_id
+        for frozen in self.storage.get_all_studies():
+            study_id = frozen._study_id
+            snap = dict(
+                health.worker_snapshots(self.storage, study_id).get(worker_id)
+                or {"worker": worker_id, "pid": 0, "seq": 1, "counters": {},
+                    "gauges": {}, "histograms": {}, "jit": {},
+                    "interval_s": health.DEFAULT_INTERVAL_S}
+            )
+            snap["last_seen_unix"] = time.time() - age_s
+            snap.pop("final", None)
+            self.storage.set_study_system_attr(study_id, attr_key, snap)
+        self.invalidate_liveness()
+
+    def heal(self, name: str) -> None:
+        """The partition heals: RPCs to the hub flow again and a fresh
+        snapshot is republished for every study (the hub was alive the
+        whole time — only unreachable)."""
+        from optuna_tpu import health
+
+        with self._lock:
+            self._killed.discard(name)
+        worker_id = name + health.HUB_WORKER_ID_SUFFIX
+        attr_key = health.WORKER_ATTR_PREFIX + worker_id
+        for frozen in self.storage.get_all_studies():
+            study_id = frozen._study_id
+            snap = health.worker_snapshots(self.storage, study_id).get(worker_id)
+            if snap is None:
+                continue
+            snap = dict(snap)
+            snap["last_seen_unix"] = time.time()
+            self.storage.set_study_system_attr(study_id, attr_key, snap)
+        self.invalidate_liveness()
+
+    def drop_response(self, name: str, method: str = "service_ask", count: int = 1) -> None:
+        """Schedule the next ``count`` successful ``method`` calls on hub
+        ``name`` to commit server-side but lose their response."""
+        with self._lock:
+            self._drops[(name, method)] = self._drops.get((name, method), 0) + count
+
+    def invalidate_liveness(self) -> None:
+        for hub in self.hubs.values():
+            hub.invalidate_liveness()
+
+    # --------------------------------------------------------------- clients
+
+    def rpc(self, name: str, method: str, *args: Any, **kwargs: Any) -> Any:
+        return self._rpc[name](method, *args, **kwargs)
+
+    def client_asks(self) -> dict[str, Callable[..., dict]]:
+        """Per-hub ask closures for :class:`fleet.FleetClient`: op token and
+        ``fleet_redial`` ride the wire exactly as a thin client sends them."""
+        wire = self._wire
+
+        def make(name):
+            def ask(study_id, trial_id, number, token, redial):
+                return self.rpc(
+                    name, "service_ask", study_id, trial_id, number,
+                    fleet_redial=redial, **{wire.OP_TOKEN_KEY: token},
+                )
+
+            return ask
+
+        return {name: make(name) for name in self.router.hubs}
+
+    def fleet_client(self, **kwargs: Any) -> Any:
+        """A :class:`fleet.FleetClient` over this fleet's handlers. Default
+        backoff sleeps are suppressed (tests must not wait out real jitter)."""
+        policy = kwargs.pop("retry_policy", None)
+        if policy is None:
+            from optuna_tpu.storages._retry import RetryPolicy
+
+            policy = RetryPolicy(
+                max_attempts=2 * len(self.router.hubs) + 1, sleep=lambda _s: None
+            )
+        return self._fleet_mod.FleetClient(
+            self.router, self.client_asks(), retry_policy=policy, **kwargs
+        )
+
+    def thin_client(self, **kwargs: Any) -> Any:
+        """A ``ThinClientSampler`` whose asks walk the fleet (routing,
+        redial, replay) instead of a single hub."""
+        from optuna_tpu.storages._grpc.suggest_service import ThinClientSampler
+
+        return ThinClientSampler(self.fleet_client().ask, **kwargs)
+
+    def close(self) -> None:
+        for hub in self.hubs.values():
+            try:
+                hub.close()
+            except Exception:  # graphlint: ignore[PY001] -- teardown best-effort: one hub's close must not strand the rest
+                pass
+
+
+class _FleetPeerStub:
+    """Peer protocol routed back through the fleet's own handlers: a
+    forwarded ask crosses the same wire/op-token path a socket peer would,
+    and a killed hub severs forwarding exactly like a dead socket."""
+
+    def __init__(self, fleet: FakeHubFleet, name: str) -> None:
+        self._fleet = fleet
+        self.name = name
+
+    def service_forwarded_ask(self, *args: Any, **kwargs: Any) -> dict:
+        return self._fleet.rpc(self.name, "service_forwarded_ask", *args, **kwargs)
+
+    def service_burn_verdict(self) -> dict:
+        return self._fleet.rpc(self.name, "service_burn_verdict")
 
 
 # ------------------------------------------------------------- pod-bus chaos

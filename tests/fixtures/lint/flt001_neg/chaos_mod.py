@@ -1,0 +1,5 @@
+# graphlint fixture: FLT001 negative — both copies agree with the registry.
+HUB_CHAOS_MATRIX = {
+    "hub_blip": "kill the hub mid-burst; the blip is declared and re-homed",
+    "ask_detour": "mis-route an ask; the detour answers it at the owner",
+}

@@ -1,0 +1,5 @@
+# graphlint fixture: FLT001 negative — both copies agree with the registry.
+FLEET_EVENTS = {
+    "hub_blip": "what the event means for an in-flight ask",
+    "ask_detour": "what the event means for an in-flight ask",
+}

@@ -1,0 +1,2 @@
+# graphlint fixture: FLT001 — this copy DRIFTED: 'ask_detour' is missing.
+HUB_CHAOS_MATRIX = {"hub_blip": "scenario"}  # EXPECT: FLT001

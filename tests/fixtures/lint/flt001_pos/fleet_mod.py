@@ -1,0 +1,6 @@
+# graphlint fixture: FLT001 — this copy DRIFTED: 'hub_phantom' is extra.
+FLEET_EVENTS = {  # EXPECT: FLT001
+    "hub_blip": "scenario",
+    "ask_detour": "scenario",
+    "hub_phantom": "scenario",
+}

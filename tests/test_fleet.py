@@ -1,0 +1,297 @@
+"""Hub-fleet unit contracts (ISSUE 16): the consistent-hash router, the
+shared-storage replicator, liveness derivation, the redialing fleet client,
+and the burn-verdict peer ranking — each in isolation, no service needed.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import health, telemetry
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.storages._grpc.fleet import (
+    FLEET_EVENTS,
+    REPLAY_SLOTS,
+    FleetClient,
+    FleetHub,
+    FleetReplicator,
+    FleetRouter,
+    HubUnavailableError,
+    dead_hubs,
+)
+from optuna_tpu.storages._retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability():
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+# ------------------------------------------------------------------- router
+
+
+def test_router_is_deterministic_across_instances():
+    hubs = ["hub-a", "hub-b", "hub-c", "hub-d"]
+    r1, r2 = FleetRouter(hubs), FleetRouter(hubs)
+    for sid in range(200):
+        assert r1.hub_for(sid) == r2.hub_for(sid)
+        assert r1.successors(sid) == r2.successors(sid)
+
+
+def test_router_successors_cover_every_hub_owner_first():
+    router = FleetRouter(["a", "b", "c"])
+    for sid in range(50):
+        order = router.successors(sid)
+        assert sorted(order) == ["a", "b", "c"]
+        assert order[0] == router.hub_for(sid)
+
+
+def test_router_partitions_are_roughly_balanced():
+    hubs = [f"hub-{i}" for i in range(4)]
+    router = FleetRouter(hubs)
+    counts = {h: 0 for h in hubs}
+    n = 2000
+    for sid in range(n):
+        counts[router.hub_for(sid)] += 1
+    for hub, count in counts.items():
+        assert 0.5 * n / len(hubs) < count < 2.0 * n / len(hubs), counts
+
+
+def test_router_route_walks_successors_by_liveness():
+    router = FleetRouter(["a", "b", "c"])
+    sid = 7
+    order = router.successors(sid)
+    assert router.route(sid) == order[0]
+    assert router.route(sid, alive={order[1], order[2]}) == order[1]
+    assert router.route(sid, alive={order[2]}) == order[2]
+    # Every hub dead: the primary owner answers (degrade to a redial, not
+    # to silence).
+    assert router.route(sid, alive=set()) == order[0]
+
+
+def test_router_adding_a_hub_moves_a_minority_of_studies():
+    before = FleetRouter(["a", "b", "c"])
+    after = FleetRouter(["a", "b", "c", "d"])
+    moved = sum(
+        1 for sid in range(1000) if before.hub_for(sid) != after.hub_for(sid)
+    )
+    # Consistent hashing: ~1/4 of keys move to the new hub; modulo hashing
+    # would reshuffle ~3/4.
+    assert moved < 500, moved
+
+
+def test_router_rejects_empty_and_duplicate_hub_lists():
+    with pytest.raises(ValueError):
+        FleetRouter([])
+    with pytest.raises(ValueError):
+        FleetRouter(["a", "a"])
+
+
+# --------------------------------------------------------------- replicator
+
+
+def _study(storage, name="s") -> int:
+    optuna_tpu.create_study(storage=storage, study_name=name, direction="minimize")
+    return storage.get_study_id_from_name(name)
+
+
+def test_replicator_replays_recorded_ask_by_token():
+    storage = InMemoryStorage()
+    sid = _study(storage)
+    rep = FleetReplicator(storage)
+    resp = {"params": {"x": 1.5}, "distributions": {}}
+    rep.record_ask(sid, "tok-1", resp)
+    assert rep.lookup_ask(sid, "tok-1") == resp
+    assert rep.lookup_ask(sid, "tok-never-recorded") is None
+
+
+def test_replicator_slot_ring_is_bounded():
+    storage = InMemoryStorage()
+    sid = _study(storage)
+    rep = FleetReplicator(storage)
+    for i in range(3 * REPLAY_SLOTS):
+        rep.record_ask(sid, f"tok-{i}", {"params": {"x": float(i)}})
+    attrs = storage.get_study_system_attrs(sid)
+    slots = [k for k in attrs if k.startswith("serve:fleet:tok:")]
+    assert len(slots) <= REPLAY_SLOTS
+    # An overwritten slot answers only its *current* token — a stale token
+    # misses (and re-executes, still op-token-deduped) rather than replaying
+    # someone else's proposal.
+    survivors = sum(
+        1 for i in range(3 * REPLAY_SLOTS) if rep.lookup_ask(sid, f"tok-{i}")
+    )
+    assert 0 < survivors <= REPLAY_SLOTS
+
+
+def test_replicator_watermark_takes_fleet_max():
+    storage = InMemoryStorage()
+    sid = _study(storage)
+    rep = FleetReplicator(storage)
+    assert rep.watermark_epoch(sid) == 0
+    rep.record_watermark(sid, "hub-a", epoch=3)
+    rep.record_watermark(sid, "hub-b", epoch=7, asks=12)
+    rep.record_watermark(sid, "hub-c", epoch=5)
+    assert rep.watermark_epoch(sid) == 7
+
+
+# ----------------------------------------------------------------- liveness
+
+
+def test_dead_hubs_derives_from_stale_serve_snapshots():
+    from optuna_tpu.testing.fault_injection import plant_dead_worker
+
+    storage = InMemoryStorage()
+    sid = _study(storage)
+    study = optuna_tpu.load_study(study_name="s", storage=storage)
+    hubs = ["hub-a", "hub-b", "hub-c"]
+    suffix = health.HUB_WORKER_ID_SUFFIX
+    # hub-a: stale -> dead. hub-b: fresh -> alive. hub-c: no snapshot ->
+    # unknown, not dead. A stale NON-hub worker must not leak in.
+    plant_dead_worker(study, worker_id="hub-a" + suffix, age_s=3600.0)
+    plant_dead_worker(study, worker_id="hub-b" + suffix, age_s=0.0)
+    plant_dead_worker(study, worker_id="plain-worker", age_s=3600.0)
+    assert dead_hubs(storage, sid, hubs) == frozenset({"hub-a"})
+
+
+def test_dead_hubs_ignores_clean_final_flush():
+    from optuna_tpu.testing.fault_injection import plant_dead_worker
+
+    storage = InMemoryStorage()
+    sid = _study(storage)
+    study = optuna_tpu.load_study(study_name="s", storage=storage)
+    suffix = health.HUB_WORKER_ID_SUFFIX
+    snap = plant_dead_worker(study, worker_id="hub-a" + suffix, age_s=3600.0)
+    snap["final"] = True
+    storage.set_study_system_attr(
+        sid, health.WORKER_ATTR_PREFIX + "hub-a" + suffix, snap
+    )
+    assert dead_hubs(storage, sid, ["hub-a"]) == frozenset()
+
+
+# ------------------------------------------------------------- fleet client
+
+
+def _no_sleep_policy(attempts=7):
+    return RetryPolicy(max_attempts=attempts, sleep=lambda _s: None)
+
+
+def test_fleet_client_redials_next_replica_with_same_token():
+    router = FleetRouter(["a", "b", "c"])
+    sid = 3
+    order = router.successors(sid)
+    calls = []
+
+    def make(hub):
+        def ask(study_id, trial_id, number, token, redial):
+            calls.append((hub, token, redial))
+            if hub == order[0]:
+                raise HubUnavailableError("injected")
+            return {"params": {}, "hub": hub}
+
+        return ask
+
+    client = FleetClient(
+        router, {h: make(h) for h in router.hubs}, retry_policy=_no_sleep_policy()
+    )
+    resp = client.ask(sid, 0, 0, "tok-x")
+    assert resp["hub"] == order[1]
+    # First attempt: the owner, not a redial. Second: the successor, marked
+    # fleet_redial (the replay-record check), SAME token.
+    assert calls == [(order[0], "tok-x", False), (order[1], "tok-x", True)]
+
+
+def test_fleet_client_reraises_non_unavailable_errors():
+    router = FleetRouter(["a", "b"])
+
+    def ask(study_id, trial_id, number, token, redial):
+        raise ValueError("not a transport problem")
+
+    client = FleetClient(
+        router, {h: ask for h in router.hubs}, retry_policy=_no_sleep_policy()
+    )
+    with pytest.raises(ValueError):
+        client.ask(1, 0, 0, "tok")
+
+
+def test_fleet_client_exhausts_attempts_when_all_hubs_are_dead():
+    router = FleetRouter(["a", "b"])
+    attempts = []
+
+    def ask(study_id, trial_id, number, token, redial):
+        attempts.append(1)
+        raise HubUnavailableError("all dead")
+
+    client = FleetClient(
+        router, {h: ask for h in router.hubs}, retry_policy=_no_sleep_policy(4)
+    )
+    with pytest.raises(HubUnavailableError):
+        client.ask(1, 0, 0, "tok")
+    assert len(attempts) == 4
+
+
+def test_fleet_client_requires_an_ask_per_hub():
+    router = FleetRouter(["a", "b"])
+    with pytest.raises(ValueError, match="b"):
+        FleetClient(router, {"a": lambda *a: {}})
+
+
+# ----------------------------------------------------------- burn verdicts
+
+
+def test_burn_key_ranks_draining_and_critical_last():
+    key = FleetHub._burn_key
+    idle = key({"score": 0.0, "depth": 0})
+    busy = key({"score": 0.0, "depth": 9})
+    burning = key({"score": 2.5, "depth": 0, "burning": True})
+    critical = key({"score": 0.0, "critical": True})
+    draining = key({"draining": True})
+    assert idle < busy < burning
+    assert critical[0] == float("inf") and draining[0] == float("inf")
+
+
+def test_least_burning_peer_prefers_idle_and_skips_critical():
+    storage = InMemoryStorage()
+    router = FleetRouter(["me", "idle", "busy", "onfire"])
+
+    class _Peer:
+        def __init__(self, verdict):
+            self._verdict = verdict
+
+        def service_burn_verdict(self):
+            return dict(self._verdict)
+
+    class _Svc:
+        _health_worker_id = "me-serve"
+
+    hub = FleetHub(
+        "me",
+        _Svc(),
+        router,
+        storage,
+        peers={
+            "idle": _Peer({"score": 0.0, "depth": 1}),
+            "busy": _Peer({"score": 1.0, "depth": 5, "burning": True}),
+            "onfire": _Peer({"score": 0.0, "critical": True}),
+        },
+    )
+    alive = frozenset(router.hubs)
+    assert hub._least_burning_peer(alive) == "idle"
+    # The idle peer dies: the burning-but-not-critical peer is next.
+    assert hub._least_burning_peer(alive - {"idle"}) == "busy"
+    # Only the critical peer remains: nobody is a shed target.
+    assert hub._least_burning_peer(frozenset({"onfire"})) is None
+
+
+def test_fleet_events_have_a_counter_family_home():
+    assert "serve.fleet" in telemetry.COUNTERS
+    for event in FLEET_EVENTS:
+        # Suffix-extension of the family is what the vocabulary scan allows.
+        assert event and "." not in event

@@ -1,0 +1,636 @@
+"""Hub-fleet chaos acceptance (ISSUE 16 / HubChaosPlan / HUB_CHAOS_MATRIX).
+
+SIGKILL one of four in-process fleet hubs mid-burst (:class:`FakeHubFleet` —
+real services, real gRPC handlers, one shared storage, no sockets): zero
+lost asks, every committed-but-unacked ask answered exactly once by a ring
+successor through the shared replay record, every healthy trial COMPLETE
+exactly once with zero RUNNING, and the doctor reports ``service.hub_dead``
+naming the dead hub. The fault-free fleet-of-1 twin is bit-identical to the
+single-hub service on the same seed. Shed-forwarding spills an overloaded
+hub's asks to the least-burning peer (with cross-hub flow arrows) before
+any client sees RESOURCE_EXHAUSTED; a fleet-wide burst still walks the
+client-visible shed ladder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import flight, health, telemetry
+from optuna_tpu.samplers import TPESampler
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.storages._grpc import _service as wire
+from optuna_tpu.storages._grpc.fleet import FLEET_EVENTS, FORWARD_FLOW, FleetReplicator
+from optuna_tpu.storages._grpc.suggest_service import (
+    ShedPolicy,
+    SuggestService,
+    ThinClientSampler,
+)
+from optuna_tpu.storages._retry import RetryPolicy
+from optuna_tpu.testing.fault_injection import (
+    HUB_CHAOS_MATRIX,
+    FakeHubFleet,
+    hub_chaos_plan,
+)
+from optuna_tpu.trial._state import TrialState
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability():
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    saved_flight = flight.enabled()
+    health_was = health.enabled()
+    health.enable(interval_s=0.0)
+    yield
+    health.disable()
+    if health_was:
+        health.enable()
+    flight.disable()
+    if saved_flight:
+        flight.enable()
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _objective(trial) -> float:
+    x = trial.suggest_float("x", -5.0, 5.0)
+    y = trial.suggest_float("y", -5.0, 5.0)
+    return (x - 1.0) ** 2 + (y + 2.0) ** 2
+
+
+def _service_factory(storage, plan, **overrides):
+    def factory(name):
+        kwargs = dict(
+            ready_ahead=0,
+            coalesce_window_s=0.0,
+        )
+        kwargs.update(overrides)
+        return SuggestService(
+            storage,
+            lambda: TPESampler(
+                multivariate=True,
+                n_startup_trials=plan.n_startup_trials,
+                seed=plan.seed,
+            ),
+            **kwargs,
+        )
+
+    return factory
+
+
+def _fleet(storage, names, plan, **overrides) -> FakeHubFleet:
+    return FakeHubFleet(storage, names, _service_factory(storage, plan, **overrides))
+
+
+def test_hub_chaos_matrix_covers_every_event():
+    assert set(HUB_CHAOS_MATRIX) == set(FLEET_EVENTS)
+
+
+def test_hub_kill_chaos_acceptance():
+    """The tentpole acceptance: kill 1 of 4 hubs mid-burst; zero lost asks,
+    committed-but-unacked asks replay exactly once on the successor, every
+    trial completes with zero RUNNING, and the doctor names the dead hub."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = [f"hub-{i}" for i in range(plan.n_hubs)]
+    fleet = _fleet(storage, names, plan)
+    mounted = fleet.mounted[names[0]]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="kill", direction="minimize")
+        sid = storage.get_study_id_from_name("kill")
+        victim = fleet.router.hub_for(sid)
+        survivors = [n for n in names if n != victim]
+
+        def run_trials(count, seed):
+            sampler = fleet.thin_client(seed=seed)
+            study = optuna_tpu.load_study(
+                study_name="kill", storage=mounted, sampler=sampler
+            )
+            for _ in range(count):
+                trial = study.ask()
+                study.tell(trial, _objective(trial))
+
+        # ---- phase 1: the burst is mid-flight when chaos strikes
+        run_trials(plan.kill_after_trials, seed=100)
+
+        # ---- phase 2: committed-but-unacked — the owner answers (and
+        # replicates) but the response dies on the wire; the client redials
+        # the ring successor with the SAME op token and the successor
+        # replays the shared record instead of re-executing.
+        fleet.drop_response(victim, "service_ask", count=plan.drop_responses)
+        run_trials(plan.drop_responses, seed=101)
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("serve.fleet.ask_replayed", 0) == plan.drop_responses
+
+        # ---- phase 3: SIGKILL the owner; the burst continues concurrently
+        fleet.kill(victim)
+        remaining = plan.n_trials - plan.kill_after_trials - plan.drop_responses
+        per_client = remaining // plan.n_clients
+        errors: list[BaseException] = []
+
+        def client(seed):
+            try:
+                run_trials(per_client, seed)
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append(err)
+
+        threads = [
+            threading.Thread(target=client, args=(200 + i,))
+            for i in range(plan.n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        # ---- zero lost asks: every ask was answered, every trial landed
+        study = optuna_tpu.load_study(study_name="kill", storage=mounted)
+        trials = study.trials
+        assert len(trials) == plan.kill_after_trials + plan.drop_responses + (
+            per_client * plan.n_clients
+        )
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+        assert sum(1 for t in trials if t.state == TrialState.RUNNING) == 0
+        assert all(set(t.params) == {"x", "y"} for t in trials)
+
+        # ---- the failover was observed on the one vocabulary
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("serve.fleet.hub_dead", 0) >= 1
+        assert counters.get("serve.fleet.hub_rehome", 0) >= 1
+
+        # ---- the doctor names the dead hub (and only it)
+        report = study.health_report()
+        findings = {f["check"]: f for f in report["findings"]}
+        assert "service.hub_dead" in findings
+        assert findings["service.hub_dead"]["evidence"]["dead_hubs"] == [victim]
+        assert set(survivors).isdisjoint(
+            findings["service.hub_dead"]["evidence"]["dead_hubs"]
+        )
+    finally:
+        fleet.close()
+
+
+def test_fault_free_fleet_of_one_twin_is_bit_identical_to_single_hub():
+    """A fleet of 1 is the single hub, bit for bit and write for write: the
+    same draw sequence as a local sampler, zero fleet counters, and zero
+    ``serve:fleet:*`` replication attrs on the shared storage."""
+    plan = hub_chaos_plan()
+
+    def sampler():
+        return TPESampler(
+            multivariate=True, n_startup_trials=plan.n_startup_trials, seed=plan.seed
+        )
+
+    local_storage = InMemoryStorage()
+    optuna_tpu.create_study(
+        storage=local_storage, study_name="twin", direction="minimize"
+    )
+    local = optuna_tpu.load_study(
+        study_name="twin", storage=local_storage, sampler=sampler()
+    )
+    for _ in range(12):
+        trial = local.ask()
+        local.tell(trial, _objective(trial))
+
+    storage = InMemoryStorage()
+    fleet = _fleet(storage, ["solo"], plan, health_reporting=False)
+    mounted = fleet.mounted["solo"]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="twin", direction="minimize")
+        sid = storage.get_study_id_from_name("twin")
+        served = optuna_tpu.load_study(
+            study_name="twin", storage=mounted, sampler=fleet.thin_client(seed=plan.seed)
+        )
+        for _ in range(12):
+            trial = served.ask()
+            served.tell(trial, _objective(trial))
+        for ours, ref in zip(served.trials, local.trials):
+            assert ours.params == ref.params
+            assert ours.values == ref.values
+            assert ours.state == ref.state == TrialState.COMPLETE
+        counters = telemetry.snapshot()["counters"]
+        assert not any(k.startswith("serve.fleet") for k in counters)
+        assert not any(k.startswith("serve.shed") for k in counters)
+        attrs = storage.get_study_system_attrs(sid)
+        assert not any(k.startswith("serve:fleet:") for k in attrs)
+    finally:
+        fleet.close()
+
+
+def test_misrouted_ask_is_forwarded_to_the_owner_and_answered():
+    """The routing contract: an ask landing on a non-owner hub is answered
+    by forwarding to the owner — never rejected — with the cross-hub flow
+    arrow recorded at both ends."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = ["hub-a", "hub-b", "hub-c"]
+    fleet = _fleet(storage, names, plan)
+    flight.enable(flight.FlightRecorder(capacity=4096))
+    mounted = fleet.mounted[names[0]]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="mis", direction="minimize")
+        sid = storage.get_study_id_from_name("mis")
+        owner = fleet.router.hub_for(sid)
+        wrong = next(n for n in names if n != owner)
+
+        def ask(study_id, trial_id, number, token):
+            # Deliberately mis-routed: every ask targets a non-owner hub.
+            return fleet.rpc(
+                wrong, "service_ask", study_id, trial_id, number,
+                **{wire.OP_TOKEN_KEY: token},
+            )
+
+        sampler = ThinClientSampler(ask, seed=5, max_shed_retries=0)
+        study = optuna_tpu.load_study(study_name="mis", storage=mounted, sampler=sampler)
+        for _ in range(3):
+            trial = study.ask()
+            study.tell(trial, _objective(trial))
+        assert sampler.sheds_seen == 0  # forwarded and answered, never rejected
+        assert all(t.state == TrialState.COMPLETE for t in study.trials)
+
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("serve.fleet.ask_forward", 0) == 3
+        flows = [
+            ev for ev in flight.events()
+            if ev.kind == "flow" and ev.name == FORWARD_FLOW
+        ]
+        outs = {ev.meta["flow_id"] for ev in flows if ev.meta["dir"] == "out"}
+        ins = {ev.meta["flow_id"] for ev in flows if ev.meta["dir"] == "in"}
+        assert outs and outs == ins  # every arrow crosses hubs and is matched
+    finally:
+        fleet.close()
+
+
+def test_overload_spills_to_least_burning_peer_before_any_client_shed():
+    """Fleet shedding: one hub overloaded into its reject rung forwards to
+    the idle peer — the client never sees RESOURCE_EXHAUSTED. A fleet-wide
+    burst (every hub rejecting) still walks the client shed ladder."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = ["hub-a", "hub-b"]
+    fleet = _fleet(storage, names, plan)
+    flight.enable(flight.FlightRecorder(capacity=4096))
+    mounted = fleet.mounted[names[0]]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="shed", direction="minimize")
+        sid = storage.get_study_id_from_name("shed")
+        owner = fleet.router.hub_for(sid)
+        peer = next(n for n in names if n != owner)
+
+        # ---- one overloaded hub: its rejects spill to the idle peer
+        fleet.hubs[owner].service.shed_policy = ShedPolicy(
+            degrade_depth=0, independent_depth=0, reject_depth=1, retry_after_s=0.001
+        )
+        sampler = fleet.thin_client(seed=11, max_shed_retries=0)
+        study = optuna_tpu.load_study(
+            study_name="shed", storage=mounted, sampler=sampler
+        )
+        n_burst = 4
+        for _ in range(n_burst):
+            trial = study.ask()
+            study.tell(trial, _objective(trial))
+        assert sampler.sheds_seen == 0  # the fleet absorbed the overload
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("serve.fleet.shed_forward", 0) == n_burst
+        flows = [
+            ev for ev in flight.events()
+            if ev.kind == "flow" and ev.name == FORWARD_FLOW
+        ]
+        crossing = [
+            ev for ev in flows
+            if ev.meta.get("from") == owner and ev.meta.get("to") == peer
+        ]
+        assert crossing  # the spill is a visible cross-hub arrow
+
+        # ---- fleet-wide burst: nowhere to spill, the client ladder engages
+        fleet.hubs[peer].service.shed_policy = ShedPolicy(
+            degrade_depth=0, independent_depth=0, reject_depth=1, retry_after_s=0.001
+        )
+        sleeps: list[float] = []
+        burst = fleet.thin_client(seed=12, max_shed_retries=0, sleep=sleeps.append)
+        burst_study = optuna_tpu.load_study(
+            study_name="shed", storage=mounted, sampler=burst
+        )
+        for _ in range(2):
+            trial = burst_study.ask()
+            burst_study.tell(trial, _objective(trial))
+        assert burst.sheds_seen == 2  # PR 13 contract: the ladder still walks
+        assert all(
+            t.state == TrialState.COMPLETE
+            for t in optuna_tpu.load_study(study_name="shed", storage=mounted).trials
+        )
+    finally:
+        fleet.close()
+
+
+def test_partition_then_heal_restores_ownership():
+    """A partitioned hub's studies re-home to the successor; when the
+    partition heals the owner resumes answering its own studies."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = ["hub-a", "hub-b", "hub-c"]
+    fleet = _fleet(storage, names, plan)
+    mounted = fleet.mounted[names[0]]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="p", direction="minimize")
+        sid = storage.get_study_id_from_name("p")
+        owner = fleet.router.hub_for(sid)
+
+        def run(count, seed):
+            sampler = fleet.thin_client(seed=seed)
+            study = optuna_tpu.load_study(
+                study_name="p", storage=mounted, sampler=sampler
+            )
+            for _ in range(count):
+                trial = study.ask()
+                study.tell(trial, _objective(trial))
+
+        run(3, seed=20)
+        fleet.kill(owner)  # the partition
+        run(3, seed=21)  # successors answer; nothing is lost
+        fleet.heal(owner)  # the partition heals
+
+        owner_handle = fleet.hubs[owner].service._handle(sid)
+        asks_before = owner_handle.asks_since_fill
+        run(3, seed=22)
+        assert owner_handle.asks_since_fill > asks_before  # ownership restored
+
+        trials = optuna_tpu.load_study(study_name="p", storage=mounted).trials
+        assert len(trials) == 9
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+    finally:
+        fleet.close()
+
+
+def test_kill_during_refill_successor_adopts_epoch_watermark():
+    """A hub killed while its ready queue is mid-churn: the successor adopts
+    the published epoch watermark (its epochs continue the dead hub's, not
+    restart at 0) and the study keeps completing trials."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = ["hub-a", "hub-b"]
+    fleet = _fleet(storage, names, plan)
+    mounted = fleet.mounted[names[0]]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="rf", direction="minimize")
+        sid = storage.get_study_id_from_name("rf")
+        owner = fleet.router.hub_for(sid)
+        successor = next(n for n in names if n != owner)
+
+        def run(count, seed):
+            sampler = fleet.thin_client(seed=seed)
+            study = optuna_tpu.load_study(
+                study_name="rf", storage=mounted, sampler=sampler
+            )
+            for _ in range(count):
+                trial = study.ask()
+                study.tell(trial, _objective(trial))
+
+        run(3, seed=30)
+        # The owner's queue churns (a refill-then-invalidate storm), then
+        # one more ask publishes the epoch watermark before the kill.
+        owner_handle = fleet.hubs[owner].service._handle(sid)
+        for _ in range(5):
+            owner_handle.queue.invalidate()
+        run(1, seed=31)
+        floor = FleetReplicator(storage).watermark_epoch(sid)
+        assert floor >= 5
+
+        fleet.kill(owner)
+        run(3, seed=32)
+        successor_handle = fleet.hubs[successor].service._handle(sid)
+        assert successor_handle.queue.epoch >= floor  # epochs continued
+
+        trials = optuna_tpu.load_study(study_name="rf", storage=mounted).trials
+        assert len(trials) == 7
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+    finally:
+        fleet.close()
+
+
+def test_drain_mid_burst_answers_every_parked_ask():
+    """The SIGTERM contract under a live burst: a drain while asks are
+    parked in the coalesce window answers or sheds every one of them —
+    never hangs, never drops — and the fleet keeps serving through the
+    peer afterwards (the drained hub's answers are already in the shared
+    journal for its successor)."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = ["hub-a", "hub-b"]
+    # A wide-open coalesce window: the burst parks mid-window until the
+    # drain (or the width trigger) flushes it.
+    fleet = _fleet(storage, names, plan, coalesce_window_s=0.2, max_coalesce=64)
+    mounted = fleet.mounted[names[0]]
+    try:
+        optuna_tpu.create_study(storage=mounted, study_name="dr", direction="minimize")
+        sid = storage.get_study_id_from_name("dr")
+        owner = fleet.router.hub_for(sid)
+
+        # Warm past startup so asks take the (coalescing) relative path.
+        warm = fleet.thin_client(seed=40)
+        warm_study = optuna_tpu.load_study(
+            study_name="dr", storage=mounted, sampler=warm
+        )
+        for _ in range(plan.n_startup_trials + 1):
+            trial = warm_study.ask()
+            warm_study.tell(trial, _objective(trial))
+
+        n_burst = 4
+        results: list[str | None] = [None] * n_burst
+        errors: list[BaseException] = []
+        started = threading.Barrier(n_burst + 1)
+
+        def client(i):
+            try:
+                sampler = fleet.thin_client(seed=50 + i)
+                study = optuna_tpu.load_study(
+                    study_name="dr", storage=mounted, sampler=sampler
+                )
+                started.wait(timeout=10.0)
+                trial = study.ask()
+                study.tell(trial, _objective(trial))
+                results[i] = "answered"
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append(err)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n_burst)]
+        for t in threads:
+            t.start()
+        started.wait(timeout=10.0)
+        time.sleep(0.02)  # let the burst park in the open window
+        fleet.hubs[owner].drain()  # SIGTERM: flush the window NOW
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not any(t.is_alive() for t in threads), "a parked ask hung"
+        assert not errors, errors
+        assert results == ["answered"] * n_burst  # every parked ask resolved
+
+        # The drained hub sheds; the fleet still serves through the peer.
+        post = fleet.thin_client(seed=60)
+        post_study = optuna_tpu.load_study(
+            study_name="dr", storage=mounted, sampler=post
+        )
+        trial = post_study.ask()
+        post_study.tell(trial, _objective(trial))
+
+        trials = optuna_tpu.load_study(study_name="dr", storage=mounted).trials
+        assert sum(1 for t in trials if t.state == TrialState.RUNNING) == 0
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_eight_hub_saturation():
+    """Saturation: 8 hubs, 8 studies, 16 concurrent clients hammering the
+    fleet through the consistent-hash ring — every ask answered, every
+    trial COMPLETE, zero RUNNING, and at least one study lands on a hub
+    other than hub-0 (the ring actually partitions)."""
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    names = [f"hub-{i}" for i in range(8)]
+    fleet = _fleet(storage, names, plan)
+    mounted = fleet.mounted[names[0]]
+    n_studies = 8
+    per_client = 4
+    try:
+        sids = []
+        for i in range(n_studies):
+            optuna_tpu.create_study(
+                storage=mounted, study_name=f"sat-{i}", direction="minimize"
+            )
+            sids.append(storage.get_study_id_from_name(f"sat-{i}"))
+        owners = {fleet.router.hub_for(sid) for sid in sids}
+        assert len(owners) > 1  # the ring spreads studies across hubs
+
+        errors: list[BaseException] = []
+
+        def client(i):
+            try:
+                sampler = fleet.thin_client(seed=300 + i)
+                study = optuna_tpu.load_study(
+                    study_name=f"sat-{i % n_studies}", storage=mounted, sampler=sampler
+                )
+                for _ in range(per_client):
+                    trial = study.ask()
+                    study.tell(trial, _objective(trial))
+            except BaseException as err:  # noqa: BLE001 - surfaced below
+                errors.append(err)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in threads)
+        assert not errors, errors
+
+        total = 0
+        for i in range(n_studies):
+            trials = optuna_tpu.load_study(
+                study_name=f"sat-{i}", storage=mounted
+            ).trials
+            assert all(t.state == TrialState.COMPLETE for t in trials)
+            total += len(trials)
+        assert total == 16 * per_client
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_real_socket_fleet_smoke():
+    """Two hubs on real gRPC sockets sharing one storage: a thin client
+    pointed at the WRONG hub still completes trials (the mis-route is
+    forwarded hub-to-hub over the socket peer channel)."""
+    from optuna_tpu.storages._grpc import fleet as fleet_mod
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.testing.storages import _find_free_port
+
+    plan = hub_chaos_plan()
+    storage = InMemoryStorage()
+    ports = [_find_free_port(), _find_free_port()]
+    names = [f"localhost:{p}" for p in ports]
+    servers = []
+    hubs = []
+    try:
+        for name, port in zip(names, ports):
+            service = SuggestService(
+                storage,
+                lambda: TPESampler(
+                    multivariate=True,
+                    n_startup_trials=plan.n_startup_trials,
+                    seed=plan.seed,
+                ),
+                ready_ahead=0,
+                coalesce_window_s=0.0,
+            )
+            hub = fleet_mod.attach_hub(service, storage, names, name)
+            server = make_grpc_server(storage, "localhost", port, suggest_service=hub)
+            server.start()
+            servers.append(server)
+            hubs.append(hub)
+
+        proxy = GrpcStorageProxy(host="localhost", port=ports[0])
+        optuna_tpu.create_study(storage=proxy, study_name="sock", direction="minimize")
+        sid = proxy.get_study_id_from_name("sock")
+        owner = hubs[0].router.hub_for(sid)
+        wrong_port = ports[1] if owner == names[0] else ports[0]
+        wrong_proxy = GrpcStorageProxy(host="localhost", port=wrong_port)
+        sampler = ThinClientSampler(proxy=wrong_proxy, seed=5)
+        study = optuna_tpu.load_study(
+            study_name="sock", storage=wrong_proxy, sampler=sampler
+        )
+        for _ in range(plan.n_startup_trials + 2):
+            trial = study.ask()
+            study.tell(trial, _objective(trial))
+        trials = optuna_tpu.load_study(study_name="sock", storage=proxy).trials
+        assert len(trials) == plan.n_startup_trials + 2
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("serve.fleet.ask_forward", 0) >= 1
+
+        # The README client path: FleetClient over fleet_asks routes to the
+        # owner over the socket (no forwards) and kills one hub -> the ring
+        # redial answers through the survivor, same token, zero lost asks.
+        fclient = fleet_mod.FleetClient(
+            fleet_mod.FleetRouter(names),
+            fleet_mod.fleet_asks(names),
+            retry_policy=RetryPolicy(max_attempts=5, sleep=lambda _s: None),
+        )
+        ring_sampler = ThinClientSampler(fclient.ask, seed=9)
+        owner_index = names.index(owner)
+        # Storage traffic through the survivor: the kill below must only
+        # sever the SUGGEST path, so what it proves is the ring redial.
+        survivor_proxy = GrpcStorageProxy(
+            host="localhost", port=ports[1 - owner_index]
+        )
+        ring_study = optuna_tpu.load_study(
+            study_name="sock", storage=survivor_proxy, sampler=ring_sampler
+        )
+        for _ in range(2):
+            trial = ring_study.ask()
+            ring_study.tell(trial, _objective(trial))
+        servers[owner_index].stop(0)  # SIGKILL the owner's socket
+        for _ in range(2):
+            trial = ring_study.ask()
+            ring_study.tell(trial, _objective(trial))
+        trials = optuna_tpu.load_study(
+            study_name="sock", storage=survivor_proxy
+        ).trials
+        assert len(trials) == plan.n_startup_trials + 6
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+    finally:
+        for hub in hubs:
+            hub.close()
+        for server in servers:
+            server.stop(0)

@@ -554,6 +554,60 @@ def test_doctor_cli_and_health_endpoint_serve_the_same_report(capsys):
         server.shutdown()
 
 
+def test_doctor_cli_merges_comma_separated_hub_endpoints(capsys):
+    """``optuna-tpu doctor --endpoint hub-a,hub-b,...`` (the hub-fleet
+    surface, ISSUE 16): per-hub ``/health.json`` reports merge into one —
+    findings unioned by check and tagged with the hubs that raised them,
+    and an unreachable hub is LISTED, not fatal (the survivors'
+    ``service.hub_dead`` verdict is the point of asking)."""
+    from optuna_tpu.testing.fault_injection import plant_dead_worker
+    from optuna_tpu.testing.storages import _find_free_port
+
+    # Two hubs with divergent views of the same-named study: only hub A
+    # sees the dead worker (the only-one-hub-can-see-it case the merge
+    # must not lose to a fresher but blind base report).
+    study_a = optuna_tpu.create_study(
+        study_name="fdoc", sampler=RandomSampler(seed=0)
+    )
+    study_a.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=2)
+    plant_dead_worker(study_a, worker_id="gone", age_s=900.0)
+    study_b = optuna_tpu.create_study(
+        study_name="fdoc", sampler=RandomSampler(seed=0)
+    )
+    study_b.optimize(lambda t: t.suggest_float("x", 0, 1) ** 2, n_trials=2)
+    storage_a, storage_b = study_a._storage, study_b._storage
+    server_a = telemetry.serve_metrics(
+        0, health_source=lambda: health.storage_health_reports(storage_a)
+    )
+    server_b = telemetry.serve_metrics(
+        0, health_source=lambda: health.storage_health_reports(storage_b)
+    )
+    try:
+        url_a = f"http://localhost:{server_a.server_address[1]}"
+        url_b = f"http://localhost:{server_b.server_address[1]}"
+        dead = f"http://localhost:{_find_free_port()}"  # nothing listens
+        assert cli_main(
+            ["doctor", "--study-name", "fdoc", "--format", "json",
+             "--endpoint", f"{url_a},{url_b},{dead}"]
+        ) == 0
+        merged = json.loads(capsys.readouterr().out)
+        assert merged["healthy"] is False
+        by_check = {f["check"]: f for f in merged["findings"]}
+        assert "worker.dead" in by_check
+        assert by_check["worker.dead"]["hubs"] == [url_a]  # tagged to its hub
+        assert merged["hub_endpoints"]["reachable"] == sorted([url_a, url_b])
+        assert merged["hub_endpoints"]["unreachable"] == [dead]
+
+        # Every hub unreachable: loud, not an empty clean bill.
+        assert cli_main(
+            ["doctor", "--study-name", "fdoc",
+             "--endpoint", f"{dead},{dead}"]
+        ) == 2
+    finally:
+        server_a.shutdown()
+        server_b.shutdown()
+
+
 def test_doctor_cli_local_storage(tmp_path, capsys):
     url = f"sqlite:///{tmp_path}/doc.db"
     study = optuna_tpu.create_study(

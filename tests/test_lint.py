@@ -283,6 +283,72 @@ def test_act001_fixture_in_sync_is_silent():
     assert not result.findings, [f.format() for f in result.findings]
 
 
+def test_flt001_registry_matches_runtime_sets():
+    """The canonical fleet-event registry equals the *runtime* values of
+    both hand-written copies (the lint compares them statically) — and
+    every event has a serve.fleet.<event> counter home in the telemetry
+    vocabulary (the suffixed family)."""
+    from optuna_tpu import telemetry
+    from optuna_tpu.storages._grpc import fleet
+    from optuna_tpu.testing.fault_injection import HUB_CHAOS_MATRIX
+
+    canonical = set(lint_registry.FLEET_EVENT_REGISTRY)
+    assert set(fleet.FLEET_EVENTS) == canonical
+    assert set(HUB_CHAOS_MATRIX) == canonical
+    assert "serve.fleet" in telemetry.COUNTERS
+
+
+def test_flt001_gate_rejects_drift():
+    """Point FLT001 at the real files with a registry containing an event
+    the code does not know: both copies must be reported as drifted —
+    adding a failover event without a hub-kill scenario that forces it is
+    a lint failure (the STO001/.../ACT001 discipline): an unexercised
+    failover path loses its first real in-flight ask in production."""
+    fat_registry = dict(lint_registry.FLEET_EVENT_REGISTRY)
+    fat_registry["hub_phantom_event"] = "made-up event to prove the gate is live"
+    config = Config(flt001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.flt001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "FLT001"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("hub_phantom_event" in f.message for f in drifted)
+
+
+_FLT001_FIXTURE_REGISTRY = {
+    "hub_blip": "a hub went briefly dark",
+    "ask_detour": "an ask took the scenic route",
+}
+
+
+def _flt001_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        flt001_registry=_FLT001_FIXTURE_REGISTRY,
+        flt001_targets=(
+            (f"fixtures/lint/{tree}/fleet_mod.py", "FLEET_EVENTS", "event vocabulary"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "HUB_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_flt001_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "flt001_pos")
+    result = run_lint([tree], _flt001_config("flt001_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "hub_phantom" in by_file["fleet_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_flt001_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "flt001_neg")
+    result = run_lint([tree], _flt001_config("flt001_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 def test_obs002_registry_matches_runtime_sets():
     """The canonical flight event-kind registry equals the *runtime* values
     of both hand-written copies (the lint compares them statically)."""

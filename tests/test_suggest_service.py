@@ -493,13 +493,47 @@ def test_reject_shed_carries_retry_after_and_client_converges():
             mounted, rpc, seed=2, max_shed_retries=2, sleep=sleeps.append
         )
         _run_trials(study, 3)
-        # Every ask was rejected; the client honored retry-after, then
-        # converged via the local independent path — the study never aborts.
+        # Every ask was rejected; the client honored retry-after (full
+        # jitter: uniform in [0, retry_after_s]), then converged via the
+        # local independent path — the study never aborts.
         assert sampler.sheds_seen >= 3
-        assert sleeps and all(s == 0.001 for s in sleeps)
+        assert sleeps and all(0.0 <= s <= 0.001 for s in sleeps)
         assert all(t.state == TrialState.COMPLETE for t in study.trials)
         assert all(set(t.params) == {"x", "y"} for t in study.trials)
         assert telemetry.snapshot()["counters"]["serve.shed.reject"] >= 3
+    finally:
+        service.close()
+
+
+def test_shed_retry_sleeps_are_jittered_per_client():
+    """Thundering-herd regression: two clients shed on the SAME tick with
+    the SAME retry-after must draw DIFFERENT sleeps (full jitter through a
+    per-instance RetryPolicy), so the retry wave is decorrelated instead of
+    re-slamming the recovering hub in lockstep. The jitter rng is
+    deliberately not derived from the sampler seed — two workers cloned
+    from one config must still desynchronize."""
+    storage = InMemoryStorage()
+    service, mounted, rpc, _ = _serve_stack(
+        storage,
+        ready_ahead=0,
+        shed_policy=ShedPolicy(degrade_depth=0, independent_depth=0, reject_depth=1,
+                               retry_after_s=0.01),
+    )
+    try:
+        sleeps_a: list[float] = []
+        sleeps_b: list[float] = []
+        study_a, _ = _client_study(
+            mounted, rpc, seed=3, max_shed_retries=2, sleep=sleeps_a.append
+        )
+        study_b, _ = _client_study(
+            mounted, rpc, seed=3, max_shed_retries=2, sleep=sleeps_b.append
+        )
+        _run_trials(study_a, 2)
+        _run_trials(study_b, 2)
+        assert len(sleeps_a) >= 2 and len(sleeps_b) >= 2
+        assert all(0.0 <= s <= 0.01 for s in sleeps_a + sleeps_b)
+        # Identical sampler seeds, identical retry-after — different draws.
+        assert sleeps_a != sleeps_b
     finally:
         service.close()
 
