@@ -19,6 +19,10 @@ OBS004   study-doctor check vocabularies drifted from the canonical one
 OBS005   SLO objective vocabularies drifted from the canonical one
 STO001   replay-unsafe write registries drifted from the canonical one
 STO002   lock-order cycle in the storage layer
+CONC001  interprocedural lock-order cycle (package-wide, self-call aware)
+CONC002  blocking call under a held lock on a serve hot path
+CONC003  thread-shared attribute written outside a lock
+CONC004  lock sanitizer vocabularies drifted from the canonical one
 SRV001   suggestion-service shed policy sets drifted from the canonical one
 ACT001   autopilot action vocabularies drifted from the canonical one
 FLT001   hub-fleet event vocabularies drifted from the canonical one
@@ -60,6 +64,12 @@ def all_rules() -> list[Rule]:
         TPU003DtypeDrift,
         TPU004StrayDebugOutput,
     )
+    from optuna_tpu._lint.rules_concurrency import (
+        CONC001LockOrder,
+        CONC002BlockingUnderLock,
+        CONC003ThreadSharedWrite,
+        CONC004LocksanRegistrySync,
+    )
     from optuna_tpu._lint.rules_py import PY001BroadExcept
     from optuna_tpu._lint.rules_sampler import (
         SMP001FallbackPolicySync,
@@ -86,6 +96,10 @@ def all_rules() -> list[Rule]:
         OBS005SloRegistrySync(),
         STO001ReplayRegistrySync(),
         STO002LockOrder(),
+        CONC001LockOrder(),
+        CONC002BlockingUnderLock(),
+        CONC003ThreadSharedWrite(),
+        CONC004LocksanRegistrySync(),
         SRV001ShedPolicySync(),
         ACT001ActionRegistrySync(),
         FLT001FleetEventSync(),

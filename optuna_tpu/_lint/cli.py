@@ -24,8 +24,9 @@ def _build_parser() -> argparse.ArgumentParser:
         help="files or directories to lint (default: optuna_tpu)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="finding output format (default: text)",
+        "--format", choices=("text", "json", "github"), default="text",
+        help="finding output format; 'github' emits ::error workflow "
+        "annotations (default: text)",
     )
     parser.add_argument(
         "--config", default=None, metavar="PYPROJECT",
@@ -62,7 +63,24 @@ def main(argv: Sequence[str] | None = None) -> int:
         print(f"optuna-tpu-lint: {err}", file=sys.stderr)
         return 2
 
-    if args.format == "json":
+    if args.format == "github":
+        # GitHub Actions workflow commands: one ::error per finding, so the
+        # findings land as inline PR annotations. Newlines cannot appear in
+        # the message portion of a workflow command; findings never contain
+        # them, but escape defensively as the protocol requires (%0A/%0D,
+        # and %25 so literal percent signs round-trip).
+        for finding in result.findings:
+            message = (
+                f"{finding.rule} {finding.message}"
+                .replace("%", "%25")
+                .replace("\r", "%0D")
+                .replace("\n", "%0A")
+            )
+            print(
+                f"::error file={finding.path},line={finding.line},"
+                f"col={finding.col},title=graphlint {finding.rule}::{message}"
+            )
+    elif args.format == "json":
         print(
             json.dumps(
                 {

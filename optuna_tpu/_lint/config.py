@@ -116,6 +116,15 @@ class Config:
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)
+    conc001_paths: tuple[str, ...] = ("optuna_tpu/",)
+    conc002_paths: tuple[str, ...] = registry.CONC002_HOT_PATHS
+    conc003_entrypoints: tuple[tuple[str, str, str], ...] = (
+        registry.CONC003_THREAD_ENTRYPOINTS
+    )
+    conc004_targets: tuple[tuple[str, str, str], ...] = registry.CONC004_TARGETS
+    conc004_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.LOCKSAN_REGISTRY
+    )
     base_dir: str | None = None  # dir containing the config file, for display paths
 
     def is_excluded(self, path: str) -> bool:

@@ -212,6 +212,22 @@ def _display_path(path: str, config) -> str:
     return rel.replace(os.sep, "/")
 
 
+#: Parse results shared across ``run_lint`` calls, keyed by absolute path
+#: and invalidated by ``(mtime_ns, size)``. One scan parses each file once
+#: and shares the AST across every rule; REPEATED scans (the tier-1 gate +
+#: the per-rule live-drift tests each rescan the package) skip the parse
+#: and tokenize work entirely. Entries are ``(stat key, source, tree,
+#: pragmas, bad-pragma (line, col, message) triples)`` — everything stored
+#: is display-path-independent, so one cache serves any config/base_dir.
+#: Rules receive the SAME tree object on every scan and must not mutate it.
+_PARSE_CACHE: dict[str, tuple[tuple[int, int], str, ast.Module, list, list]] = {}
+
+
+def clear_parse_cache() -> None:
+    """Drop every cached parse (tests; long-lived daemons after bulk edits)."""
+    _PARSE_CACHE.clear()
+
+
 def run_lint(paths: Sequence[str], config, rules: Sequence[Rule] | None = None) -> LintResult:
     """Lint ``paths`` (files or directories) under ``config`` with ``rules``.
 
@@ -233,26 +249,44 @@ def run_lint(paths: Sequence[str], config, rules: Sequence[Rule] | None = None) 
     for path in files:
         display = _display_path(path, config)
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
-        except (OSError, UnicodeDecodeError) as err:
+            stat = os.stat(path)
+        except OSError as err:
             if config.rule_enabled(PARSE_ERROR_RULE, path):
                 raw.append(Finding(PARSE_ERROR_RULE, display, 1, 1, f"unreadable file: {err}"))
             continue
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as err:
-            if config.rule_enabled(PARSE_ERROR_RULE, path):
-                raw.append(
-                    Finding(
-                        PARSE_ERROR_RULE, display, err.lineno or 1, (err.offset or 0) + 1,
-                        f"syntax error: {err.msg}",
+        stat_key = (stat.st_mtime_ns, stat.st_size)
+        cached = _PARSE_CACHE.get(path)
+        if cached is not None and cached[0] == stat_key:
+            _, source, tree, pragmas, bad_raw = cached
+        else:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    source = f.read()
+            except (OSError, UnicodeDecodeError) as err:
+                if config.rule_enabled(PARSE_ERROR_RULE, path):
+                    raw.append(
+                        Finding(PARSE_ERROR_RULE, display, 1, 1, f"unreadable file: {err}")
                     )
-                )
-            continue
-        pragmas, bad_pragmas = parse_pragmas(source, display)
+                continue
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as err:
+                if config.rule_enabled(PARSE_ERROR_RULE, path):
+                    raw.append(
+                        Finding(
+                            PARSE_ERROR_RULE, display, err.lineno or 1, (err.offset or 0) + 1,
+                            f"syntax error: {err.msg}",
+                        )
+                    )
+                continue
+            pragmas, bad_pragmas = parse_pragmas(source, display)
+            bad_raw = [(f.line, f.col, f.message) for f in bad_pragmas]
+            _PARSE_CACHE[path] = (stat_key, source, tree, pragmas, bad_raw)
         if config.rule_enabled(BAD_PRAGMA_RULE, path):
-            raw.extend(bad_pragmas)
+            raw.extend(
+                Finding(BAD_PRAGMA_RULE, display, line, col, message)
+                for line, col, message in bad_raw
+            )
         pragma_map[display] = pragmas
         ctx = ModuleContext(path, display, source, tree, config)
         contexts.append(ctx)

@@ -159,6 +159,7 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
     "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
+    "locksan.verdict": "(suffixed by kind) the lock sanitizer reported a potential deadlock cycle or a blocking window under held locks",
 }
 
 #: The flight recorder's event-kind vocabulary: canonical mirror of
@@ -403,6 +404,83 @@ FLT001_TARGETS: tuple[tuple[str, str, str], ...] = (
         "optuna_tpu/testing/fault_injection.py",
         "HUB_CHAOS_MATRIX",
         "chaos matrix: every fleet event must have a hub-fault scenario that forces it",
+    ),
+)
+
+#: The runtime lock sanitizer's named-lock vocabulary: every lock
+#: ``optuna_tpu/locksan.py`` wraps (opt-in via ``OPTUNA_TPU_LOCKSAN=1``)
+#: carries one of these names — the same name the sanitizer's verdicts,
+#: ``locksan.verdict.*`` counters, and flight postmortems report. Canonical
+#: mirror of ``locksan.py::LOCK_NAMES`` (rule **CONC004**, the STO001
+#: machinery pointed at lock identity itself). Values say what each lock
+#: guards; a lock wired into the sanitizer under a name this registry does
+#: not list is a lint failure — an anonymous lock produces verdicts nobody
+#: can map back to a code site.
+LOCKSAN_REGISTRY: dict[str, str] = {
+    "suggest.shed": "ShedPolicy's overload counters + rung state (decide() is the serve hot path)",
+    "suggest.coalesce": "the ask coalescer's leader/follower window (a Condition: followers wait on it)",
+    "suggest.ready_queue": "one study's speculative ready queue (epoch + proposals)",
+    "suggest.handle": "one study's serve handle: serializes sampler dispatch vs refill vs prewarm",
+    "suggest.handles": "the service's study-id -> handle map",
+    "suggest.inflight": "the service's in-flight ask accounting (overload signal)",
+    "suggest.refill": "the demand-refill wakeup (a Condition: the refill worker waits on it)",
+    "suggest.thin_client": "the thin client sampler's per-trial proposal cache",
+    "server.op_token": "the gRPC server's op-token replay cache + in-flight coalescing map",
+    "fleet.liveness": "a fleet hub's liveness-TTL cache of dead hub ids",
+    "fleet.adopt": "a fleet hub's adopted-studies set (re-home decisions)",
+    "fleet.peer": "a remote peer stub's in-flight forward bookkeeping",
+    "telemetry.registry": "the metrics registry's counter/gauge/histogram maps",
+    "flight.jit_totals": "the flight recorder's per-label jit compile totals",
+    "autopilot.step": "the autopilot's step serialization (reentrant: maybe_step -> step; report() shares it)",
+    "health.doctor": "a health reporter's publish sequencing + gap bookkeeping",
+    "slo.engine": "the SLO engine's quantile sketches + burn windows",
+}
+
+#: The hand-maintained copies CONC004 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+#: CONC004 additionally flags any ``locksan.lock/rlock/condition("name")``
+#: call site whose name literal is not a registry member.
+CONC004_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/locksan.py",
+        "LOCK_NAMES",
+        "the sanitizer's accepted lock names (validated at wrap time)",
+    ),
+)
+
+#: The server/hot-path modules where rule **CONC002** forbids blocking calls
+#: (storage ops, RPC dispatch, sleeps, joins, future waits, foreign-condition
+#: waits) inside a ``with <lock>:`` body — the measured 17x p99 regression
+#: class from the suggestion-service hardening (PR 13's "refresh runs
+#: OUTSIDE the policy lock"), promoted from a review note to a lint. A
+#: trailing slash means "the whole subtree".
+CONC002_HOT_PATHS: tuple[str, ...] = (
+    "optuna_tpu/storages/_grpc/",
+    "optuna_tpu/telemetry.py",
+    "optuna_tpu/flight.py",
+    "optuna_tpu/autopilot.py",
+    "optuna_tpu/health.py",
+    "optuna_tpu/slo.py",
+)
+
+#: The registered background-thread entrypoints for rule **CONC003**, as
+#: ``(path suffix, Class.method, why that method runs on its own thread)``.
+#: Any ``self.<attr>`` the entrypoint (or a method it calls one level deep)
+#: assigns is thread-shared; a lock-free assignment to the same attr in any
+#: other method of the class (``__init__`` excepted — construction
+#: happens-before the thread starts) is a data race under the right
+#: interleaving and is flagged at the main-path write site.
+CONC003_THREAD_ENTRYPOINTS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/storages/_heartbeat.py",
+        "HeartbeatThread._record_periodically",
+        "the per-batch liveness beat loop (daemon thread started by __enter__)",
+    ),
+    (
+        "optuna_tpu/storages/_grpc/suggest_service.py",
+        "SuggestService._refill_loop",
+        "the demand-scheduled ready-queue refill worker (daemon thread)",
     ),
 )
 

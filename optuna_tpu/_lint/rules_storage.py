@@ -235,7 +235,14 @@ class FLT001FleetEventSync(_RegistrySyncRule):
 
 
 def _lock_label(node: ast.AST, class_name: str, module: str) -> str | None:
-    """Identify a ``with`` context expression as a lock; None otherwise."""
+    """Identify a ``with`` context expression as a lock; None otherwise.
+
+    Recognized spellings: anything containing "lock"/"mutex"/"cond"
+    (``threading.Condition`` IS a lock — its ``with`` acquires one), plus
+    the classic ``cv`` condition-variable abbreviation as a whole
+    underscore-separated token (``_cv``, ``cv_ready``; NOT ``recv``, which
+    merely contains the letters).
+    """
     if isinstance(node, ast.Attribute):
         name = node.attr
     elif isinstance(node, ast.Name):
@@ -243,7 +250,13 @@ def _lock_label(node: ast.AST, class_name: str, module: str) -> str | None:
     else:
         return None
     lowered = name.lower()
-    if "lock" not in lowered and "mutex" not in lowered:
+    is_lock = (
+        "lock" in lowered
+        or "mutex" in lowered
+        or "cond" in lowered
+        or "cv" in lowered.strip("_").split("_")
+    )
+    if not is_lock:
         return None
     owner = class_name if class_name else module
     return f"{owner}.{name}"

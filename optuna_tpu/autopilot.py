@@ -69,7 +69,7 @@ import weakref
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable, Mapping
 
-from optuna_tpu import health, telemetry
+from optuna_tpu import health, locksan, telemetry
 from optuna_tpu.logging import get_logger, warn_once
 
 if TYPE_CHECKING:
@@ -283,7 +283,7 @@ class Autopilot:
         # Reentrant: maybe_step -> step nest on the stepping thread, and
         # report() (the /autopilot.json handler's thread) takes the same
         # lock so a scrape never iterates the log/cooldowns mid-mutation.
-        self._step_lock = threading.RLock()
+        self._step_lock = locksan.rlock("autopilot.step")
         self._executor_ref: weakref.ReferenceType | None = None
         self._service_ref: weakref.ReferenceType | None = None
         # Process-local delta baselines (the HealthReporter discipline): a

@@ -63,7 +63,7 @@ import uuid
 from collections import deque
 from typing import Any, Callable, Iterable, Mapping
 
-from optuna_tpu import telemetry
+from optuna_tpu import locksan, telemetry
 
 __all__ = [
     "EVENT_KINDS",
@@ -519,7 +519,7 @@ def _jit_cache_size(fn: Any) -> int | None:
 #: guarded wrapper under "vectorized.guarded"), and the gauges must report
 #: the label's total, not whichever proxy wrote last.
 _jit_totals: dict[str, list] = {}
-_jit_totals_lock = threading.Lock()
+_jit_totals_lock = locksan.lock("flight.jit_totals")
 
 
 def _note_jit_compile(label: str, seconds: float, retrace: bool) -> None:

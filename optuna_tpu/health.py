@@ -57,7 +57,7 @@ import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
-from optuna_tpu import telemetry
+from optuna_tpu import locksan, telemetry
 from optuna_tpu.logging import get_logger, warn_once
 
 if TYPE_CHECKING:
@@ -275,7 +275,7 @@ class HealthReporter:
         self._last_publish: float | None = None
         self._max_observed_gap = 0.0
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("health.doctor")
         # The delta baseline: everything the process-global registry held
         # when this reporter attached to its study belongs to whatever ran
         # before, not to this study's fleet rates.

@@ -60,7 +60,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Sequence
 
-from optuna_tpu import telemetry
+from optuna_tpu import locksan, telemetry
 
 __all__ = [
     "BURN_CRITICAL",
@@ -427,7 +427,7 @@ class SLOEngine:
         self.specs = specs
         self.quantiles = tuple(quantiles)  # retained so reset() can rebuild
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("slo.engine")
         self._by_phase: dict[str, tuple[SLOSpec, ...]] = {}
         for spec in specs:
             self._by_phase[spec.phase] = self._by_phase.get(spec.phase, ()) + (spec,)

@@ -48,7 +48,7 @@ import time
 from bisect import bisect_right
 from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
-from optuna_tpu import flight, telemetry
+from optuna_tpu import flight, locksan, telemetry
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._retry import RetryPolicy, TransientStorageError
 
@@ -317,7 +317,7 @@ class FleetHub:
         self._liveness_ttl_s = float(liveness_ttl_s)
         self._clock = clock
         self._now = now
-        self._liveness_lock = threading.Lock()
+        self._liveness_lock = locksan.lock("fleet.liveness")
         #: study_id -> (expires_at, alive frozenset) — liveness is a storage
         #: read; cache it so the hot ask path pays one read per TTL, not one
         #: per ask.
@@ -327,7 +327,7 @@ class FleetHub:
         self._known_dead: set[str] = set()
         #: Studies whose epoch watermark this hub already adopted.
         self._adopted: set[int] = set()
-        self._adopt_lock = threading.Lock()
+        self._adopt_lock = locksan.lock("fleet.adopt")
         #: study_id -> last epoch this hub published a watermark for.
         self._published_epochs: dict[int, int] = {}
 
@@ -684,7 +684,7 @@ class _RemotePeer:
     def __init__(self, endpoint: str) -> None:
         self.endpoint = endpoint
         self._proxy: Any | None = None
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("fleet.peer")
 
     def _ensure(self) -> Any:
         with self._lock:

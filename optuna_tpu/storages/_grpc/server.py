@@ -12,7 +12,7 @@ from collections import OrderedDict
 from concurrent import futures
 from typing import TYPE_CHECKING
 
-from optuna_tpu import flight, telemetry
+from optuna_tpu import flight, locksan, telemetry
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import BaseStorage
 from optuna_tpu.storages._grpc._service import (
@@ -57,7 +57,7 @@ def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None"
     # owner to finish instead of racing it into a double-apply.
     token_cache: "OrderedDict[str, bytes]" = OrderedDict()
     token_in_flight: dict = {}  # token -> threading.Event
-    token_lock = threading.Lock()
+    token_lock = locksan.lock("server.op_token")
 
     def handle(request_bytes: bytes, context) -> bytes:
         try:

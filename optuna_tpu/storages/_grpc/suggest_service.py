@@ -72,7 +72,7 @@ import uuid
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
-from optuna_tpu import flight, telemetry
+from optuna_tpu import flight, locksan, telemetry
 from optuna_tpu.distributions import (
     BaseDistribution,
     distribution_to_json,
@@ -197,7 +197,7 @@ class ShedPolicy:
         self._findings_cached_at: float | None = None
         self._findings_critical = False
         self._findings_refreshing = False
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("suggest.shed")
 
     def _fleet_critical(self) -> bool:
         if self._findings_source is None:
@@ -311,7 +311,7 @@ class _AskCoalescer:
         self.window_s = window_s
         self.max_batch = max_batch
         self._clock = clock
-        self._cond = threading.Condition()
+        self._cond = locksan.condition("suggest.coalesce")
         self._pending: list[_PendingAsk] = []
         self._leader_active = False
         self._draining = False
@@ -434,7 +434,7 @@ class _ReadyQueue:
     def __init__(self, maxlen: int) -> None:
         self._entries: deque[_ReadyEntry] = deque(maxlen=max(1, maxlen))
         self.epoch = 0
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("suggest.ready_queue")
 
     def pop_fresh(self, max_behind: int = 0) -> _ReadyEntry | None:
         with self._lock:
@@ -508,7 +508,7 @@ class _StudyHandle:
         #: increments are fine: this is a nonzero/zero heuristic, not a
         #: counter anything aggregates.
         self.asks_since_fill = 0
-        self.lock = threading.Lock()
+        self.lock = locksan.lock("suggest.handle")
 
 
 class _TellObserverStorage(_ForwardingStorage):
@@ -595,9 +595,9 @@ class SuggestService:
         self.coalesce_window_s = coalesce_window_s
         self.max_coalesce = max(1, int(max_coalesce))
         self._handles: dict[int, _StudyHandle] = {}
-        self._handles_lock = threading.Lock()
+        self._handles_lock = locksan.lock("suggest.handles")
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = locksan.lock("suggest.inflight")
         self._token = next(_service_seq)
         self._closed = False
         self._draining = False
@@ -611,7 +611,7 @@ class SuggestService:
         # at deeper history).
         self._refill_needed: set[int] = set()
         self._refill_demand: set[int] = set()
-        self._refill_cond = threading.Condition()
+        self._refill_cond = locksan.condition("suggest.refill")
         self._refill_thread: threading.Thread | None = None
         # Register as an autopilot action target: the service.shed_earlier
         # remediation drives this hub's shed thresholds + ready-queue
@@ -921,6 +921,7 @@ class SuggestService:
                 item.params, item.dists = {}, {}
                 item.fallback = reason
                 try:
+                    # graphlint: ignore[CONC002] -- fallback path only, never the served hot path; the attr write must be ordered before the batch returns, and handle.lock is per-study so other studies keep serving
                     self._storage.set_trial_system_attr(
                         item.trial_id,
                         SAMPLER_FALLBACK_ATTR_PREFIX + "relative_batch",
@@ -1264,7 +1265,7 @@ class ThinClientSampler(BaseSampler):
         self._service_unsupported = False
         self._warn_token = next(_service_seq)
         self._pending: dict[int, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("suggest.thin_client")
         #: Recent responses' source/shed tags (bounded) — test/bench
         #: visibility into how this client's asks were served.
         self.served_sources: deque[str] = deque(maxlen=1024)

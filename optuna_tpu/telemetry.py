@@ -47,6 +47,8 @@ import threading
 import time
 from typing import Callable, Iterator, Mapping
 
+from optuna_tpu import locksan
+
 __all__ = [
     "BUCKET_BOUNDS",
     "COUNTERS",
@@ -118,6 +120,7 @@ COUNTERS: dict[str, str] = {
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
     "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
+    "locksan.verdict": "(suffixed by kind) the lock sanitizer reported a potential deadlock cycle or a blocking window under held locks",
 }
 
 _PHASE_METRIC_PREFIX = "phase."
@@ -258,7 +261,7 @@ class MetricsRegistry:
 
     def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksan.lock("telemetry.registry")
         self._counters: dict[str, int] = {}
         self._gauges: dict[str, float] = {}
         self._histograms: dict[str, _Histogram] = {}
@@ -416,6 +419,7 @@ _LABELED_COUNTER_FAMILIES: dict[str, str] = {
     "serve.shed": "policy",
     "serve.ready_queue": "event",
     "serve.fleet": "event",
+    "locksan.verdict": "kind",
 }
 _LABELED_GAUGE_FAMILIES: dict[str, str] = {
     "jit.compiles": "label",

@@ -1,0 +1,3 @@
+# graphlint fixture: CONC004 negative — accepted names equal the canonical
+# registry exactly.
+LOCK_NAMES = frozenset({"alpha.lock", "beta.cond"})

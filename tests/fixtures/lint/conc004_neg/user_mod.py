@@ -1,0 +1,14 @@
+# graphlint fixture: CONC004 negative — every construction site uses a
+# registered name; dynamic names are out of static scope (the runtime
+# sanitizer rejects them at construction instead).
+from optuna_tpu import locksan
+
+
+def make(name):
+    return locksan.rlock(name)  # non-constant: runtime's job
+
+
+class Thing:
+    def __init__(self):
+        self._lock = locksan.lock("alpha.lock")
+        self._cond = locksan.condition("beta.cond")

@@ -1,0 +1,3 @@
+# graphlint fixture: CONC004 positive — the sanitizer's accepted-name set
+# drifted from the canonical registry (one name missing, one unregistered).
+LOCK_NAMES = frozenset({"alpha.lock", "gamma.rogue"})  # EXPECT: CONC004

@@ -1,0 +1,9 @@
+# graphlint fixture: CONC004 positive — a construction site minting a
+# sanitized lock under a name the canonical registry never blessed.
+from optuna_tpu import locksan
+
+
+class Thing:
+    def __init__(self):
+        self._lock = locksan.lock("alpha.lock")
+        self._cond = locksan.condition("rogue.name")  # EXPECT: CONC004

@@ -35,3 +35,44 @@ def register_callback(callbacks):
                 pass
 
         callbacks.append(cb)
+
+
+class _Channel:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+# "cv" only counts as a whole underscore-separated token: `recv` is a
+# socket-shaped name, not a condition variable. If it were mislabelled a
+# lock, these two orders would fabricate a cycle.
+recv = _Channel()
+
+
+def recv_one_way():
+    with lock_a:
+        with recv:
+            pass
+
+
+def recv_other_way():
+    with recv:
+        with lock_a:
+            pass
+
+
+cond_state = threading.Condition()
+
+
+def cond_consistent_one():
+    with lock_a:
+        with cond_state:
+            pass
+
+
+def cond_consistent_two():
+    with lock_a:
+        with cond_state:
+            pass

@@ -16,11 +16,12 @@ from __future__ import annotations
 
 import threading
 import time
+import types
 
 import pytest
 
 import optuna_tpu
-from optuna_tpu import flight, health, telemetry
+from optuna_tpu import flight, health, locksan, telemetry
 from optuna_tpu.samplers import TPESampler
 from optuna_tpu.storages import InMemoryStorage
 from optuna_tpu.storages._grpc import _service as wire
@@ -40,7 +41,22 @@ from optuna_tpu.trial._state import TrialState
 
 
 @pytest.fixture(autouse=True)
-def _isolated_observability():
+def _lock_sanitizer():
+    """Every fleet chaos scenario runs under the armed lock sanitizer: the
+    hubs, routers, peers, and services below construct their named locks
+    while armed, so a lock-order inversion or a blocking window provoked by
+    a hub death becomes a verdict — and ZERO verdicts is part of the chaos
+    acceptance."""
+    locksan.enable()
+    yield
+    verdicts = locksan.report()["verdicts"]
+    locksan.disable()
+    locksan.reset()
+    assert verdicts == [], verdicts
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability(_lock_sanitizer):
     saved_registry = telemetry.get_registry()
     saved_enabled = telemetry.enabled()
     telemetry.enable(telemetry.MetricsRegistry())
@@ -634,3 +650,118 @@ def test_real_socket_fleet_smoke():
             hub.close()
         for server in servers:
             server.stop(0)
+
+
+# ------------------------------------------------- liveness-cache stress
+
+
+def test_liveness_cache_thread_stress_no_torn_reads():
+    """N threads route through one hub's cached liveness view while a chaos
+    thread kills and heals peers underneath (stale vs fresh ``-serve``
+    snapshots): every observed view is a consistent frozenset over the ring
+    (never torn), the never-killed hub is alive in every view, and routing
+    through any view lands on a ring member. Runs under the armed lock
+    sanitizer (autouse fixture) — zero verdicts is part of the assertion."""
+    import random
+
+    from optuna_tpu.storages._grpc.fleet import FleetHub, FleetRouter
+
+    storage = InMemoryStorage()
+    study_id = storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    names = ("h0", "h1", "h2", "h3")
+    router = FleetRouter(names)
+    service = types.SimpleNamespace(_health_worker_id="h0-serve")
+    hub = FleetHub("h0", service, router, storage, liveness_ttl_s=0.005)
+
+    def mark(name: str, alive: bool) -> None:
+        storage.set_study_system_attr(
+            study_id,
+            health.WORKER_ATTR_PREFIX + name + health.HUB_WORKER_ID_SUFFIX,
+            {
+                "last_seen_unix": time.time() - (60.0 if not alive else 0.0),
+                "interval_s": 10.0,
+                "final": False,
+            },
+        )
+
+    for name in names:
+        mark(name, alive=True)
+
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def chaos():
+        rng = random.Random(7)
+        while not stop.is_set():
+            victim = rng.choice(names[1:])  # h0 is never killed
+            mark(victim, alive=rng.random() < 0.5)
+            time.sleep(0.001)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                view = hub.alive_hubs(study_id)
+                if not isinstance(view, frozenset):
+                    failures.append(f"torn read: {type(view).__name__}")
+                    return
+                if not view <= set(names):
+                    failures.append(f"view off the ring: {sorted(view)}")
+                    return
+                if "h0" not in view:
+                    failures.append("never-killed hub declared dead")
+                    return
+                target = router.route(study_id, alive=view)
+                if target not in names:
+                    failures.append(f"routed off the ring: {target}")
+                    return
+        except Exception as err:  # noqa: BLE001 - surfaced via failures
+            failures.append(repr(err))
+
+    chaos_thread = threading.Thread(target=chaos)
+    readers = [threading.Thread(target=reader) for _ in range(8)]
+    chaos_thread.start()
+    for t in readers:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    chaos_thread.join()
+    for t in readers:
+        t.join()
+    assert not failures, failures
+
+
+def test_liveness_verdict_is_monotone_within_one_ttl_window():
+    """Within one TTL window the cached view is immutable: a heal written
+    right after a death verdict does not flicker the view mid-window; the
+    next window sees it. (The controllable-clock twin of the stress test.)"""
+    from optuna_tpu.storages._grpc.fleet import FleetHub, FleetRouter
+
+    storage = InMemoryStorage()
+    study_id = storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    names = ("h0", "h1")
+    router = FleetRouter(names)
+    service = types.SimpleNamespace(_health_worker_id="h0-serve")
+    tick = [0.0]
+    hub = FleetHub(
+        "h0", service, router, storage, liveness_ttl_s=1.0, clock=lambda: tick[0]
+    )
+
+    def mark(name: str, alive: bool) -> None:
+        storage.set_study_system_attr(
+            study_id,
+            health.WORKER_ATTR_PREFIX + name + health.HUB_WORKER_ID_SUFFIX,
+            {
+                "last_seen_unix": time.time() - (60.0 if not alive else 0.0),
+                "interval_s": 10.0,
+                "final": False,
+            },
+        )
+
+    mark("h0", alive=True)
+    mark("h1", alive=False)
+    assert hub.alive_hubs(study_id) == frozenset({"h0"})
+    mark("h1", alive=True)  # heals immediately...
+    for _ in range(3):  # ...but the verdict holds for the whole window
+        assert hub.alive_hubs(study_id) == frozenset({"h0"})
+    tick[0] = 2.0  # past the TTL: the next read sees the heal
+    assert hub.alive_hubs(study_id) == frozenset({"h0", "h1"})

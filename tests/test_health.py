@@ -794,8 +794,10 @@ def test_trajectory_cli_renders_the_committed_ledger(capsys):
 def test_concurrent_scrapes_while_a_faulted_study_runs():
     """Hammer /metrics, /metrics.json, /trace.json and /health.json from
     threads while a faulted vectorized study runs: every response parses,
-    no torn renders, no handler exceptions, the registry lock holds."""
+    no torn renders, no handler exceptions, the registry lock holds — and
+    the armed lock sanitizer sees zero lock-order or blocking verdicts."""
     from optuna_tpu import flight
+    from optuna_tpu import locksan
     from optuna_tpu.parallel import optimize_vectorized
     from optuna_tpu.samplers._resilience import GuardedSampler
     from optuna_tpu.testing.fault_injection import (
@@ -803,6 +805,10 @@ def test_concurrent_scrapes_while_a_faulted_study_runs():
         FaultyVectorizedObjective,
     )
 
+    locksan.enable()
+    # Rebuild the registry under the armed sanitizer so its lock is
+    # instrumented; the autouse fixture restores the saved registry after.
+    telemetry.enable(telemetry.MetricsRegistry())
     saved_flight = flight.enabled()
     flight.enable(flight.FlightRecorder())
     health.enable(interval_s=0.0)
@@ -856,7 +862,11 @@ def test_concurrent_scrapes_while_a_faulted_study_runs():
         server.shutdown()
         if not saved_flight:
             flight.disable()
+        verdicts = locksan.report()["verdicts"]
+        locksan.disable()
+        locksan.reset()
     assert errors == []
+    assert verdicts == [], verdicts
     # The faulted study's signals all made it through the scrape window's
     # surfaces: the final snapshot carries them.
     snap = telemetry.snapshot()

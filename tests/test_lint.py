@@ -27,7 +27,7 @@ PKG = os.path.join(REPO_ROOT, "optuna_tpu")
 PYPROJECT = os.path.join(REPO_ROOT, "pyproject.toml")
 FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
 
-_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z]{2,3}\d{3})")
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z]{2,4}\d{3})")
 
 
 def expected_markers(*paths: str) -> set[tuple[str, str, int]]:
@@ -641,6 +641,17 @@ RULE_CASES = [
         "smp002",
         lambda name: Config(base_dir=REPO_ROOT, smp002_paths=(f"fixtures/lint/{name}",)),
     ),
+    ("conc001", lambda name: Config(base_dir=REPO_ROOT, conc001_paths=("fixtures/lint/",))),
+    ("conc002", lambda name: Config(base_dir=REPO_ROOT, conc002_paths=("fixtures/lint/",))),
+    (
+        "conc003",
+        lambda name: Config(
+            base_dir=REPO_ROOT,
+            conc003_entrypoints=(
+                (f"fixtures/lint/{name}", "Worker._run", "fixture beat thread"),
+            ),
+        ),
+    ),
 ]
 
 
@@ -692,6 +703,148 @@ def test_sto001_fixture_drift_detected():
 def test_sto001_fixture_in_sync_is_silent():
     tree = os.path.join(FIXTURES, "sto001_neg")
     result = run_lint([tree], _sto001_config("sto001_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
+# ------------------------------------------------------ CONC rule family
+
+
+def test_lock_label_recognizes_condition_spellings():
+    """The satellite regression: Condition attrs (`_cond`, `cond_state`,
+    `_cv`) are locks to the order analysis; `recv`-shaped names are not."""
+    import ast
+
+    from optuna_tpu._lint.rules_storage import _lock_label
+
+    def label(src: str, class_name: str = "C"):
+        return _lock_label(ast.parse(src, mode="eval").body, class_name, "mod")
+
+    assert label("self._lock") == "C._lock"
+    assert label("self._cond") == "C._cond"
+    assert label("self._cv") == "C._cv"
+    assert label("state_cond", class_name="") == "mod.state_cond"
+    assert label("self.recv") is None
+    assert label("recv_queue", class_name="") is None
+    assert label("self._results") is None
+
+
+def test_conc001_cycle_across_modules():
+    """Each module alone is acyclic; only the package-wide merged graph
+    (same class name -> same lock labels) closes the cycle."""
+    tree = os.path.join(FIXTURES, "conc001_tree")
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    result = run_lint(
+        [tree], Config(base_dir=REPO_ROOT, conc001_paths=("fixtures/lint/conc001_tree",))
+    )
+    assert found_triples(result) == expected_markers(*members)
+    for member in members:
+        alone = run_lint(
+            [member], Config(base_dir=REPO_ROOT, conc001_paths=("fixtures/lint/",))
+        )
+        assert not alone.findings, [f.format() for f in alone.findings]
+
+
+def test_conc001_subsumes_sto002_on_the_real_storages_tree():
+    """CONC001 over just the storages subtree must agree with STO002's
+    verdict there (the seed tree is clean): the superset analysis cannot
+    invent cycles the lexical one disproved."""
+    result = run_lint(
+        [os.path.join(PKG, "storages")],
+        Config(base_dir=REPO_ROOT, enable=("CONC001",)),
+    )
+    assert not result.findings, [f.format() for f in result.findings]
+
+
+def test_conc003_missing_entrypoint_is_reported_as_drift():
+    """A registered thread entrypoint the code no longer has is itself a
+    finding — the registry can't silently rot."""
+    config = Config(
+        base_dir=REPO_ROOT,
+        conc003_entrypoints=(
+            ("fixtures/lint/conc003_neg.py", "Worker._gone", "stale registration"),
+        ),
+    )
+    result = run_lint([fixture("conc003_neg.py")], config)
+    assert [f.rule for f in result.findings] == ["CONC003"]
+    assert "not found" in result.findings[0].message
+
+
+def test_conc003_registered_entrypoints_exist_at_runtime():
+    """The canonical entrypoint registrations point at real methods."""
+    from optuna_tpu.storages._grpc.suggest_service import SuggestService
+    from optuna_tpu.storages._heartbeat import HeartbeatThread
+
+    runtime = {
+        "HeartbeatThread._record_periodically": HeartbeatThread._record_periodically,
+        "SuggestService._refill_loop": SuggestService._refill_loop,
+    }
+    for _, qualname, _ in lint_registry.CONC003_THREAD_ENTRYPOINTS:
+        assert callable(runtime[qualname])
+
+
+def test_conc004_registry_matches_runtime_sets():
+    """`locksan.LOCK_NAMES` (what the runtime sanitizer accepts) equals the
+    canonical LOCKSAN_REGISTRY (the lint compares them statically)."""
+    from optuna_tpu import locksan
+
+    assert locksan.LOCK_NAMES == frozenset(lint_registry.LOCKSAN_REGISTRY)
+
+
+def test_conc004_gate_rejects_drift():
+    """Point CONC004 at the real sanitizer with a registry naming a lock the
+    code does not know: the accepted-name set must be reported as drifted."""
+    fat_registry = dict(lint_registry.LOCKSAN_REGISTRY)
+    fat_registry["ghost.lock"] = "made-up lock to prove the check is live"
+    config = Config(conc004_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint([os.path.join(PKG, "locksan.py")], config)
+    drifted = [f for f in result.findings if f.rule == "CONC004"]
+    assert len(drifted) == 1, [f.format() for f in result.findings]
+    assert "ghost.lock" in drifted[0].message
+
+
+def test_conc004_flags_real_call_site_outside_vocabulary():
+    """Drop a name from the registry and scan a module that constructs that
+    lock: the construction site itself must be flagged (the call-site half
+    of the rule is live against the real tree)."""
+    thin_registry = dict(lint_registry.LOCKSAN_REGISTRY)
+    del thin_registry["telemetry.registry"]
+    config = Config(conc004_registry=thin_registry, base_dir=REPO_ROOT)
+    result = run_lint([os.path.join(PKG, "telemetry.py")], config)
+    flagged = [f for f in result.findings if f.rule == "CONC004"]
+    assert len(flagged) == 1, [f.format() for f in result.findings]
+    assert "telemetry.registry" in flagged[0].message
+
+
+_CONC004_FIXTURE_REGISTRY = {
+    "alpha.lock": "guards alpha state",
+    "beta.cond": "guards beta waiters",
+}
+
+
+def _conc004_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        conc004_registry=_CONC004_FIXTURE_REGISTRY,
+        conc004_targets=(
+            (f"fixtures/lint/{tree}/locksan_mod.py", "LOCK_NAMES", "fixture vocabulary"),
+        ),
+    )
+
+
+def test_conc004_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "conc004_pos")
+    result = run_lint([tree], _conc004_config("conc004_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    messages = " | ".join(f.message for f in result.findings)
+    assert "beta.cond" in messages  # missing from the accepted set
+    assert "gamma.rogue" in messages  # accepted but never registered
+    assert "rogue.name" in messages  # constructed outside the vocabulary
+
+
+def test_conc004_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "conc004_neg")
+    result = run_lint([tree], _conc004_config("conc004_neg"))
     assert not result.findings, [f.format() for f in result.findings]
 
 
@@ -783,6 +936,86 @@ def test_cli_json_format_and_exit_codes(capsys):
     payload = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert payload["findings"] == []
+
+
+def test_cli_github_format_emits_error_annotations(capsys):
+    from optuna_tpu._lint.cli import main
+
+    rc = main([fixture("tpu004_pos.py"), "--no-config", "--format=github"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 1
+    assert len(out) == 2
+    for line in out:
+        assert line.startswith("::error file=")
+        assert "tpu004_pos.py" in line
+        assert re.search(r",line=\d+,col=\d+,", line)
+        assert "::TPU004 " in line
+
+    rc = main([fixture("tpu004_neg.py"), "--no-config", "--format=github"])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+# --------------------------------------------------------------- parse cache
+
+
+def test_engine_parses_each_file_once_and_reuses_across_scans(monkeypatch):
+    """One scan = one parse per file; a rescan of an unchanged tree = zero
+    parses (the shared-AST cache), and the warm scan is measurably faster."""
+    import ast
+    import time
+
+    from optuna_tpu._lint import engine
+
+    real_parse = ast.parse
+    parse_calls = []
+
+    def counting_parse(*args, **kwargs):
+        parse_calls.append(args[1] if len(args) > 1 else kwargs.get("filename"))
+        return real_parse(*args, **kwargs)
+
+    monkeypatch.setattr(engine.ast, "parse", counting_parse)
+    config = Config(base_dir=REPO_ROOT, enable=("TPU004",))
+    engine.clear_parse_cache()
+    try:
+        t0 = time.perf_counter()
+        cold = run_lint([FIXTURES], config)
+        t_cold = time.perf_counter() - t0
+        cold_parses = len(parse_calls)
+        # Parsed once per scanned file (broken_syntax.py fails mid-parse and
+        # is not cached, so it may be attempted but never double-parsed in
+        # one scan).
+        assert cold_parses >= cold.files_scanned
+        assert len(set(parse_calls)) == cold_parses
+
+        parse_calls.clear()
+        t0 = time.perf_counter()
+        warm = run_lint([FIXTURES], config)
+        t_warm = time.perf_counter() - t0
+        # The unparsable file is re-attempted; every parsable file is served
+        # from the cache.
+        assert len(parse_calls) <= 1
+        assert t_warm < t_cold
+        assert found_triples(warm) == found_triples(cold)
+        assert warm.files_scanned == cold.files_scanned
+    finally:
+        engine.clear_parse_cache()
+
+
+def test_engine_cache_invalidates_when_a_file_changes(tmp_path):
+    from optuna_tpu._lint import engine
+
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    config = Config(base_dir=str(tmp_path))
+    engine.clear_parse_cache()
+    try:
+        assert not run_lint([str(mod)], config).findings
+        mod.write_text("x = ((\n")  # now syntactically broken: must re-parse
+        result = run_lint([str(mod)], config)
+        assert [f.rule for f in result.findings] == ["LNT000"]
+    finally:
+        engine.clear_parse_cache()
 
 
 def test_module_entrypoint_runs_clean_on_package():

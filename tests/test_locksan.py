@@ -1,0 +1,358 @@
+"""Runtime lock-order sanitizer (`optuna_tpu.locksan`): TSan-lite for the
+package's named locks.
+
+Covered here: the off-by-default / zero-allocation-disabled contract, the
+potential-deadlock (lock-order cycle) and held-across-blocking verdicts,
+verdict dedupe and report shape, RLock reentrancy, the telemetry counter +
+flight postmortem surfaces, and the canonical-name gate. The chaos suites
+(test_serve_chaos / test_fleet_chaos / test_telemetry_chaos) run their whole
+scenario matrix under an armed sanitizer and assert zero verdicts — this
+file proves the sanitizer itself works, those prove the tree is clean.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from optuna_tpu import flight, locksan, telemetry
+from optuna_tpu._lint import registry as lint_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer():
+    """Every test starts disarmed with an empty graph and leaves it that way."""
+    locksan.disable()
+    locksan.reset()
+    yield
+    locksan.disable()
+    locksan.reset()
+
+
+def _armed():
+    locksan.enable()
+    return (
+        locksan.lock("suggest.shed"),
+        locksan.lock("suggest.handles"),
+    )
+
+
+def _in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+# ------------------------------------------------------------ vocabulary
+
+
+def test_lock_names_match_canonical_registry():
+    """`locksan.LOCK_NAMES` and `LOCKSAN_REGISTRY` are the same vocabulary
+    (rule CONC004 enforces this statically; this is the live twin)."""
+    assert locksan.LOCK_NAMES == frozenset(lint_registry.LOCKSAN_REGISTRY)
+
+
+def test_unregistered_name_is_rejected_at_construction():
+    locksan.enable()
+    with pytest.raises(ValueError, match="CONC004"):
+        locksan.lock("suggest.unregistered")
+    with pytest.raises(ValueError, match="canonical vocabulary"):
+        locksan.condition("not.a.lock")
+
+
+# -------------------------------------------------------- disabled contract
+
+
+def test_disabled_factories_return_bare_threading_primitives():
+    """Off (the default): no wrappers at all — the hot path pays nothing."""
+    assert isinstance(locksan.lock("suggest.shed"), type(threading.Lock()))
+    assert isinstance(locksan.rlock("autopilot.step"), type(threading.RLock()))
+    assert type(locksan.condition("suggest.refill")) is threading.Condition
+    # Unregistered names are not even validated while disabled: validation
+    # lives behind the arm switch so the disabled path is branch + construct.
+    assert isinstance(locksan.lock("anything.goes"), type(threading.Lock()))
+
+
+def test_disabled_blocking_is_a_shared_singleton():
+    assert locksan.blocking("storage.read") is locksan.blocking("rpc.dispatch")
+
+
+def test_disabled_acquire_path_allocates_nothing():
+    """The acceptance bound: 10k acquire/release + blocking-window rounds on
+    a disabled-mode lock must not grow the heap (bounded constant, not
+    O(acquires)) — same discipline as telemetry's disabled span."""
+    lk = locksan.lock("suggest.shed")
+
+    def hot():
+        with lk:
+            pass
+        with locksan.blocking("storage.read"):
+            pass
+
+    for _ in range(200):  # warm free lists / caches
+        hot()
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(10_000):
+        hot()
+    gc.collect()
+    after = sys.getallocatedblocks()
+    assert after - before < 500
+
+
+def test_arming_never_retrofits_existing_bare_locks():
+    bare = locksan.lock("suggest.shed")
+    locksan.enable()
+    with bare:  # still a plain threading.Lock — no tracking, no verdicts
+        with locksan.blocking("storage.read"):
+            pass
+    assert locksan.verdicts() == []
+
+
+# ------------------------------------------------------- lock-order cycles
+
+
+def test_opposite_acquisition_orders_yield_a_cycle_verdict():
+    a, b = _armed()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    _in_thread(order_ab)
+    with b:
+        with a:  # the b -> a edge closes the a -> b cycle
+            pass
+    (verdict,) = locksan.verdicts("lock_order_cycle")
+    assert verdict["lock"] == "suggest.shed"
+    assert verdict["cycle"] == ["suggest.shed", "suggest.handles", "suggest.shed"]
+    assert verdict["thread"] == threading.current_thread().name
+
+
+def test_cycle_is_reported_before_the_acquire_can_deadlock():
+    """The check runs at acquire *intent* (before blocking on the inner
+    primitive), so the inverted order is reported even when this thread
+    would then park forever. Sequential here: thread one teaches a -> b,
+    then b -> a trips the verdict while nothing actually contends."""
+    a, b = _armed()
+    _in_thread(lambda: (a.acquire(), b.acquire(), b.release(), a.release()))
+    b.acquire()
+    assert locksan.verdicts("lock_order_cycle") == []
+    a.acquire()  # verdict lands here, acquisition still succeeds
+    assert len(locksan.verdicts("lock_order_cycle")) == 1
+    a.release()
+    b.release()
+
+
+def test_same_cycle_is_deduplicated():
+    a, b = _armed()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    _in_thread(order_ab)
+    for _ in range(3):
+        with b:
+            with a:
+                pass
+    assert len(locksan.verdicts("lock_order_cycle")) == 1
+
+
+def test_consistent_global_order_is_clean():
+    a, b = _armed()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    _in_thread(order_ab)
+    with a:
+        with b:
+            pass
+    assert locksan.verdicts() == []
+
+
+def test_rlock_reentrancy_is_not_an_order_edge():
+    locksan.enable()
+    r = locksan.rlock("autopilot.step")
+    inner = locksan.lock("health.doctor")
+    with r:
+        with r:  # reentrant: no self-edge, no verdict
+            with inner:
+                pass
+    with r:  # stack unwound correctly: r held once, not leaked twice
+        pass
+    assert locksan.verdicts() == []
+    assert locksan.report()["edges"] == {"autopilot.step": ["health.doctor"]}
+
+
+# --------------------------------------------------- held-across-blocking
+
+
+def test_blocking_window_under_a_held_lock_is_a_verdict():
+    a, _ = _armed()
+    with a:
+        with locksan.blocking("storage.read"):
+            pass
+    (verdict,) = locksan.verdicts("held_across_blocking")
+    assert verdict["operation"] == "storage.read"
+    assert verdict["held"] == ["suggest.shed"]
+
+
+def test_blocking_window_with_nothing_held_is_clean():
+    _armed()
+    with locksan.blocking("storage.read"):
+        pass
+    assert locksan.verdicts() == []
+
+
+def test_condition_wait_releases_only_its_own_lock():
+    """`cond.wait()` while a *foreign* sanitized lock stays held is a
+    verdict; waiting holding only the condition's own lock is the normal
+    pattern and stays clean."""
+    locksan.enable()
+    shed = locksan.lock("suggest.shed")
+    cond = locksan.condition("suggest.refill")
+    with cond:
+        cond.wait(timeout=0.001)
+    assert locksan.verdicts() == []
+    with shed:
+        with cond:
+            cond.wait(timeout=0.001)
+    (verdict,) = locksan.verdicts("held_across_blocking")
+    assert verdict["operation"] == "suggest.refill.wait"
+    assert verdict["held"] == ["suggest.shed"]
+
+
+def test_blocking_verdicts_dedupe_by_operation_and_held_set():
+    a, b = _armed()
+    for _ in range(3):
+        with a:
+            with locksan.blocking("storage.read"):
+                pass
+    with a:
+        with locksan.blocking("rpc.dispatch"):  # different op: new verdict
+            pass
+    with b:
+        with locksan.blocking("storage.read"):  # different held set: new one
+            pass
+    assert len(locksan.verdicts("held_across_blocking")) == 3
+
+
+# ----------------------------------------------------- report + telemetry
+
+
+def test_report_is_json_able_and_carries_graph_plus_verdicts():
+    a, b = _armed()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    _in_thread(order_ab)
+    with b:
+        with a:
+            pass
+    rep = json.loads(json.dumps(locksan.report()))
+    assert rep["enabled"] is True
+    assert rep["edges"]["suggest.shed"] == ["suggest.handles"]
+    assert rep["edges"]["suggest.handles"] == ["suggest.shed"]
+    kinds = [v["kind"] for v in rep["verdicts"]]
+    assert kinds == ["lock_order_cycle"]
+
+
+def test_verdicts_increment_the_labeled_telemetry_counter():
+    saved_registry, saved_enabled = telemetry.get_registry(), telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    try:
+        a, _ = _armed()
+        with a:
+            with locksan.blocking("storage.read"):
+                pass
+        assert (
+            telemetry.get_registry().counter_value(
+                "locksan.verdict.held_across_blocking"
+            )
+            == 1
+        )
+    finally:
+        telemetry.enable(saved_registry)
+        if not saved_enabled:
+            telemetry.disable()
+
+
+def test_verdict_dumps_a_flight_postmortem(tmp_path, monkeypatch):
+    monkeypatch.setenv("OPTUNA_TPU_FLIGHT_DUMP_DIR", str(tmp_path))
+    was_enabled = flight.enabled()
+    flight.enable(recorder=flight.FlightRecorder(capacity=64))
+    try:
+        a, _ = _armed()
+        with a:
+            with locksan.blocking("storage.read"):
+                pass
+        dumps = list(tmp_path.glob("optuna-tpu-flight-*.json"))
+        assert len(dumps) == 1
+        payload = json.loads(dumps[0].read_text())
+        assert payload["reason"] == "locksan.held_across_blocking"
+    finally:
+        flight.disable()
+        if was_enabled:
+            flight.enable()
+
+
+def test_verdict_reporting_does_not_recurse_into_the_sanitized_registry_lock():
+    """telemetry's registry lock is itself a sanitized lock; counting a
+    verdict acquires it. The reporting guard must keep that acquisition out
+    of the analysis or every verdict would spawn phantom edges/verdicts."""
+    saved_registry, saved_enabled = telemetry.get_registry(), telemetry.enabled()
+    locksan.enable()
+    telemetry.enable(telemetry.MetricsRegistry())  # registry lock is sanitized
+    try:
+        a = locksan.lock("suggest.shed")
+        with a:
+            with locksan.blocking("storage.read"):
+                pass
+        rep = locksan.report()
+        assert [v["kind"] for v in rep["verdicts"]] == ["held_across_blocking"]
+        assert "telemetry.registry" not in rep["edges"].get("suggest.shed", [])
+    finally:
+        telemetry.enable(saved_registry)
+        if not saved_enabled:
+            telemetry.disable()
+
+
+def test_verdict_list_is_bounded():
+    locksan.enable()
+    a = locksan.lock("suggest.shed")
+    for i in range(locksan._MAX_VERDICTS + 50):
+        with a:
+            with locksan.blocking(f"op.{i}"):  # distinct op: no dedupe
+                pass
+    assert len(locksan.verdicts()) == locksan._MAX_VERDICTS
+
+
+def test_enable_resets_and_env_switch_matches_module_state():
+    a, _ = _armed()
+    with a:
+        with locksan.blocking("storage.read"):
+            pass
+    assert locksan.verdicts()
+    locksan.enable()  # re-arming is a fresh session
+    assert locksan.verdicts() == []
+    assert locksan.enabled() is True
+    locksan.disable()
+    assert locksan.enabled() is False
+    # The env switch is what production uses; this process was started
+    # without it, so the module must have come up disarmed.
+    if not os.environ.get("OPTUNA_TPU_LOCKSAN"):
+        assert not locksan.enabled()

@@ -19,7 +19,7 @@ import types
 import pytest
 
 import optuna_tpu
-from optuna_tpu import health, telemetry
+from optuna_tpu import health, locksan, telemetry
 from optuna_tpu.samplers import TPESampler
 from optuna_tpu.storages import InMemoryStorage
 from optuna_tpu.storages._grpc import _service as wire
@@ -39,7 +39,22 @@ from optuna_tpu.trial._state import TrialState
 
 
 @pytest.fixture(autouse=True)
-def _isolated_observability():
+def _lock_sanitizer():
+    """Every chaos scenario runs under the armed lock sanitizer: the service
+    stack's named locks (shed policy, coalescer, ready queue, handles,
+    refill, telemetry registry, ...) are constructed while armed, so any
+    lock-order inversion or blocking window the scenario provokes becomes a
+    verdict — and ZERO verdicts is part of the chaos acceptance."""
+    locksan.enable()
+    yield
+    verdicts = locksan.report()["verdicts"]
+    locksan.disable()
+    locksan.reset()
+    assert verdicts == [], verdicts
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability(_lock_sanitizer):
     saved_registry = telemetry.get_registry()
     saved_enabled = telemetry.enabled()
     telemetry.enable(telemetry.MetricsRegistry())

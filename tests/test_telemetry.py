@@ -142,43 +142,55 @@ def test_concurrent_mutation_loses_no_updates():
     and the main loop increment counters/gauges concurrently — N threads
     hammering every write API under the registry lock must lose zero
     updates and raise nothing. Covers the read-modify-write gauges
-    (add_gauge/max_gauge) the device-stats harvest leans on."""
+    (add_gauge/max_gauge) the device-stats harvest leans on. Runs under the
+    armed lock sanitizer: the registry lock is constructed sanitized, and
+    zero verdicts across the hammer is part of the assertion."""
     import threading
 
-    registry = telemetry.get_registry()
-    n_threads, n_iters = 8, 500
-    errors: list[BaseException] = []
-    start = threading.Barrier(n_threads)
+    from optuna_tpu import locksan
 
-    def hammer(worker: int) -> None:
-        try:
-            start.wait()
-            for i in range(n_iters):
-                telemetry.count("storage.retry")
-                telemetry.count("heartbeat.reap", 2)
-                telemetry.add_gauge("device.executor.quarantined.total", 1)
-                telemetry.max_gauge("device.gp.ladder_rung.max", worker)
-                telemetry.set_gauge("hbm.live_bytes", float(i))
-                telemetry.observe("phase.tell", 0.001)
-        except BaseException as err:  # pragma: no cover - the assertion below
-            errors.append(err)
+    locksan.enable()
+    try:
+        telemetry.enable(telemetry.MetricsRegistry())  # built while armed
+        registry = telemetry.get_registry()
+        n_threads, n_iters = 8, 500
+        errors: list[BaseException] = []
+        start = threading.Barrier(n_threads)
 
-    threads = [
-        threading.Thread(target=hammer, args=(w,), name=f"stress-{w}")
-        for w in range(n_threads)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    assert errors == []
-    total = n_threads * n_iters
-    assert registry.counter_value("storage.retry") == total
-    assert registry.counter_value("heartbeat.reap") == 2 * total
-    snap = registry.snapshot()
-    assert snap["gauges"]["device.executor.quarantined.total"] == total
-    assert snap["gauges"]["device.gp.ladder_rung.max"] == n_threads - 1
-    assert snap["histograms"]["phase.tell"]["count"] == total
+        def hammer(worker: int) -> None:
+            try:
+                start.wait()
+                for i in range(n_iters):
+                    telemetry.count("storage.retry")
+                    telemetry.count("heartbeat.reap", 2)
+                    telemetry.add_gauge("device.executor.quarantined.total", 1)
+                    telemetry.max_gauge("device.gp.ladder_rung.max", worker)
+                    telemetry.set_gauge("hbm.live_bytes", float(i))
+                    telemetry.observe("phase.tell", 0.001)
+            except BaseException as err:  # pragma: no cover - assertion below
+                errors.append(err)
+
+        threads = [
+            threading.Thread(target=hammer, args=(w,), name=f"stress-{w}")
+            for w in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        total = n_threads * n_iters
+        assert registry.counter_value("storage.retry") == total
+        assert registry.counter_value("heartbeat.reap") == 2 * total
+        snap = registry.snapshot()
+        assert snap["gauges"]["device.executor.quarantined.total"] == total
+        assert snap["gauges"]["device.gp.ladder_rung.max"] == n_threads - 1
+        assert snap["histograms"]["phase.tell"]["count"] == total
+        verdicts = locksan.report()["verdicts"]
+    finally:
+        locksan.disable()
+        locksan.reset()
+    assert verdicts == [], verdicts
 
 
 # ------------------------------------------------------- disabled-path cost

@@ -333,6 +333,42 @@ def test_autopilot_action_counter_increments_once_per_decision():
     assert counters["autopilot.action.sampler.pin_independent"] == 1
 
 
+def test_locksan_verdict_counter_is_labeled_by_kind():
+    """The locksan.verdict family's scenario: arm the runtime lock
+    sanitizer, provoke one lock-order cycle and one held-across-blocking
+    window — each verdict kind counts exactly once under its own suffix,
+    and the dedupe keeps repeats from re-counting."""
+    from optuna_tpu import locksan
+
+    locksan.enable()
+    try:
+        shed = locksan.lock("suggest.shed")
+        handles = locksan.lock("suggest.handles")
+
+        def order_shed_then_handles():
+            with shed:
+                with handles:
+                    pass
+
+        t = threading.Thread(target=order_shed_then_handles)
+        t.start()
+        t.join()
+        for _ in range(2):  # the second lap dedupes, the counter stays 1
+            with handles:
+                with shed:
+                    pass
+            with shed:
+                with locksan.blocking("storage.read"):
+                    pass
+        counters = telemetry.snapshot()["counters"]
+        assert counters["locksan.verdict.lock_order_cycle"] == 1
+        assert counters["locksan.verdict.held_across_blocking"] == 1
+        assert _containment_counters(telemetry.snapshot()) == {"locksan.verdict": 2}
+    finally:
+        locksan.disable()
+        locksan.reset()
+
+
 def test_disabled_chaos_records_nothing():
     """Faults with telemetry disabled: containment still works, registry
     stays empty — recording is opt-in, never load-bearing."""
