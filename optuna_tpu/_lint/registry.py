@@ -212,6 +212,10 @@ DEVICE_STAT_REGISTRY: dict[str, str] = {
     "gp.fit_iterations": "L-BFGS iterations the fused kernel-param fit actually ran",
     "gp.proposal_fallback_coords": "proposal coordinates that took the per-coordinate isfinite fallback",
     "gp.best_acq": "best acquisition value the fused proposal search found",
+    "gp.inducing_count": "live inducing points backing the sparse (SGPR) posterior (absent below the exact-size threshold)",
+    "gp.sparsity_ratio": "inducing count over real history size for the last sparse fit (m/n; 1.0 would mean no compression)",
+    "gp.inducing_swaps": "inducing-set swap-ins the scan loop performed (each is one O(nm^2) SGPR rebuild; a warmed-up set stops swapping)",
+    "gp.sparse_heldout_err": "mean |predicted - observed| standardized-score error of the last sparse scan chunk, measured before ingestion (a one-step-ahead held-out residual)",
     "executor.quarantined": "trials quarantined as FAIL in one batch dispatch, from the in-graph isfinite mask (0 under non_finite='clip': nothing is quarantined)",
     "scan.rank1_updates": "scan-loop tells that took the O(n^2) incremental Cholesky row append",
     "scan.refactorizations": "scan-loop tells whose pivot check fell back to a full jitter-ladder refactorization",
@@ -254,6 +258,7 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "executor.dispatch_timeouts": "repeated dispatch-deadline strikes (each abandons a watchdog thread)",
     "jit.retrace_churn": "jit wrappers keep retracing after their first compile (runtime TPU002)",
     "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
+    "gp.sparse_degraded": "the sparse GP's one-step-ahead held-out error says the inducing set no longer covers the search",
     "worker.dead": "a worker's health snapshot went stale past its report interval",
     "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
     "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
@@ -356,6 +361,7 @@ AUTOPILOT_ACTION_REGISTRY: dict[str, str] = {
     "executor.pin_shapes": "jit.retrace_churn -> freeze the executor's batch width at the dominant compiled width",
     "executor.tighten_regrowth": "executor.quarantine_rate -> stretch the executor's probationary batch-regrowth streak",
     "service.shed_earlier": "service.slo_burn/service.backpressure -> halve the shed thresholds and widen ready-queue prewarm",
+    "gp.densify": "gp.sparse_degraded -> widen the sparse GP engine: double the inducing capacity, or fall back to the exact posterior once at cap",
 }
 
 #: The hand-maintained copies ACT001 cross-checks, as
@@ -498,6 +504,10 @@ SMP002_CHOLESKY_HELPER: str = "optuna_tpu/samplers/_resilience.py"
 #: in pyproject.toml (tests/test_lint.py asserts the two stay identical).
 DEVICE_MODULE_PATHS: tuple[str, ...] = (
     "optuna_tpu/ops/",
+    # Redundant with the ops/ subtree, listed explicitly: the Pallas kernels
+    # are the hardest-device code in the tree and must stay classified even
+    # if the ops/ umbrella is ever narrowed.
+    "optuna_tpu/ops/pallas/",
     "optuna_tpu/gp/",
     "optuna_tpu/samplers/_tpe/_kernels.py",
     "optuna_tpu/samplers/_resilience.py",

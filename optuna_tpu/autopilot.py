@@ -111,6 +111,7 @@ ACTIONS: dict[str, str] = {
     "executor.pin_shapes": "jit.retrace_churn -> freeze the executor's batch width at the dominant compiled width",
     "executor.tighten_regrowth": "executor.quarantine_rate -> stretch the executor's probationary batch-regrowth streak",
     "service.shed_earlier": "service.slo_burn/service.backpressure -> halve the shed thresholds and widen ready-queue prewarm",
+    "gp.densify": "gp.sparse_degraded -> widen the sparse GP engine: double the inducing capacity, or fall back to the exact posterior once at cap",
 }
 
 #: Which doctor findings trigger which action. Keys are exactly
@@ -122,6 +123,7 @@ ACTION_TRIGGERS: dict[str, tuple[str, ...]] = {
     "executor.pin_shapes": ("jit.retrace_churn",),
     "executor.tighten_regrowth": ("executor.quarantine_rate",),
     "service.shed_earlier": ("service.slo_burn", "service.backpressure"),
+    "gp.densify": ("gp.sparse_degraded",),
 }
 
 #: Operating modes. ``observe`` (the default) records would-have-acted
@@ -491,6 +493,10 @@ class Autopilot:
             )
         if check == "service.backpressure":
             return new.get("total", 0) <= old.get("total", 0)
+        if check == "gp.sparse_degraded":
+            return new.get("heldout_err", float("inf")) < old.get(
+                "heldout_err", 0.0
+            )
         if check == "service.slo_burn":
             old_burn = max(
                 (s.get("burn_long", 0.0) for s in old.get("slos", {}).values()),
@@ -517,6 +523,22 @@ class Autopilot:
         if action == "service.shed_earlier":
             service = self._service_ref() if self._service_ref is not None else None
             return service if service is not None else _noted_service()
+        if action == "gp.densify":
+            # Two actuator shapes, scan loop first: optimize_scan registers
+            # its live threshold dict on the study; a per-trial study instead
+            # exposes the knob through its (possibly Guarded-wrapped)
+            # sampler. Neither present -> no_target, the honest verdict.
+            control = getattr(self._study, "_scan_gp_control", None)
+            if isinstance(control, dict):
+                return control
+            sampler = self._study.sampler
+            # Probe through GuardedSampler: its delegation method always
+            # exists, but only a wrapped engine that itself has the knob
+            # can honour the call.
+            inner = getattr(sampler, "sampler", sampler)
+            return (
+                sampler if hasattr(inner, "autopilot_densify") else None
+            )
         raise AssertionError(f"unreachable: unknown action {action!r}")
 
     def _execute(self, action: str, target: Any) -> Callable[[], None]:
@@ -550,6 +572,8 @@ class Autopilot:
             return target.autopilot_tighten_regrowth(self.policy.regrowth_streak)
         if action == "service.shed_earlier":
             return _shed_earlier(target)
+        if action == "gp.densify":
+            return _densify(target)
         raise AssertionError(f"unreachable: unknown action {action!r}")
 
     def _guarded_sampler(self) -> Any:
@@ -581,12 +605,21 @@ class Autopilot:
             }
             if delta["compiles"] > 0 or delta["retraces_after_first"] > 0:
                 jit[label] = delta
+        # Device-stat gauges pass through live (not as deltas): the checks
+        # that read them (gp.sparse_degraded, gp.ladder_escalation via the
+        # fleet channel) threshold current values, and "last"/"max"
+        # aggregated gauges have no meaningful baseline subtraction.
+        gauges = {
+            name: value
+            for name, value in snap.get("gauges", {}).items()
+            if name.startswith("device.")
+        }
         return {
             "workers": [],
             "n_workers": 0,
             "n_alive": 0,
             "counters": counters,
-            "gauges": {},
+            "gauges": gauges,
             "histograms": {},
             "jit": jit,
             "slo": slo.worker_snapshot(self._baseline_slo),
@@ -674,6 +707,44 @@ def _shed_earlier(service: Any) -> Callable[[], None]:
         ) = previous
 
     return undo
+
+
+def _densify(target: Any) -> Callable[[], None]:
+    """The sparse-GP actuator (``gp.densify``): widen the engine one notch.
+
+    On a scan-loop control dict (``study._scan_gp_control``): double the
+    inducing capacity up to :data:`~optuna_tpu.gp.sparse.N_INDUCING_MAX`;
+    once at cap, raise the exact-size threshold out of reach so every later
+    chunk takes the exact posterior — the most accurate (and most
+    expensive) setting, which is why each firing moves one notch and the
+    rollback pass restores the previous thresholds if the held-out error
+    does not improve. On a sampler actuator: delegate to its
+    ``autopilot_densify`` (which applies the same ladder to its own knobs
+    and returns its own undo)."""
+    if isinstance(target, dict):
+        from optuna_tpu.gp.sparse import N_INDUCING_MAX
+
+        previous = dict(target)
+        m = int(target.get("n_inducing", N_INDUCING_MAX))
+        if m < N_INDUCING_MAX:
+            target["n_inducing"] = min(2 * m, N_INDUCING_MAX)
+        else:
+            # At capacity: the approximation itself is the problem — route
+            # back to the exact posterior (reversible, like every action).
+            target["n_exact_max"] = _DENSIFY_EXACT_LIMIT
+
+        def undo() -> None:
+            target.clear()
+            target.update(previous)
+
+        return undo
+    return target.autopilot_densify()
+
+
+#: The "effectively exact" threshold gp.densify pins when the inducing
+#: capacity is already at cap: no realistic study exceeds it, so the scan
+#: loop routes every later chunk through the exact program.
+_DENSIFY_EXACT_LIMIT = 10**9
 
 
 # ------------------------------------------------- module-level fast path

@@ -646,6 +646,12 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
             parts.append(
                 f"r1={stats['scan_rank1_updates']}/rf={stats.get('scan_refactorizations', 0)}"
             )
+        # Large-n sparse-engine entries (bench --loop=scan --trials=N)
+        # additionally condense the inducing regime: live inducing count and
+        # the sparsity ratio the window settled at.
+        if stats.get("inducing_count") is not None:
+            parts.append(f"ind={stats['inducing_count']}")
+            parts.append(f"sp={stats.get('sparsity_ratio', 0)}")
         return " ".join(parts)
 
     def _flags(entry: dict[str, Any]) -> str:

@@ -89,6 +89,10 @@ DEVICE_STATS: dict[str, str] = {
     "gp.fit_iterations": "L-BFGS iterations the fused kernel-param fit actually ran",
     "gp.proposal_fallback_coords": "proposal coordinates that took the per-coordinate isfinite fallback",
     "gp.best_acq": "best acquisition value the fused proposal search found",
+    "gp.inducing_count": "live inducing points backing the sparse (SGPR) posterior (absent below the exact-size threshold)",
+    "gp.sparsity_ratio": "inducing count over real history size for the last sparse fit (m/n; 1.0 would mean no compression)",
+    "gp.inducing_swaps": "inducing-set swap-ins the scan loop performed (each is one O(nm^2) SGPR rebuild; a warmed-up set stops swapping)",
+    "gp.sparse_heldout_err": "mean |predicted - observed| standardized-score error of the last sparse scan chunk, measured before ingestion (a one-step-ahead held-out residual)",
     "executor.quarantined": "trials quarantined as FAIL in one batch dispatch, from the in-graph isfinite mask (0 under non_finite='clip': nothing is quarantined)",
     "scan.rank1_updates": "scan-loop tells that took the O(n^2) incremental Cholesky row append",
     "scan.refactorizations": "scan-loop tells whose pivot check fell back to a full jitter-ladder refactorization",
@@ -108,6 +112,10 @@ STAT_AGGREGATIONS: dict[str, str] = {
     "gp.fit_iterations": "total",
     "gp.proposal_fallback_coords": "total",
     "gp.best_acq": "last",
+    "gp.inducing_count": "last",
+    "gp.sparsity_ratio": "last",
+    "gp.inducing_swaps": "total",
+    "gp.sparse_heldout_err": "last",
     "executor.quarantined": "total",
     "scan.rank1_updates": "total",
     "scan.refactorizations": "total",

@@ -213,6 +213,8 @@ def fit_gp(
     n_restarts: int = 4,
     seed: int = 0,
     counts: np.ndarray | None = None,
+    n_exact_max: int | None = None,
+    n_inducing: int | None = None,
 ) -> tuple[GPState, np.ndarray, dict]:
     """Fit kernel params by MAP (MLL + priors) with batched multi-start
     L-BFGS; returns the fitted state, the raw log-params for warm starts
@@ -226,8 +228,27 @@ def fit_gp(
     exact-duplicate observations (see ``samplers/_resilience.py::
     collapse_duplicate_rows``); the mask carries them so each such row's
     observation noise is divided by its count (posterior-exact at fixed
-    kernel params; the fitted MLL drops the within-group scatter term)."""
+    kernel params; the fitted MLL drops the within-group scatter term).
+
+    **Large-n switch**: above ``n_exact_max`` rows (default
+    :data:`optuna_tpu.gp.sparse.N_EXACT_MAX`) the exact O(n³) fit hands off
+    to the SGPR inducing engine (:func:`optuna_tpu.gp.sparse.fit_gp_sparse`)
+    — same return contract, the state is a reduced m-point GPState every
+    downstream consumer uses unchanged. At or below the threshold this
+    function is bit-identical to the pre-sparse engine (the branch is a
+    host-side size check, never traced)."""
     n, d = X.shape
+    from optuna_tpu.gp import sparse as _sparse
+
+    limit = _sparse.N_EXACT_MAX if n_exact_max is None else int(n_exact_max)
+    if n > limit:
+        return _sparse.fit_gp_sparse(
+            X, y, is_categorical, warm_start_raw, minimum_noise,
+            n_restarts, seed, counts,
+            n_inducing=(
+                _sparse.N_INDUCING_MAX if n_inducing is None else int(n_inducing)
+            ),
+        )
     N = _bucket(n)
     Xp = np.zeros((N, d), dtype=np.float32)
     Xp[:n] = X

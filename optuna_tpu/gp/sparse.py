@@ -1,0 +1,441 @@
+"""Large-n GP engine: SGPR inducing-point posteriors above ``N_EXACT_MAX``.
+
+**Method (documented choice).** This is the SGPR/Titsias *predictive*
+posterior: with inducing set ``Z`` (m rows), per-row noise precisions
+``w_i = count_i / (noise + jitter)`` and cross-covariance ``C = K(Z, X)``,
+
+    A = Kmm + C·diag(w)·Cᵀ          (m×m information matrix)
+    b = C·diag(w)·y                 (m, information vector)
+    μ(x*)   = k*ᵀ A⁻¹ b
+    var(x*) = k** − k*ᵀ (Kmm⁻¹ − A⁻¹) k*
+
+Hyperparameters are fit by *subset-of-inducing* MAP-MLL (the m-point MLL,
+O(m³) per L-BFGS iteration) rather than the collapsed ELBO — the ELBO's
+O(nm²)-per-iteration gradient would triple fit cost for a modest accuracy
+gain at these m, and the O(n³)·iters full-history MLL fit is exactly the
+thing this module exists to eliminate. The projection through ``A``/``b``
+then conditions on the FULL history.
+
+**The reduction trick.** The predictive above is re-expressed as an exact
+m-point :class:`~optuna_tpu.gp.gp.GPState`: ``X := Z``, ``alpha := A⁻¹b``,
+and ``L := chol(M)`` where ``M = (Kmm⁻¹ − A⁻¹)⁻¹ = A·E⁻¹·Kmm`` with
+``E = A − Kmm = C·diag(w)·Cᵀ`` (PSD since A ⪰ Kmm). ``GPState.posterior``
+then computes ``scale − k*ᵀM⁻¹k* = scale − k*ᵀ(Kmm⁻¹−A⁻¹)k*`` — the SGPR
+variance — with zero changes to any consumer: LogEI, the fused maximizer,
+`GuardedSampler` containment, and the AOT plumbing all see an ordinary
+(small) GPState. Proposes are O(m²) per point by construction.
+
+**Incremental tells** (scan loop, kriging-believer chains): adding an
+observation is ``A += w·v·vᵀ, b += w·y·v`` with ``v = k_m(x)`` — in the
+whitened factorization ``A = Lmm·B·Lmmᵀ`` (see :func:`sgpr_reduce`) an
+*additive* rank-1 Cholesky raise of ``L_B``
+(:func:`optuna_tpu.samplers._resilience.ladder_cholesky_rank1_raise`;
+``ladder_cholesky`` remains the blessed factorization per SMP002). The
+variance factor ``L`` is refreshed at chunk boundaries / chain starts, not
+per tell — within a window the variance is slightly stale (conservative:
+it under-counts the newest evidence, so exploration is mildly favored),
+which is what keeps tells O(m²) and swap-free steady states at zero full
+refactorizations.
+
+Gram/cross-covariance assembly (``Kmm``, ``C``) rides the fused Pallas
+Matérn kernel (:mod:`optuna_tpu.ops.pallas.matern`) on no-grad paths for
+all-continuous spaces; categorical spaces and grad paths take the XLA twin.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from optuna_tpu.gp.acqf import LogEIData
+from optuna_tpu.gp.gp import _JITTER, GPParams, GPState, matern52, posterior
+from optuna_tpu.gp.fused import (
+    _fit_params,
+    _maximize_logei,
+    device_candidates,
+)
+from optuna_tpu.ops.pallas.matern import matern52_gram
+
+#: History size above which the GP switches from the exact posterior to the
+#: SGPR inducing approximation. Below (and at) this threshold the code path
+#: is bit-identical to the exact engine — the switch is a host-side branch,
+#: never a traced one.
+N_EXACT_MAX = 1024
+
+#: Inducing-set capacity cap. The set is a fixed-shape (m, d) buffer so the
+#: compiled programs are size-stable; ``gp.inducing_count`` reports the
+#: filled slots.
+N_INDUCING_MAX = 256
+
+#: Greedy variance-based swap-in threshold (scan path): a new observation
+#: whose sparse posterior variance exceeds this fraction of the prior
+#: ``scale`` is poorly covered by the current inducing set and swaps in,
+#: replacing the most redundant inducing point (min nearest-neighbor
+#: distance). Well-covered steady states stop swapping — the "zero full
+#: refits after warm-up" contract the bench gates.
+SWAP_VAR_FRAC = 0.25
+
+
+def _pow2_bucket(n: int) -> int:
+    return max(16, 1 << max(0, (n - 1)).bit_length())
+
+
+def select_inducing_host(X: np.ndarray, m: int) -> np.ndarray:
+    """Deterministic farthest-point (k-center) inducing subset, host-side.
+
+    Used by the per-trial refit path where the whole history is on host
+    anyway; the scan path instead seeds from the Sobol startup block (the
+    first m history rows) and lets variance swap-ins adapt the set.
+    Returns the selected row indices (m,).
+    """
+    n = len(X)
+    m = min(m, n)
+    chosen = np.empty(m, dtype=np.int64)
+    chosen[0] = 0
+    d2 = np.sum((X - X[0]) ** 2, axis=1)
+    for i in range(1, m):
+        chosen[i] = int(np.argmax(d2))
+        d2 = np.minimum(d2, np.sum((X - X[chosen[i]]) ** 2, axis=1))
+    return chosen
+
+
+def _decoupled_gram(K: jnp.ndarray, valid: jnp.ndarray, diag_fill) -> jnp.ndarray:
+    """Zero rows/cols of invalid slots and pin their diagonal, so padded
+    inducing slots factor as decoupled identity-like rows (the `_PAD_NOISE`
+    convention of the exact engine, applied to the m×m blocks)."""
+    pair = valid[:, None] * valid[None, :]
+    K = jnp.where(pair > 0, K, 0.0)
+    diag = jnp.where(valid > 0, jnp.diagonal(K) + _JITTER, diag_fill)
+    return K - jnp.diag(jnp.diagonal(K)) + jnp.diag(diag)
+
+
+def sgpr_reduce(
+    params: GPParams,
+    Z: jnp.ndarray,  # (m, d) inducing buffer
+    zy: jnp.ndarray,  # (m,) inducing targets (standardized), informational
+    zmask: jnp.ndarray,  # (m,) 1.0 for live inducing slots
+    X: jnp.ndarray,  # (N, d) full padded history
+    y: jnp.ndarray,  # (N,) standardized targets
+    mask: jnp.ndarray,  # (N,) counts; 0 for padding
+    cat_mask: jnp.ndarray,
+    *,
+    use_pallas: bool | None = None,
+    has_categorical: bool = False,
+):
+    """Build the reduced GPState + tell factors from the full history.
+
+    O(nm²): one m×n cross-covariance, three m×m ladder factorizations and a
+    solve chain. Returns ``(state, Lmm, L_B, b, rung)`` where ``state`` is
+    the m-point reduced GPState (see module docstring), ``Lmm``/``L_B``/``b``
+    are the tell-update factors, and ``rung`` is the max jitter-ladder rung
+    of the factorizations (the ``gp.ladder_rung`` channel).
+
+    Numerically this is the *whitened* Titsias factorization:
+    ``A = Lmm·B·Lmmᵀ`` with ``B = I + G``, ``G = Ah·diag(w)·Ahᵀ``,
+    ``Ah = Lmm⁻¹C`` — the f32-viable form (conditioning splits across
+    ``Lmm`` and ``B`` instead of compounding in ``A``), and the variance
+    factor ``M = Kmm + Lmm·G⁻¹·Lmmᵀ`` is a sum of two PSD terms rather
+    than an unsymmetric triple product. ``G``'s null directions (inducing
+    directions the data never excites) are pinned with a relative epsilon:
+    there ``M`` saturates — variance approaches the prior, exactly the
+    honest answer for an unconstrained direction.
+    """
+    from optuna_tpu.samplers._resilience import ladder_cholesky_with_rung
+
+    w = jnp.where(mask > 0, mask / (params.noise + _JITTER), 0.0)  # (N,)
+    Kmm = matern52(Z, Z, params, cat_mask)
+    Kmm = _decoupled_gram(Kmm, zmask, 1.0)
+    C = matern52_gram(
+        Z, X, params.inv_sq_lengthscales, params.scale, cat_mask,
+        use_pallas=use_pallas, has_categorical=has_categorical,
+    )
+    C = C * zmask[:, None] * (mask > 0)[None, :]
+    b = (C * w[None, :]) @ y
+
+    Lmm, rung_k = ladder_cholesky_with_rung(Kmm)
+    Ah = jax.scipy.linalg.solve_triangular(Lmm, C, lower=True)  # (m, N)
+    G = (Ah * w[None, :]) @ Ah.T
+    G = 0.5 * (G + G.T)
+    m = Z.shape[0]
+    eye = jnp.eye(m, dtype=Z.dtype)
+    L_B, rung_b = ladder_cholesky_with_rung(G + eye)
+    alpha = _sparse_alpha(Lmm, L_B, b)
+
+    g_eps = 1e-6 * (1.0 + jnp.max(jnp.diagonal(G)))
+    L_G, _ = ladder_cholesky_with_rung(G + g_eps * eye)
+    T = Lmm @ jax.scipy.linalg.cho_solve((L_G, True), Lmm.T)
+    M = Kmm + 0.5 * (T + T.T)
+    L_var, rung_m = ladder_cholesky_with_rung(M)
+
+    state = GPState(params=params, X=Z, y=zy, mask=zmask, L=L_var, alpha=alpha)
+    rung = jnp.maximum(rung_k, jnp.maximum(rung_b, rung_m))
+    return state, Lmm, L_B, b, rung
+
+
+def _sparse_alpha(Lmm, L_B, b):
+    """``A⁻¹b`` through the whitened factors: two triangular sandwiches."""
+    inner = jax.scipy.linalg.solve_triangular(Lmm, b, lower=True)
+    inner = jax.scipy.linalg.cho_solve((L_B, True), inner)
+    return jax.scipy.linalg.solve_triangular(Lmm.T, inner, lower=False)
+
+
+def sparse_tell(
+    state: GPState,
+    Lmm: jnp.ndarray,
+    L_B: jnp.ndarray,
+    b: jnp.ndarray,
+    x_new: jnp.ndarray,  # (d,)
+    y_new: jnp.ndarray,  # () standardized target
+    cat_mask: jnp.ndarray,
+):
+    """O(m²) incremental tell: raise ``B`` by ``u·uᵀ``, refresh ``alpha``.
+
+    ``A += w·v·vᵀ`` is ``B += u·uᵀ`` with ``u = √w·Lmm⁻¹v`` in the whitened
+    factorization — one triangular solve plus an additive rank-1 Cholesky
+    raise. Returns ``(state', L_B', b', refactored)``. The variance factor
+    ``state.L`` is deliberately NOT touched (see module docstring); callers
+    refresh it at their window boundary via :func:`sgpr_reduce`. The
+    fallback factorization inside the rank-1 raise rebuilds ``B`` from the
+    factors at hand (``L_B L_Bᵀ + u·uᵀ``) — still O(m²) to assemble.
+    """
+    from optuna_tpu.samplers._resilience import ladder_cholesky_rank1_raise
+
+    params = state.params
+    w = 1.0 / (params.noise + _JITTER)
+    v = matern52(x_new[None], state.X, params, cat_mask)[0] * state.mask
+    u = jnp.sqrt(w) * jax.scipy.linalg.solve_triangular(Lmm, v, lower=True)
+    L_B2, _rung, refactored = ladder_cholesky_rank1_raise(
+        L_B, u, lambda: L_B @ L_B.T + jnp.outer(u, u)
+    )
+    b2 = b + w * y_new * v
+    alpha2 = _sparse_alpha(Lmm, L_B2, b2)
+    return state._replace(alpha=alpha2), L_B2, b2, refactored
+
+
+def _select_inducing_device(X, mask, m_pad):
+    """In-graph farthest-point selection over the padded history.
+
+    Same greedy as :func:`select_inducing_host` but masked and fixed-shape:
+    m_pad steps of argmax-of-min-distance; masked rows sit at distance −inf
+    so they are only chosen once real rows are exhausted (their slots stay
+    dead via the returned validity mask).
+    """
+    n = X.shape[0]
+    first = jnp.argmax(mask > 0)
+
+    def body(carry, i):
+        d2, chosen_count = carry
+        pick = jnp.argmax(jnp.where(mask > 0, d2, -jnp.inf))
+        pick = jnp.where(i == 0, first, pick)
+        dist_new = jnp.sum((X - X[pick]) ** 2, axis=1)
+        d2 = jnp.minimum(d2, dist_new)
+        valid = chosen_count < jnp.sum(mask > 0)
+        return (d2, chosen_count + 1), (pick, valid)
+
+    (_, _), (idx, valid) = jax.lax.scan(
+        body, (jnp.full((n,), jnp.inf), jnp.asarray(0, jnp.int32)), jnp.arange(m_pad)
+    )
+    return idx, valid
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "q", "m_pad", "n_local_search", "n_cycles", "lbfgs_iters", "fit_iters",
+        "has_sweep", "has_categorical",
+    ),
+)
+def gp_suggest_sparse_fused(
+    starts: jnp.ndarray,  # (S, d+2) kernel-param starts
+    X: jnp.ndarray,  # (N, d) padded observations, N > n_exact_max regime
+    y: jnp.ndarray,  # (N,) standardized
+    cat_mask: jnp.ndarray,  # (d,)
+    mask: jnp.ndarray,  # (N,) counts
+    sobol_base: jnp.ndarray,  # (C, d)
+    incumbents: jnp.ndarray,  # (I, d)
+    key: jax.Array,
+    minimum_noise: float,
+    cont_mask: jnp.ndarray,
+    lower: jnp.ndarray,
+    upper: jnp.ndarray,
+    n_choices: jnp.ndarray,
+    steps: jnp.ndarray,
+    dim_onehot: jnp.ndarray,
+    choice_grid: jnp.ndarray,
+    choice_valid: jnp.ndarray,
+    stabilizing_noise: float = 1e-10,
+    q: int = 1,
+    m_pad: int = N_INDUCING_MAX,
+    n_local_search: int = 10,
+    n_cycles: int = 2,
+    lbfgs_iters: int = 40,
+    fit_iters: int = 60,
+    has_sweep: bool = False,
+    has_categorical: bool = False,
+):
+    """The sparse twin of ``gp_suggest_fused``/``gp_suggest_chain_fused``:
+    one dispatch → q proposals above the exact-size threshold.
+
+    Pipeline: in-graph farthest-point inducing selection → subset MAP fit
+    (O(m³)/iter) → SGPR reduction over the full history (O(nm²), Pallas
+    Gram on all-continuous spaces) → q kriging-believer LogEI rounds with
+    O(m²) additive tells. One program per (N-bucket, m_pad, q) triple —
+    compile count stays log-bounded in history size.
+    """
+    idx, zvalid = _select_inducing_device(X, mask, m_pad)
+    Z = X[idx]
+    zy = y[idx]
+    zmask = zvalid.astype(X.dtype)
+
+    raw, params, fit_iters_used = _fit_params(
+        starts, Z, zy, cat_mask, zmask, minimum_noise, fit_iters
+    )
+    state, Lmm, L_B, b, rung = sgpr_reduce(
+        params, Z, zy, zmask, X, y, mask, cat_mask,
+        has_categorical=has_categorical,
+    )
+    noise_c = jnp.asarray(stabilizing_noise, dtype=X.dtype)
+    best0 = jnp.max(jnp.where(mask > 0, y, -jnp.inf))
+
+    def propose(carry, i):
+        st, L_Bc, bc, best = carry
+        data = LogEIData(
+            state=st, cat_mask=cat_mask, best=best, stabilizing_noise=noise_c
+        )
+        k_i = jax.random.fold_in(key, i)
+        k_cand, k_start = jax.random.split(k_i)
+        cand = device_candidates(sobol_base, k_cand, cat_mask, n_choices, steps)
+        cand = jnp.concatenate([incumbents, cand], axis=0)
+        x_i, v_i, nf_i = _maximize_logei(
+            data, cand, k_start, cont_mask, lower, upper,
+            dim_onehot, choice_grid, choice_valid,
+            n_local_search=n_local_search, n_cycles=n_cycles,
+            lbfgs_iters=lbfgs_iters, has_sweep=has_sweep,
+        )
+        mean_i, _ = posterior(st, x_i[None], cat_mask)
+        st2, L_B2, b2, rf_i = sparse_tell(st, Lmm, L_Bc, bc, x_i, mean_i[0], cat_mask)
+        best2 = jnp.maximum(best, mean_i[0])
+        return (st2, L_B2, b2, best2), (x_i, v_i, nf_i, rf_i)
+
+    (_, _, _, _), (xs, vs, nfs, rfs) = jax.lax.scan(
+        propose, (state, L_B, b, best0), jnp.arange(q)
+    )
+    n_real = jnp.sum(mask > 0)
+    m_live = jnp.sum(zmask > 0).astype(jnp.int32)
+    stats = {
+        "gp.ladder_rung": rung,
+        "gp.fit_iterations": fit_iters_used,
+        "gp.proposal_fallback_coords": jnp.sum(nfs).astype(jnp.int32),
+        "gp.best_acq": jnp.max(vs),
+        "gp.inducing_count": m_live,
+        "gp.sparsity_ratio": m_live.astype(jnp.float32)
+        / jnp.maximum(n_real, 1).astype(jnp.float32),
+    }
+    return xs, vs, raw, stats
+
+
+from optuna_tpu import flight as _flight  # noqa: E402 (gauge wiring below the kernels)
+
+gp_suggest_sparse_fused = _flight.instrument_jit(
+    gp_suggest_sparse_fused, "gp.suggest_sparse_fused"
+)
+
+
+def fit_gp_sparse(
+    X: np.ndarray,
+    y: np.ndarray,
+    is_categorical: np.ndarray,
+    warm_start_raw: np.ndarray | None = None,
+    minimum_noise: float | None = None,
+    n_restarts: int = 4,
+    seed: int = 0,
+    counts: np.ndarray | None = None,
+    n_inducing: int = N_INDUCING_MAX,
+) -> tuple[GPState, np.ndarray, dict]:
+    """Sparse twin of :func:`optuna_tpu.gp.gp.fit_gp` for n > ``N_EXACT_MAX``.
+
+    Same signature and return contract (reduced GPState quacks exactly like
+    the exact one), plus the inducing device stats. The inducing subset is
+    the deterministic host k-center selection; params fit on the subset,
+    posterior conditioned on everything.
+    """
+    from optuna_tpu.gp.gp import (
+        _bucket,
+        _fit_kernel_params_jit,
+        fit_gp,
+    )
+    from optuna_tpu.gp.prior import DEFAULT_MINIMUM_NOISE_VAR
+
+    if minimum_noise is None:
+        minimum_noise = DEFAULT_MINIMUM_NOISE_VAR
+    n, d = X.shape
+    m = min(n_inducing, n)
+    if m >= n:  # degenerate call below the regime: exact is strictly better
+        return fit_gp(
+            X, y, is_categorical, warm_start_raw, minimum_noise,
+            n_restarts, seed, counts, n_exact_max=n,  # force exact: no re-entry
+        )
+    sel = select_inducing_host(np.asarray(X, np.float32), m)
+    m_pad = _pow2_bucket(m)
+    N = _bucket(n)
+
+    Zp = np.zeros((m_pad, d), np.float32)
+    Zp[:m] = X[sel]
+    zyp = np.zeros(m_pad, np.float32)
+    zyp[:m] = y[sel]
+    zmaskp = np.zeros(m_pad, np.float32)
+    zmaskp[:m] = 1.0
+    Xp = np.zeros((N, d), np.float32)
+    Xp[:n] = X
+    yp = np.zeros(N, np.float32)
+    yp[:n] = y
+    maskp = np.zeros(N, np.float32)
+    maskp[:n] = 1.0 if counts is None else counts
+
+    default = np.zeros(d + 2, dtype=np.float32)
+    default[d + 1] = np.log(1e-2)
+    starts = [default]
+    if warm_start_raw is not None:
+        starts.append(np.asarray(warm_start_raw, dtype=np.float32))
+    rng = np.random.RandomState(seed)
+    while len(starts) < n_restarts:
+        starts.append(default + rng.normal(0, 1.0, size=d + 2).astype(np.float32))
+    starts_arr = jnp.asarray(np.stack(starts))
+
+    cat_mask = jnp.asarray(is_categorical.astype(bool))
+    has_cat = bool(np.any(is_categorical))
+    raw, _ = _fit_kernel_params_jit(
+        starts_arr, jnp.asarray(Zp), jnp.asarray(zyp), cat_mask,
+        jnp.asarray(zmaskp), float(minimum_noise),
+    )
+    state, rung = _finalize_sparse(
+        raw, jnp.asarray(Zp), jnp.asarray(zyp), jnp.asarray(zmaskp),
+        jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(maskp), cat_mask,
+        float(minimum_noise), has_cat,
+    )
+    stats = {
+        "gp.ladder_rung": rung,
+        "gp.inducing_count": jnp.asarray(m, jnp.int32),
+        "gp.sparsity_ratio": jnp.asarray(m / max(n, 1), jnp.float32),
+    }
+    return state, np.asarray(raw), stats
+
+
+@partial(jax.jit, static_argnames=("minimum_noise", "has_categorical"))
+def _finalize_sparse(
+    raw, Z, zy, zmask, X, y, mask, cat_mask, minimum_noise, has_categorical
+):
+    d = Z.shape[-1]
+    params = GPParams(
+        inv_sq_lengthscales=jnp.exp(raw[:d]),
+        scale=jnp.exp(raw[d]),
+        noise=jnp.exp(raw[d + 1]) + minimum_noise,
+    )
+    state, _Lmm, _L_B, _b, rung = sgpr_reduce(
+        params, Z, zy, zmask, X, y, mask, cat_mask,
+        has_categorical=has_categorical,
+    )
+    return state, rung

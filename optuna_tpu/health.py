@@ -108,6 +108,7 @@ HEALTH_CHECKS: dict[str, str] = {
     "executor.dispatch_timeouts": "repeated dispatch-deadline strikes (each abandons a watchdog thread)",
     "jit.retrace_churn": "jit wrappers keep retracing after their first compile (runtime TPU002)",
     "gp.ladder_escalation": "the Cholesky jitter ladder is escalating rungs on real fits",
+    "gp.sparse_degraded": "the sparse GP's one-step-ahead held-out error says the inducing set no longer covers the search",
     "worker.dead": "a worker's health snapshot went stale past its report interval",
     "shard.imbalance": "one trial shard's throughput fell >= 2x below the mesh median",
     "service.backpressure": "the suggestion service is shedding asks (overload ladder engaged)",
@@ -136,6 +137,7 @@ CHECK_SEVERITIES: dict[str, str] = {
     "executor.dispatch_timeouts": "WARNING",
     "jit.retrace_churn": "WARNING",
     "gp.ladder_escalation": "WARNING",
+    "gp.sparse_degraded": "WARNING",
     "worker.dead": "CRITICAL",
     "shard.imbalance": "WARNING",
     "service.backpressure": "WARNING",
@@ -183,6 +185,14 @@ QUARANTINE_MIN = 3
 DISPATCH_TIMEOUT_STRIKES = 2  # watchdog strikes before flagging
 RETRACE_CHURN_MIN = 3  # retraces-after-first across all jit labels
 LADDER_RUNG_WARN = 3  # device.gp.ladder_rung.max at or above this escalates
+# Sparse-GP degradation: the scan loop's gp.sparse_heldout_err gauge is a
+# one-step-ahead |predicted - observed| residual in STANDARDIZED score units
+# (unit variance by construction) measured before each tell. A healthy
+# approximation predicts new points well under one standard deviation off;
+# sustained error at/above one full standard deviation means the inducing
+# set has stopped covering where the optimizer is searching — the trigger
+# for the autopilot's gp.densify action.
+SPARSE_HELDOUT_ERR_WARN = 1.0
 DUPLICATE_RATE = 0.25  # exact-duplicate completed trials per completed trial
 DUPLICATE_MIN = 4
 SHARD_IMBALANCE_FACTOR = 2.0  # a shard this far below the median is lagging
@@ -974,6 +984,36 @@ def _check_ladder_escalation(
     )
 
 
+def _check_sparse_degraded(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    threshold = kw.get("sparse_heldout_err_warn", SPARSE_HELDOUT_ERR_WARN)
+    err = fleet["gauges"].get("device.gp.sparse_heldout_err.last")
+    if err is None or err < threshold:
+        return None
+    m = fleet["gauges"].get("device.gp.inducing_count.last")
+    ratio = fleet["gauges"].get("device.gp.sparsity_ratio.last")
+    return HealthFinding(
+        check="gp.sparse_degraded",
+        severity=CHECK_SEVERITIES["gp.sparse_degraded"],
+        summary=(
+            f"sparse GP held-out error {err:.2f} standardized units "
+            f"(>= {threshold:g}): the inducing set no longer covers the search"
+        ),
+        evidence={
+            "heldout_err": err,
+            "inducing_count": m,
+            "sparsity_ratio": ratio,
+        },
+        remediation=(
+            "the SGPR approximation is starving: raise the inducing capacity "
+            "(optimize_scan(n_inducing=...) / GPSampler(n_inducing=...)) or "
+            "the exact-size threshold — the autopilot's gp.densify action "
+            "does exactly this, one notch per firing"
+        ),
+    )
+
+
 def _check_worker_dead(
     fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
 ) -> HealthFinding | None:
@@ -1237,6 +1277,7 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "executor.dispatch_timeouts": _check_dispatch_timeouts,
     "jit.retrace_churn": _check_retrace_churn,
     "gp.ladder_escalation": _check_ladder_escalation,
+    "gp.sparse_degraded": _check_sparse_degraded,
     "worker.dead": _check_worker_dead,
     "shard.imbalance": _check_shard_imbalance,
     "service.backpressure": _check_backpressure,

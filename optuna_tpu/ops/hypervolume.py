@@ -37,6 +37,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+#: Objective count at which HSSP scoring switches from the slicing
+#: decomposition to the WFG stack machine (:mod:`optuna_tpu.ops.wfg`).
+#: The crossover argument: slicing is a deterministic O(k^{M-1}) pipeline
+#: per candidate — unbeatable for M <= 4 where the exponent is small and the
+#: whole batch is branch-free VPU work — while the WFG stack is output-
+#: sensitive in the front structure but independent of that exponent. At
+#: M = 5 slicing's k^4 per-candidate cost overtakes the stack's bounded
+#: depth on every front shape we measured; below it, slicing wins across the
+#: board. The boundary is pinned by a three-way parity test at M = 4 and
+#: M = 5 (slicing vs WFG vs the host NumPy oracle in ``hypervolume/wfg.py``)
+#: in ``tests/test_hypervolume_boundary.py``.
+WFG_MIN_OBJECTIVES = 5
+
 
 @jax.jit
 def hypervolume_2d(points: jnp.ndarray, reference_point: jnp.ndarray) -> jnp.ndarray:
@@ -249,9 +262,10 @@ def solve_hssp_device(
 ) -> np.ndarray:
     """Host entry for device greedy HSSP; returns selected indices (k,).
 
-    The per-candidate scorer is chosen by objective count: slicing for
-    M <= 4, the WFG stack for M >= 5 (measured crossover — slicing is
-    O(k^{M-1}) per candidate).
+    The per-candidate scorer is chosen by objective count: slicing below
+    :data:`WFG_MIN_OBJECTIVES`, the WFG stack at or above it (measured
+    crossover — slicing is O(k^{M-1}) per candidate; see the constant's
+    docstring for the full argument).
     """
     n = len(points)
     k = int(min(subset_size, n))
@@ -267,7 +281,7 @@ def solve_hssp_device(
         mask,
         k,
         k_pad,
-        use_wfg=points.shape[1] >= 5,
+        use_wfg=points.shape[1] >= WFG_MIN_OBJECTIVES,
     )
     return np.asarray(chosen)[:k].astype(np.int64)
 

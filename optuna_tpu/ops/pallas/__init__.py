@@ -1,0 +1,42 @@
+"""Pallas kernels for the large-n GP engine and multi-objective selection.
+
+Every kernel in this package ships with two contracts:
+
+* **Interpret-mode CPU fallback** — when the active JAX backend is not a TPU,
+  ``pl.pallas_call`` runs with ``interpret=True`` so the exact same kernel
+  body executes (slowly) on CPU. Tier-1 tests under ``JAX_PLATFORMS=cpu``
+  exercise the kernels through this path; nothing in this package imports a
+  TPU-only module at import time.
+* **XLA twin** — each public entry point takes ``use_pallas`` (``None`` =
+  auto: Pallas on TPU, plain XLA elsewhere; ``True``/``False`` force). The
+  XLA branch is the numerical reference the parity suites compare against.
+
+Kernels:
+
+* :mod:`~optuna_tpu.ops.pallas.matern` — fused Matérn-5/2 distance+kernel
+  Gram/cross-covariance assembly (the sparse-GP fit hot spot).
+* :mod:`~optuna_tpu.ops.pallas.nds` — NSGA-II non-dominated sort dominance
+  tiles (relocated from ``ops/pareto.py``, which now delegates here).
+* :mod:`~optuna_tpu.ops.pallas.wfg` — the per-node limit+Pareto-filter step
+  of the WFG explicit-stack hypervolume machine in ``ops/wfg.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def pallas_default() -> bool:
+    """Auto-gate: run Pallas kernels only where they pay for themselves.
+
+    Interpret mode is an emulator — orders of magnitude slower than the XLA
+    twin — so ``use_pallas=None`` resolves to the real-hardware path only.
+    Tests force ``use_pallas=True`` to run the kernels through the
+    interpreter for numerical parity.
+    """
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Whether ``pl.pallas_call`` must run under the interpreter here."""
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,150 @@
+"""Fused Matérn-5/2 distance+kernel Gram assembly as a Pallas kernel.
+
+The sparse-GP chunk boundary rebuilds the m×n cross-covariance ``Kmf`` and
+the m×m ``Kmm`` (``gp/sparse.py``) every chunk; as generic XLA this lowers to
+a broadcasted (n1, n2, d) subtract/square/reduce chain that never touches the
+MXU. This kernel computes the scaled squared distance as one contraction —
+``d2 = |x1w|² − 2·x1w·x2wᵀ + |x2w|²`` with ``xw = x·sqrt(w)`` — and applies
+the Matérn-5/2 transform in the same VMEM pass, so the Gram tile is written
+exactly once.
+
+Contract vs :func:`optuna_tpu.gp.gp.matern52`:
+
+* **Continuous dims only** on the Pallas path. Categorical (Hamming)
+  dimensions break the dot-product factorization, so any ``cat_mask`` entry
+  forces the XLA twin (the sparse scan programs know staticly whether the
+  space has categorical dims and route accordingly).
+* **No autodiff.** The exact-GP fit differentiates ``matern52`` inside the
+  MLL loss; this kernel has no custom VJP and is used only on no-grad paths
+  (sparse A/b assembly, posterior cross-covariances).
+* Parity with the XLA twin is float32-exact up to contraction reassociation
+  (tested in ``tests/test_ops_pallas.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu.ops.pallas import interpret_mode, pallas_default
+
+_ROW_TILE = 128
+_COL_TILE = 128
+
+
+def _matern52_xla(
+    x1: jnp.ndarray,
+    x2: jnp.ndarray,
+    inv_sq_ls: jnp.ndarray,
+    scale: jnp.ndarray,
+    cat_mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """The generic twin — same algebra as ``gp.gp.matern52`` (kept local so
+    ops/ stays below gp/ in the import DAG; parity is pinned by test)."""
+    diff = x1[:, None, :] - x2[None, :, :]
+    sq = jnp.where(cat_mask, (diff != 0.0).astype(x1.dtype), diff * diff)
+    d2 = jnp.sum(sq * inv_sq_ls, axis=-1)
+    safe = jnp.where(d2 > 0, d2, 1.0)
+    d = jnp.where(d2 > 0, jnp.sqrt(safe), 0.0)
+    sqrt5d = jnp.sqrt(5.0) * d
+    return scale * (1.0 + sqrt5d + (5.0 / 3.0) * d2) * jnp.exp(-sqrt5d)
+
+
+def _matern52_kernel(x1w_ref, x2w_ref, sq1_ref, sq2_ref, scale_ref, out_ref):
+    """One (ROW_TILE, n2) output tile: MXU contraction + VPU transform."""
+    x1w = x1w_ref[:]  # (ROW_TILE, d), rows pre-scaled by sqrt(w)
+    x2w = x2w_ref[:]  # (n2, d)
+    cross = jax.lax.dot_general(
+        x1w,
+        x2w,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (ROW_TILE, n2)
+    d2 = sq1_ref[:] - 2.0 * cross + sq2_ref[:]  # (ROW_TILE,1)+(1,n2) broadcast
+    d2 = jnp.maximum(d2, 0.0)  # contraction round-off can dip below zero
+    sqrt5d = jnp.sqrt(5.0 * d2)
+    out_ref[:] = scale_ref[0, 0] * (1.0 + sqrt5d + (5.0 / 3.0) * d2) * jnp.exp(-sqrt5d)
+
+
+def _pad_rows(a: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    return jnp.pad(a, ((0, n_pad - a.shape[0]), (0, 0)))
+
+
+@partial(jax.jit, static_argnames=("use_pallas",))
+def _gram_dispatch(x1, x2, inv_sq_ls, scale, cat_mask, use_pallas):
+    if not use_pallas:
+        return _matern52_xla(x1, x2, inv_sq_ls, scale, cat_mask)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n1, d = x1.shape
+    n2 = x2.shape[0]
+    # sqrt(w)-scaled rows turn the ARD distance into a plain Euclidean one
+    # the MXU can contract; row norms ride in as (tile, 1)/(1, n2) operands
+    # so the kernel never reduces over d itself.
+    w_sqrt = jnp.sqrt(jnp.maximum(inv_sq_ls, 0.0))
+    x1w = x1 * w_sqrt
+    x2w = x2 * w_sqrt
+    sq1 = jnp.sum(x1w * x1w, axis=1, keepdims=True)  # (n1, 1)
+    sq2 = jnp.sum(x2w * x2w, axis=1, keepdims=True).T  # (1, n2)
+
+    n1_pad = ((n1 + _ROW_TILE - 1) // _ROW_TILE) * _ROW_TILE
+    n2_pad = ((n2 + _COL_TILE - 1) // _COL_TILE) * _COL_TILE
+    x1w = _pad_rows(x1w, n1_pad)
+    x2w = _pad_rows(x2w, n2_pad)
+    sq1 = _pad_rows(sq1, n1_pad)
+    sq2 = jnp.pad(sq2, ((0, 0), (0, n2_pad - n2)))
+    scale_arr = jnp.reshape(scale.astype(jnp.float32), (1, 1))
+
+    out = pl.pallas_call(
+        _matern52_kernel,
+        out_shape=jax.ShapeDtypeStruct((n1_pad, n2_pad), jnp.float32),
+        grid=(n1_pad // _ROW_TILE,),
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((n2_pad, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_ROW_TILE, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n2_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (_ROW_TILE, n2_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret_mode(),
+    )(x1w, x2w, sq1, sq2, scale_arr)
+    return out[:n1, :n2]
+
+
+def matern52_gram(
+    x1: jnp.ndarray,
+    x2: jnp.ndarray,
+    inv_sq_lengthscales: jnp.ndarray,
+    scale: jnp.ndarray,
+    cat_mask: jnp.ndarray,
+    *,
+    use_pallas: bool | None = None,
+    has_categorical: bool = False,
+) -> jnp.ndarray:
+    """(n1, n2) Matérn-5/2 Gram / cross-covariance.
+
+    ``use_pallas=None`` resolves via :func:`pallas_default` (TPU only —
+    interpret mode is for parity tests, not throughput). ``has_categorical``
+    must be passed statically ``True`` whenever ``cat_mask`` can contain a
+    categorical dim: the Hamming distance does not factor through the MXU
+    contraction, so those spaces always take the XLA twin.
+    """
+    if use_pallas is None:
+        use_pallas = pallas_default()
+    if has_categorical:
+        use_pallas = False
+    return _gram_dispatch(
+        jnp.asarray(x1),
+        jnp.asarray(x2),
+        jnp.asarray(inv_sq_lengthscales),
+        jnp.asarray(scale),
+        jnp.asarray(cat_mask),
+        bool(use_pallas),
+    )

@@ -1,0 +1,57 @@
+"""NSGA-II non-dominated sort: the Pallas dominance-tile kernel.
+
+Canonical home of the dominance kernel behind ``ops/pareto.py`` (which
+delegates here and keeps its public API for callers like
+``study/_multi_objective.py`` and ``samplers/nsgaii``). The O(N²M)
+dominance comparisons are the FLOP body of the sort; they run as 128×128
+tiles of the dominance matrix on the VPU, while the O(front-count) peeling
+loop stays a ``lax.while_loop`` in the caller.
+
+CPU tier-1 runs the same kernel through ``interpret=True``
+(:func:`optuna_tpu.ops.pallas.interpret_mode`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu.ops.pallas import interpret_mode
+
+TILE = 128
+
+
+def _dominance_kernel(vi_ref, vj_ref, out_ref):
+    """out[i, j] = 1.0 iff point i dominates point j (minimization)."""
+    vi = vi_ref[:]  # (TILE, M)
+    vj = vj_ref[:]  # (TILE, M)
+    leq = jnp.all(vi[:, None, :] <= vj[None, :, :], axis=-1)
+    lt = jnp.any(vi[:, None, :] < vj[None, :, :], axis=-1)
+    out_ref[:] = (leq & lt).astype(jnp.float32)
+
+
+def dominance_matrix(values: jnp.ndarray, use_pallas: bool = True) -> jnp.ndarray:
+    """(N, N) float32 dominance matrix; N padded to a 128 multiple by callers."""
+    n, m = values.shape
+    if not use_pallas or n % TILE != 0:
+        leq = jnp.all(values[:, None, :] <= values[None, :, :], axis=-1)
+        lt = jnp.any(values[:, None, :] < values[None, :, :], axis=-1)
+        return (leq & lt).astype(jnp.float32)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (n // TILE, n // TILE)
+    return pl.pallas_call(
+        _dominance_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE, m), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, m), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE, TILE), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret_mode(),
+    )(values, values)

@@ -1,0 +1,111 @@
+"""WFG hypervolume: the per-node limit+Pareto-filter step as a Pallas kernel.
+
+``ops/wfg.py`` evaluates the WFG recursion with an explicit stack; every
+``lax.while_loop`` iteration pops a frame, clamps the remaining points to the
+pivot (``limit``), and Pareto-filters the clamped set — one masked O(N²M)
+dominance block, the whole FLOP body of the machine. This kernel fuses the
+clamp, the dominance block, and the fill-to-reference into a single VMEM
+pass so the stack machine writes each child frame exactly once.
+
+The XLA twin reproduces ``ops/wfg.py``'s original two-line body
+(``maximum`` + ``_masked_pareto``) bit-for-bit; parity between the two is
+pinned in ``tests/test_ops_pallas.py`` against the host NumPy oracle in
+``hypervolume/wfg.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from optuna_tpu.ops.pallas import interpret_mode
+
+
+def _limit_filter_xla(pts, p, eligible, ref):
+    """The original stack-body step: clamp to the pivot, Pareto-filter the
+    clamped set (duplicates keep the lowest index), fill pruned rows at ref."""
+    n = pts.shape[0]
+    child = jnp.maximum(pts, p[None, :])
+    eff = jnp.where(eligible[:, None], child, jnp.inf)
+    leq = jnp.all(eff[:, None, :] <= eff[None, :, :], axis=2)
+    strict = jnp.any(eff[:, None, :] < eff[None, :, :], axis=2)
+    earlier = jnp.arange(n)[:, None] < jnp.arange(n)[None, :]
+    dominated = jnp.any(leq & (strict | earlier) & eligible[:, None], axis=0)
+    child_msk = eligible & ~dominated
+    return jnp.where(child_msk[:, None], child, ref[None, :]), child_msk
+
+
+def _limit_filter_kernel(pts_ref, p_ref, elig_ref, ref_ref, out_pts_ref, out_msk_ref):
+    n, m = pts_ref.shape
+    pts = pts_ref[:]  # (N, M)
+    p = p_ref[:]  # (1, M)
+    elig = elig_ref[:]  # (N, 1) 1.0 for rows still in play
+    ref = ref_ref[:]  # (1, M)
+    child = jnp.maximum(pts, p)
+
+    # Dominance over the clamped set, one objective column at a time so no
+    # (N, N, M) intermediate ever materializes in VMEM. Booleans are carried
+    # as f32 masks (VPU-friendly); masked-out rows sit at +inf.
+    row_ids = jax.lax.broadcasted_iota(jnp.float32, (n, n), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.float32, (n, n), 1)
+    leq = jnp.ones((n, n), jnp.float32)
+    strict = jnp.zeros((n, n), jnp.float32)
+    for k in range(m):  # M is a static shape: unrolled at trace time
+        col = jnp.where(elig > 0.0, child[:, k : k + 1], jnp.inf)  # (N, 1)
+        a = jax.lax.broadcast_in_dim(col, (n, n), (0, 1))  # row i value
+        b = jax.lax.broadcast_in_dim(
+            jnp.transpose(col), (n, n), (0, 1)
+        )  # column j value
+        leq = leq * (a <= b).astype(jnp.float32)
+        strict = jnp.maximum(strict, (a < b).astype(jnp.float32))
+    earlier = (row_ids < col_ids).astype(jnp.float32)
+    elig_row = jax.lax.broadcast_in_dim(elig, (n, n), (0, 1))
+    dom = leq * jnp.maximum(strict, earlier) * elig_row
+    dominated = jnp.max(dom, axis=0, keepdims=True)  # (1, N)
+    child_msk = elig * (1.0 - jnp.transpose(dominated))  # (N, 1)
+    out_msk_ref[:] = child_msk
+    out_pts_ref[:] = jnp.where(child_msk > 0.0, child, ref)
+
+
+@partial(jax.jit, static_argnames=("use_pallas",))
+def limit_and_filter(pts, p, eligible, ref, use_pallas=False):
+    """One WFG stack-body step: ``(child_pts, child_msk)``.
+
+    ``pts`` (N, M) frame points, ``p`` (M,) pivot, ``eligible`` (N,) bool
+    rows still in the frame, ``ref`` (M,) reference point. Returns the
+    clamped+filtered child frame with pruned rows filled at ``ref`` and its
+    boolean mask.
+    """
+    if not use_pallas:
+        return _limit_filter_xla(pts, p, eligible, ref)
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, m = pts.shape
+    out_pts, out_msk = pl.pallas_call(
+        _limit_filter_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, m), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret_mode(),
+    )(
+        pts.astype(jnp.float32),
+        p.astype(jnp.float32)[None, :],
+        eligible.astype(jnp.float32)[:, None],
+        ref.astype(jnp.float32)[None, :],
+    )
+    return out_pts, out_msk[:, 0] > 0.0

@@ -7,6 +7,10 @@ matrix); the O(front-count) peeling loop is a `lax.while_loop` over the
 resulting matrix. Host NumPy remains the small-N path (dispatch latency
 dominates below a few hundred points — see ``study/_multi_objective.py``).
 
+The kernel itself lives in :mod:`optuna_tpu.ops.pallas.nds` (the kernel
+package introduced with the large-n GP engine); this module keeps the
+public ranking API and the host ordinal-transform entry.
+
 CPU tests run the same kernel through ``interpret=True``.
 """
 
@@ -18,42 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_TILE = 128
-
-
-def _dominance_kernel(vi_ref, vj_ref, out_ref):
-    """out[i, j] = 1.0 iff point i dominates point j (minimization)."""
-    vi = vi_ref[:]  # (TILE, M)
-    vj = vj_ref[:]  # (TILE, M)
-    leq = jnp.all(vi[:, None, :] <= vj[None, :, :], axis=-1)
-    lt = jnp.any(vi[:, None, :] < vj[None, :, :], axis=-1)
-    out_ref[:] = (leq & lt).astype(jnp.float32)
-
-
-def dominance_matrix(values: jnp.ndarray, use_pallas: bool = True) -> jnp.ndarray:
-    """(N, N) float32 dominance matrix; N padded to a 128 multiple by callers."""
-    n, m = values.shape
-    if not use_pallas or n % _TILE != 0:
-        leq = jnp.all(values[:, None, :] <= values[None, :, :], axis=-1)
-        lt = jnp.any(values[:, None, :] < values[None, :, :], axis=-1)
-        return (leq & lt).astype(jnp.float32)
-
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    interpret = jax.default_backend() != "tpu"
-    grid = (n // _TILE, n // _TILE)
-    return pl.pallas_call(
-        _dominance_kernel,
-        out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((_TILE, m), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_TILE, m), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((_TILE, _TILE), lambda i, j: (i, j), memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(values, values)
+from optuna_tpu.ops.pallas.nds import TILE as _TILE
+from optuna_tpu.ops.pallas.nds import dominance_matrix
 
 
 @partial(jax.jit, static_argnames=("use_pallas",))

@@ -38,9 +38,14 @@ well-scaled on TPU.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from optuna_tpu.ops.pallas import pallas_default
+from optuna_tpu.ops.pallas.wfg import limit_and_filter
 
 
 def _masked_pareto(pts: jnp.ndarray, msk: jnp.ndarray) -> jnp.ndarray:
@@ -58,14 +63,20 @@ def _masked_pareto(pts: jnp.ndarray, msk: jnp.ndarray) -> jnp.ndarray:
     return msk & ~dominated
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("use_pallas",))
 def hypervolume_wfg(
-    points: jnp.ndarray, reference_point: jnp.ndarray, mask: jnp.ndarray
+    points: jnp.ndarray,
+    reference_point: jnp.ndarray,
+    mask: jnp.ndarray,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """Exact hypervolume of masked rows of ``points`` (N, M), any M >= 2.
 
     Matches the host oracle (``optuna_tpu.hypervolume.wfg``) to float32
     accuracy; rows outside the reference point or masked out contribute 0.
+    ``use_pallas`` routes the per-node limit+Pareto-filter step (the O(N²M)
+    FLOP body) through the fused Pallas kernel in
+    :mod:`optuna_tpu.ops.pallas.wfg`; ``False`` keeps the original XLA body.
     """
     n, m = points.shape
     ref = reference_point
@@ -97,8 +108,9 @@ def hypervolume_wfg(
         p = pts[nxt]
         inc = jnp.prod(ref - p)
 
-        child_pts = jnp.maximum(pts, p[None, :])
-        child_msk = _masked_pareto(child_pts, msk & (idx > nxt))
+        child_pts, child_msk = limit_and_filter(
+            pts, p, msk & (idx > nxt), ref, use_pallas=use_pallas
+        )
         n_child = jnp.sum(child_msk)
         # A one-point child is just its inclusive volume: fold it in place.
         only = child_pts[jnp.argmax(child_msk)]
@@ -107,7 +119,7 @@ def hypervolume_wfg(
 
         do_push = has_more & (n_child > 1)
         s_cur = s_cur.at[top].set(jnp.where(has_more, nxt + 1, s_cur[top]))
-        s_pts = s_pts.at[depth].set(jnp.where(child_msk[:, None], child_pts, ref[None, :]))
+        s_pts = s_pts.at[depth].set(child_pts)
         s_msk = s_msk.at[depth].set(child_msk & do_push)
         s_cur = s_cur.at[depth].set(0)
         s_sign = s_sign.at[depth].set(-sign)
@@ -120,9 +132,12 @@ def hypervolume_wfg(
     return hv
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("use_pallas",))
 def wfg_loo_contributions(
-    points: jnp.ndarray, reference_point: jnp.ndarray, mask: jnp.ndarray
+    points: jnp.ndarray,
+    reference_point: jnp.ndarray,
+    mask: jnp.ndarray,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """Exclusive contribution of every masked row via the limit identity.
 
@@ -143,7 +158,7 @@ def wfg_loo_contributions(
         # p_i itself still covers part of p_i's box. The kernel's own Pareto
         # filter prunes whatever is redundant after clamping.
         lmask = inside & (jnp.arange(n) != i)
-        covered = hypervolume_wfg(limited, ref, lmask)
+        covered = hypervolume_wfg(limited, ref, lmask, use_pallas=use_pallas)
         inc = jnp.prod(ref - p)
         return jnp.where(front[i], jnp.maximum(inc - covered, 0.0), 0.0)
 
@@ -165,13 +180,25 @@ def _padded(points: np.ndarray, reference_point: np.ndarray):
 
 
 def hypervolume_wfg_nd(points: np.ndarray, reference_point: np.ndarray) -> float:
-    """Host entry: exact hypervolume via the device WFG stack (N bucketed)."""
+    """Host entry: exact hypervolume via the device WFG stack (N bucketed).
+
+    On TPU the per-node limit+filter body runs as the fused Pallas kernel;
+    elsewhere the original XLA body runs (interpret mode is parity-test-only).
+    """
     pts, mask = _padded(points, reference_point)
-    return float(hypervolume_wfg(pts, jnp.asarray(reference_point, jnp.float32), mask))
+    return float(
+        hypervolume_wfg(
+            pts, jnp.asarray(reference_point, jnp.float32), mask,
+            use_pallas=pallas_default(),
+        )
+    )
 
 
 def wfg_loo_nd(points: np.ndarray, reference_point: np.ndarray) -> np.ndarray:
     """Host entry: leave-one-out exclusive contributions via the WFG stack."""
     pts, mask = _padded(points, reference_point)
-    out = wfg_loo_contributions(pts, jnp.asarray(reference_point, jnp.float32), mask)
+    out = wfg_loo_contributions(
+        pts, jnp.asarray(reference_point, jnp.float32), mask,
+        use_pallas=pallas_default(),
+    )
     return np.asarray(out)[: len(points)]

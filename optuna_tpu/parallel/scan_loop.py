@@ -359,6 +359,265 @@ def _chunk_program(
     return compiled
 
 
+def _seed_inducing_program(objective: "VectorizedObjective", bucket: int, m_pad: int):
+    """One-dispatch inducing-set seeder for the first sparse chunk (and for
+    re-seeding after a densify action grows the capacity): in-graph
+    farthest-point selection over the live bucket, gathered into the
+    fixed-shape ``(m_pad, d)`` inducing buffers. The startup block (the
+    Sobol random phase) is the front of the history, so the greedy's
+    space-filling picks are drawn from it first."""
+    key = ("scan_seed_inducing", bucket, m_pad)
+    cached = objective._compiled_cache.get(key)
+    if cached is not None:
+        return cached
+    import jax
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp.sparse import _select_inducing_device
+
+    def seed(X, y, mask):
+        idx, valid = _select_inducing_device(X, mask, m_pad)
+        zmask = valid.astype(X.dtype)
+        return X[idx], jnp.where(zmask > 0, y[idx], 0.0), zmask
+
+    compiled = jax.jit(seed)  # graphlint: ignore[TPU002] -- memoized in the objective's compile cache: one wrapper per (bucket, m_pad) for the objective's lifetime
+    objective._compiled_cache[key] = compiled
+    return compiled
+
+
+def _chunk_program_sparse(
+    objective: "VectorizedObjective",
+    space,
+    dev,
+    *,
+    chunk_len: int,
+    bucket: int,
+    m_pad: int,
+    n_starts: int,
+    fit_iters: int,
+    minimum_noise: float,
+    maximize: bool,
+    n_local_search: int,
+    lbfgs_iters: int,
+    has_categorical: bool,
+):
+    """The large-n twin of :func:`_chunk_program`: same ask/evaluate/tell
+    scan, but the posterior is the SGPR inducing-point reduction
+    (:mod:`optuna_tpu.gp.sparse`) over a fixed-shape ``(m_pad, d)`` inducing
+    set carried beside the history buffers.
+
+    Per chunk boundary: subset MAP fit on the inducing set (O(m³)/iter
+    instead of O(n³)) and one :func:`~optuna_tpu.gp.sparse.sgpr_reduce` over
+    the full bucket (O(nm²), Pallas Gram assembly on all-continuous
+    spaces). Per scan step: propose O(m²) from the reduced m-point GPState,
+    then tell by either
+
+    * an O(m²) additive rank-1 raise of the whitened information factor
+      (:func:`~optuna_tpu.gp.sparse.sparse_tell`) when the new point is
+      well covered by the inducing set, or
+    * a **swap-in** — the point's (deliberately stale, see gp/sparse.py)
+      posterior variance exceeding ``SWAP_VAR_FRAC``·scale means the set
+      does not cover where the optimizer is going; the most redundant
+      inducing slot (min nearest-neighbor distance, empty slots first) is
+      replaced and the reduction rebuilt in-graph. Swap-ins are counted on
+      ``gp.inducing_swaps``; a warmed-up set stops swapping, which is the
+      zero-full-refits steady state the bench gates.
+
+    Every proposal's one-step-ahead residual |μ(x) − y_std(x)| is
+    accumulated *before* ingestion — ``gp.sparse_heldout_err`` is a true
+    held-out error the doctor's ``gp.sparse_degraded`` check thresholds.
+    NaN quarantine is identical to the exact path: the verdict skips the
+    buffer write, the factor update, AND the inducing set — a poisoned
+    value can never enter ``Z``.
+    """
+    cache_key = (
+        "scan_chunk_sparse", chunk_len, bucket, m_pad, n_starts, fit_iters,
+        minimum_noise, maximize, n_local_search, lbfgs_iters, has_categorical,
+        int(dev.sobol_base.shape[0]),
+    )
+    cached = objective._compiled_cache.get(cache_key)
+    if cached is not None:
+        return cached
+
+    import jax
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp.acqf import LogEIData
+    from optuna_tpu.gp.fused import _fit_params, _maximize_logei, device_candidates
+    from optuna_tpu.gp.gp import posterior
+    from optuna_tpu.gp.sparse import SWAP_VAR_FRAC, sgpr_reduce, sparse_tell
+
+    decode = _make_decode(space)
+    fn = objective.fn
+    f32 = jnp.float32
+    noise_c = jnp.asarray(_STABILIZING_NOISE, f32)
+
+    def chunk_fn(starts, X, y, mask, n_real, Z, zy, zmask, key):
+        # Chunk-start standardization over the FULL history — identical to
+        # the exact program, so the sparse/exact transition never shifts the
+        # target scale.
+        n_f = jnp.maximum(jnp.sum(mask), 1.0)
+        mu = jnp.sum(y * mask) / n_f
+        sd = jnp.sqrt(jnp.maximum(jnp.sum(mask * (y - mu) ** 2) / n_f, 0.0))
+        sd = jnp.where(sd > 1e-12, sd, 1.0)
+        y_std = jnp.where(mask > 0, (y - mu) / sd, 0.0)
+        zy_std = jnp.where(zmask > 0, (zy - mu) / sd, 0.0)
+
+        # Subset-of-inducing MAP fit: O(m^3) per iteration regardless of n.
+        raw, params, fit_n_iter = _fit_params(
+            starts, Z, zy_std, dev.cat_mask, zmask, minimum_noise, fit_iters
+        )
+        # One SGPR reduction per chunk: the O(nm^2) projection that
+        # conditions the m-point posterior on everything observed so far.
+        state0, Lmm0, L_B0, b0, rung0 = sgpr_reduce(
+            params, Z, zy_std, zmask, X, y_std, mask, dev.cat_mask,
+            has_categorical=has_categorical,
+        )
+        any_real = jnp.sum(mask) > 0
+        best0 = jnp.where(
+            any_real,
+            jnp.max(jnp.where(mask > 0, y_std, -jnp.inf)),
+            jnp.asarray(0.0, f32),
+        )
+        eye_off = ~jnp.eye(m_pad, dtype=bool)
+
+        def step(carry, i):
+            (X, y, y_std, mask, Z, zy_s, zmask, st, Lmm, L_B, b, best, n,
+             r1, rf, swaps, herr, rung_max, quar) = carry
+            data = LogEIData(
+                state=st, cat_mask=dev.cat_mask, best=best,
+                stabilizing_noise=noise_c,
+            )
+            k_i = jax.random.fold_in(key, i)
+            k_cand, k_start = jax.random.split(k_i)
+            cand = device_candidates(
+                dev.sobol_base, k_cand, dev.cat_mask, dev.n_choices, dev.steps
+            )
+            inc_idx = jnp.clip(n - 1 - jnp.arange(4), 0, bucket - 1)
+            cand = jnp.concatenate([jnp.take(X, inc_idx, axis=0), cand], axis=0)
+            x_i, _v, _nf = _maximize_logei(
+                data, cand, k_start, dev.cont_mask, dev.lower, dev.upper,
+                dev.dim_onehot, dev.choice_grid, dev.choice_valid,
+                n_local_search=n_local_search, n_cycles=1,
+                lbfgs_iters=lbfgs_iters, has_sweep=dev.has_sweep,
+            )
+            val = _single_objective_values(fn(decode(x_i[None])), 1)[0]
+            finite = jnp.isfinite(val)
+            score = val if maximize else -val
+            score = jnp.clip(
+                jnp.where(finite, score, 0.0), -_SCAN_SCORE_CLIP, _SCAN_SCORE_CLIP
+            )
+            score_std = (score - mu) / sd
+            # One-step-ahead held-out residual, measured BEFORE the tell:
+            # the model has not seen x_i yet, so this is an honest error
+            # signal for the sparse approximation's coverage.
+            mean_i, var_i = posterior(st, x_i[None], dev.cat_mask)
+            herr_i = jnp.where(finite, jnp.abs(mean_i[0] - score_std), 0.0)
+
+            def _ingest():
+                X_new = X.at[n].set(x_i)
+                mask_new = mask.at[n].set(1.0)
+                y_new = y.at[n].set(score)
+                y_std_new = y_std.at[n].set(score_std)
+                # Coverage test on the pre-tell variance (stale by design —
+                # see gp/sparse.py): a poorly-covered point swaps in.
+                any_empty = jnp.any(zmask < 0.5)
+                need_swap = (var_i[0] > SWAP_VAR_FRAC * params.scale) | any_empty
+
+                def _swap():
+                    # Replacement slot: first empty one, else the most
+                    # redundant live point (min nearest-neighbor distance).
+                    zd2 = jnp.sum((Z[:, None, :] - Z[None, :, :]) ** 2, axis=-1)
+                    live_pair = (zmask > 0)[:, None] & (zmask > 0)[None, :]
+                    nn = jnp.min(
+                        jnp.where(live_pair & eye_off, zd2, jnp.inf), axis=1
+                    )
+                    redundant = jnp.argmin(jnp.where(zmask > 0, nn, jnp.inf))
+                    slot = jnp.where(any_empty, jnp.argmin(zmask), redundant)
+                    Z2 = Z.at[slot].set(x_i)
+                    zy2 = zy_s.at[slot].set(score_std)
+                    zmask2 = zmask.at[slot].set(jnp.asarray(1.0, f32))
+                    st2, Lmm2, L_B2, b2, rung2 = sgpr_reduce(
+                        params, Z2, zy2, zmask2, X_new, y_std_new, mask_new,
+                        dev.cat_mask, has_categorical=has_categorical,
+                    )
+                    one = jnp.asarray(1, jnp.int32)
+                    zero = jnp.asarray(0, jnp.int32)
+                    return Z2, zy2, zmask2, st2, Lmm2, L_B2, b2, rung2, one, zero
+
+                def _tell():
+                    st2, L_B2, b2, refac = sparse_tell(
+                        st, Lmm, L_B, b, x_i, score_std, dev.cat_mask
+                    )
+                    zero = jnp.asarray(0, jnp.int32)
+                    return (
+                        Z, zy_s, zmask, st2, Lmm, L_B2, b2,
+                        zero, zero, refac,
+                    )
+
+                (Z2, zy2, zmask2, st2, Lmm2, L_B2, b2, rung_i, swap_i,
+                 refac_i) = jax.lax.cond(need_swap, _swap, _tell)
+                one = jnp.asarray(1, jnp.int32)
+                return (
+                    X_new, y_new, y_std_new, mask_new, Z2, zy2, zmask2,
+                    st2, Lmm2, L_B2, b2,
+                    jnp.maximum(best, score_std), n + 1,
+                    r1 + (one - swap_i) * (one - refac_i),
+                    rf + refac_i, swaps + swap_i, herr + herr_i,
+                    jnp.maximum(rung_max, rung_i), quar,
+                )
+
+            def _quarantine():
+                # Never ingested anywhere: history, factor AND inducing set
+                # are untouched — a NaN can never poison Z.
+                return (
+                    X, y, y_std, mask, Z, zy_s, zmask, st, Lmm, L_B, b,
+                    best, n, r1, rf, swaps, herr, rung_max,
+                    quar + jnp.asarray(1, jnp.int32),
+                )
+
+            carry = jax.lax.cond(finite, _ingest, _quarantine)
+            return carry, (x_i, val, finite)
+
+        zero = jnp.asarray(0, jnp.int32)
+        init = (
+            X, y, y_std, mask, Z, zy_std, zmask, state0, Lmm0, L_B0, b0,
+            best0, n_real, zero, zero, zero, jnp.asarray(0.0, f32), zero, zero,
+        )
+        final, outs = jax.lax.scan(step, init, jnp.arange(chunk_len))
+        (X_f, y_f, _ystd, mask_f, Z_f, zy_f, zmask_f, _st, _Lmm, _LB, _b,
+         _best, n_f, r1, rf, swaps, herr, rung_max, quar) = final
+        xs, vals, finites = outs
+        fill = n_f - n_real
+        m_live = jnp.sum(zmask_f > 0).astype(jnp.int32)
+        n_live = jnp.sum(mask_f > 0)
+        stats = {
+            "gp.ladder_rung": jnp.maximum(rung0, rung_max),
+            "gp.fit_iterations": fit_n_iter,
+            "scan.rank1_updates": r1,
+            "scan.refactorizations": rf,
+            "scan.quarantined": quar,
+            "scan.chunk_fill": fill,
+            "gp.inducing_count": m_live,
+            "gp.sparsity_ratio": m_live.astype(f32)
+            / jnp.maximum(n_live, 1.0).astype(f32),
+            "gp.inducing_swaps": swaps,
+            "gp.sparse_heldout_err": herr / jnp.maximum(fill, 1).astype(f32),
+        }
+        # De-standardize the inducing targets so the host-held buffer is
+        # chunk-invariant (the next chunk re-standardizes with its moments).
+        zy_raw = jnp.where(zmask_f > 0, zy_f * sd + mu, 0.0)
+        return (
+            xs, vals, finites, X_f, y_f, mask_f, n_f,
+            Z_f, zy_raw, zmask_f, raw, stats,
+        )
+
+    compiled = jax.jit(chunk_fn)  # graphlint: ignore[TPU002] -- memoized in the objective's compile cache: one wrapper per (bucket, m_pad, chunk, fit-variant) for the objective's lifetime
+    compiled = flight.instrument_jit(compiled, "scan.chunk")
+    objective._compiled_cache[cache_key] = compiled
+    return compiled
+
+
 def _publish_chunk(stats) -> None:
     """Chunk-boundary observability publish: one harvest per chunk. The
     disabled hot path is a module-global check and allocates nothing per
@@ -404,6 +663,8 @@ def optimize_scan(
     n_preliminary_samples: int = 512,
     n_local_search: int = 4,
     lbfgs_iters: int = 16,
+    n_exact_max: int | None = None,
+    n_inducing: int | None = None,
 ) -> None:
     """Run ``n_trials`` GP-BO trials with the ask/evaluate/tell cycle
     resident in HBM (see the module docstring for the architecture).
@@ -418,6 +679,19 @@ def optimize_scan(
     study bit-for-bit. Non-finite objective values are quarantined in-graph
     (never ingested by the GP) and told FAIL at the chunk sync, matching
     the per-trial executor's ``non_finite='fail'`` policy.
+
+    **Large-n switch.** Once the history would exceed ``n_exact_max``
+    (default :data:`optuna_tpu.gp.sparse.N_EXACT_MAX`), chunks route to the
+    sparse SGPR program (:func:`_chunk_program_sparse`): a fixed-shape
+    inducing set of up to ``n_inducing`` points (default
+    :data:`~optuna_tpu.gp.sparse.N_INDUCING_MAX`; the buffer capacity
+    rounds up to the next power of two for shape stability, and variance
+    swap-ins may fill it) rides the scan carry,
+    tells drop from O(n²) to O(m²) and the chunk-boundary refit from O(n³)
+    to O(nm² + m³·iters). Below the threshold the exact path is
+    bit-identical to before the switch existed. The thresholds are live in
+    ``study._scan_gp_control`` — the autopilot's ``gp.densify`` action
+    adjusts them between chunks when the doctor flags sparse degradation.
     """
     from optuna_tpu.study._study_direction import StudyDirection
 
@@ -433,12 +707,22 @@ def optimize_scan(
 
     if study._thread_local.in_optimize_loop:
         raise RuntimeError("Nested invocation of `optimize_scan` isn't allowed.")
+    from optuna_tpu.gp.sparse import N_EXACT_MAX, N_INDUCING_MAX
+
+    # The live large-n thresholds, readable AND writable between chunks:
+    # the autopilot's ``gp.densify`` action mutates this dict (its only
+    # scan-loop actuator); the loop re-reads it at every chunk boundary.
+    control = {
+        "n_exact_max": N_EXACT_MAX if n_exact_max is None else int(n_exact_max),
+        "n_inducing": N_INDUCING_MAX if n_inducing is None else int(n_inducing),
+    }
+    study._scan_gp_control = control
     study._stop_flag = False
     study._thread_local.in_optimize_loop = True
     health.attach(study)
     # Attach the autopilot at the loop's entry (no-op unless opted in): the
-    # scan loop has no sampler/executor actuators, but an attached observe
-    # loop still diagnoses and logs at every chunk sync.
+    # scan loop's actuator surface is ``study._scan_gp_control`` (the
+    # gp.densify thresholds); everything else is observe-and-log.
     autopilot.attach(study)
     try:
         with _tracing.maybe_trace_from_env():
@@ -455,6 +739,7 @@ def optimize_scan(
                 n_local_search=n_local_search,
                 lbfgs_iters=lbfgs_iters,
                 maximize=study.direction == StudyDirection.MAXIMIZE,
+                control=control,
             )
     finally:
         study._thread_local.in_optimize_loop = False
@@ -475,12 +760,14 @@ def _run_scan(
     n_local_search: int,
     lbfgs_iters: int,
     maximize: bool,
+    control: dict,
 ) -> None:
     import jax
     import jax.numpy as jnp
 
     from optuna_tpu.gp.gp import _bucket
     from optuna_tpu.gp.search_space import SearchSpace
+    from optuna_tpu.gp.sparse import _pow2_bucket
 
     space_dict = objective.search_space
     space = SearchSpace(space_dict)
@@ -547,6 +834,12 @@ def _run_scan(
     warm_raw = None  # previous chunk's fitted raw params (device array)
     chunk_idx = 0
     pending: tuple | None = None  # (xs, vals, finites, stats, n_tell)
+    has_cat = bool(np.any(space.is_categorical))
+    # Sparse-regime carry: the fixed-shape inducing buffers live on the host
+    # loop (device arrays, host references) across chunks. None until the
+    # history first crosses the exact-size threshold.
+    Zb = zyb = zmb = None
+    m_pad = 0
 
     remaining = n_trials - told
     while remaining > 0 and not study._stop_flag:
@@ -572,13 +865,35 @@ def _run_scan(
         else:
             n_starts, fit_iters = _SCAN_WARM_FIT
             starts = jnp.stack([jnp.asarray(default_start), warm_raw])
-        program = _chunk_program(
-            objective, space, dev,
-            chunk_len=sync_every, bucket=bucket, n_starts=n_starts,
-            fit_iters=fit_iters, minimum_noise=minimum_noise,
-            maximize=maximize, n_local_search=n_local_search,
-            lbfgs_iters=lbfgs_iters,
-        )
+        # Large-n routing: re-read the live thresholds every chunk (the
+        # autopilot's gp.densify mutates them between chunks).
+        sparse = n_upper + sync_every > max(1, int(control["n_exact_max"]))
+        if sparse:
+            m_eff = max(1, min(int(control["n_inducing"]), n_upper))
+            m_pad_want = min(_pow2_bucket(m_eff), bucket)
+            if Zb is None or m_pad_want != m_pad:
+                # First sparse chunk (or a densify grew the capacity):
+                # seed/re-seed the inducing set by in-graph farthest-point
+                # over the live history — the Sobol startup block fronts it.
+                m_pad = m_pad_want
+                seeder = _seed_inducing_program(objective, bucket, m_pad)
+                Zb, zyb, zmb = seeder(Xb, yb, mb)
+            program = _chunk_program_sparse(
+                objective, space, dev,
+                chunk_len=sync_every, bucket=bucket, m_pad=m_pad,
+                n_starts=n_starts, fit_iters=fit_iters,
+                minimum_noise=minimum_noise, maximize=maximize,
+                n_local_search=n_local_search, lbfgs_iters=lbfgs_iters,
+                has_categorical=has_cat,
+            )
+        else:
+            program = _chunk_program(
+                objective, space, dev,
+                chunk_len=sync_every, bucket=bucket, n_starts=n_starts,
+                fit_iters=fit_iters, minimum_noise=minimum_noise,
+                maximize=maximize, n_local_search=n_local_search,
+                lbfgs_iters=lbfgs_iters,
+            )
         key = jax.random.fold_in(base_key, chunk_idx)
         chunk_idx += 1
         # Dispatch chunk k+1, THEN sync chunk k: jax dispatch is
@@ -588,9 +903,13 @@ def _run_scan(
         # rides for free.)
         with _tracing.annotate(_TRACE_CHUNK), telemetry.span("scan.chunk"), \
                 flight.span("scan.chunk"):
-            xs, vals, fins, Xb, yb, mb, n_dev, warm_raw, stats = program(
-                starts, Xb, yb, mb, n_dev, key
-            )
+            if sparse:
+                (xs, vals, fins, Xb, yb, mb, n_dev, Zb, zyb, zmb, warm_raw,
+                 stats) = program(starts, Xb, yb, mb, n_dev, Zb, zyb, zmb, key)
+            else:
+                xs, vals, fins, Xb, yb, mb, n_dev, warm_raw, stats = program(
+                    starts, Xb, yb, mb, n_dev, key
+                )
         n_upper += sync_every
         n_tell = min(sync_every, remaining)
         remaining -= n_tell

@@ -171,6 +171,8 @@ class GPSampler(BaseSampler):
         n_local_search: int = 10,
         speculative_chain: int = 0,
         precompile_ahead: bool = True,
+        n_exact_max: int | None = None,
+        n_inducing: int | None = None,
     ) -> None:
         self._rng = LazyRandomState(seed)
         self._independent_sampler = independent_sampler or RandomSampler(seed=seed)
@@ -202,10 +204,47 @@ class GPSampler(BaseSampler):
         # (utils/_compile_cache.py) then makes later processes fully warm.
         self._precompile_ahead = precompile_ahead
         self._precompiled: set[tuple] = set()
+        # Large-n switch (gp/sparse.py): histories past `n_exact_max`
+        # (default gp.sparse.N_EXACT_MAX) route to the SGPR inducing-point
+        # programs with up to `n_inducing` inducing points. None defers to
+        # the module defaults at each use, so the autopilot's gp.densify
+        # ladder and the defaults never fight over a stale copy.
+        self._n_exact_max = n_exact_max
+        self._n_inducing = n_inducing
 
     def reseed_rng(self) -> None:
         self._rng.seed()
         self._independent_sampler.reseed_rng()
+
+    # ------------------------------------------------------- large-n switch
+
+    def _sparse_limits(self) -> tuple[int, int]:
+        """The resolved (exact-size threshold, inducing capacity)."""
+        from optuna_tpu.gp.sparse import N_EXACT_MAX, N_INDUCING_MAX
+
+        limit = N_EXACT_MAX if self._n_exact_max is None else int(self._n_exact_max)
+        m = N_INDUCING_MAX if self._n_inducing is None else int(self._n_inducing)
+        return max(1, limit), max(1, m)
+
+    def autopilot_densify(self):
+        """Autopilot actuator (``gp.densify``): widen the sparse engine one
+        notch — double the inducing capacity up to
+        :data:`~optuna_tpu.gp.sparse.N_INDUCING_MAX`, then (at cap) raise
+        the exact-size threshold out of reach so later fits take the exact
+        posterior. Returns the undo restoring the previous knobs."""
+        from optuna_tpu.gp.sparse import N_INDUCING_MAX
+
+        previous = (self._n_exact_max, self._n_inducing)
+        _, m = self._sparse_limits()
+        if m < N_INDUCING_MAX:
+            self._n_inducing = min(2 * m, N_INDUCING_MAX)
+        else:
+            self._n_exact_max = 10**9
+
+        def undo() -> None:
+            self._n_exact_max, self._n_inducing = previous
+
+        return undo
 
     # ----------------------------------------------------------- search space
 
@@ -319,7 +358,20 @@ class GPSampler(BaseSampler):
                     seed=seed,
                     minimum_noise=1e-7 if self._deterministic else 1e-5,
                     counts=counts,
+                    n_exact_max=self._n_exact_max,
+                    n_inducing=self._n_inducing,
                 )
+            if device_stats.enabled():
+                # The sparse fit reports its inducing stats; the exact fit
+                # reports none (below-threshold asks must stay bit-identical,
+                # including their observability footprint).
+                inducing = {
+                    k: fit_stats[k]
+                    for k in ("gp.inducing_count", "gp.sparsity_ratio")
+                    if k in fit_stats
+                }
+                if inducing:
+                    device_stats.harvest(inducing, trial=trial.number)
             ladder_rungs = [fit_stats["gp.ladder_rung"]]
             self._kernel_params_cache[sig] = [raw_params]
             best = float(np.max(yc))
@@ -522,7 +574,7 @@ class GPSampler(BaseSampler):
         # rung, fallback coords, best acq — optuna_tpu.device_stats) that
         # says what the indivisible dispatch actually spent its time on.
         with _tracing.annotate(_TRACE_FIT), telemetry.span("ask.fit"), flight.span("ask.fit"):
-            starts, Xp, yp, maskp, inc, _, fit_iters = self._fused_inputs(
+            starts, Xp, yp, maskp, inc, n, fit_iters = self._fused_inputs(
                 study, space, X, trials, warm
             )
         minimum_noise = 1e-7 if self._deterministic else 1e-5
@@ -532,6 +584,22 @@ class GPSampler(BaseSampler):
             dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
             dev.dim_onehot, dev.choice_grid, dev.choice_valid,
         )
+        n_exact_max, _ = self._sparse_limits()
+        if n > n_exact_max:
+            # Large-n switch: the SGPR inducing-point twin of the fused
+            # program (gp/sparse.py). Same packed args, q=1; the jit +
+            # persistent compile cache warm it (no AOT hand-off — the
+            # sparse programs are per-(bucket, m_pad), already log-bounded).
+            with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
+                xs, _vs, raw, dev_stats = self._sparse_call(
+                    args, is_cat, n, q=1, fit_iters=fit_iters, dev=dev
+                )
+            self._kernel_params_cache[sig] = [np.asarray(raw)]
+            device_stats.harvest(dev_stats)
+            from optuna_tpu.gp.optim_mixed import snap_steps
+
+            x_np = snap_steps(space, np.asarray(xs[0], dtype=np.float64))
+            return space.unnormalize_one(x_np)
         with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
             out = self._aot_call(
                 self._exec_key(
@@ -558,6 +626,26 @@ class GPSampler(BaseSampler):
         x_np = snap_steps(space, np.asarray(x_best, dtype=np.float64))
         return space.unnormalize_one(x_np)
 
+    def _sparse_call(self, args, is_cat, n: int, *, q: int, fit_iters: int, dev):
+        """Dispatch the SGPR fused program (gp/sparse.py) for a history of
+        ``n`` real rows: the inducing capacity is the configured cap,
+        power-of-two padded for shape stability (one program per
+        (bucket, m_pad, q), compile count stays log-bounded)."""
+        from optuna_tpu.gp.sparse import _pow2_bucket, gp_suggest_sparse_fused
+
+        _, m_cap = self._sparse_limits()
+        m_pad = _pow2_bucket(max(1, min(m_cap, n)))
+        n_local = self._n_local_search if q == 1 else min(self._n_local_search, 6)
+        return gp_suggest_sparse_fused(
+            *args,
+            q=q,
+            m_pad=m_pad,
+            n_local_search=n_local,
+            fit_iters=fit_iters,
+            has_sweep=dev.has_sweep,
+            has_categorical=bool(np.any(is_cat)),
+        )
+
     def _sample_chain(
         self, study, space, search_space, X, is_cat, trials, warm, sig, seed, q
     ) -> list[dict[str, Any]]:
@@ -574,6 +662,28 @@ class GPSampler(BaseSampler):
                 study, space, X, trials, warm, pad_extra=q
             )
         minimum_noise = 1e-7 if self._deterministic else 1e-5
+        n_exact_max, _ = self._sparse_limits()
+        if n > n_exact_max:
+            # Large-n switch: the sparse program's kriging-believer chain
+            # tells each fantasy by an O(m^2) additive factor raise instead
+            # of an O(n^2) row append (gp/sparse.py).
+            sargs = (
+                starts, Xp, yp, dev.cat_mask, maskp, dev.sobol_base, inc,
+                jax.random.PRNGKey(seed), minimum_noise,
+                dev.cont_mask, dev.lower, dev.upper, dev.n_choices, dev.steps,
+                dev.dim_onehot, dev.choice_grid, dev.choice_valid,
+            )
+            with _tracing.annotate(_TRACE_PROPOSE), telemetry.span("ask.propose"), flight.span("ask.propose"):
+                xs, _vs, raw, dev_stats = self._sparse_call(
+                    sargs, is_cat, n, q=q, fit_iters=fit_iters, dev=dev
+                )
+            self._kernel_params_cache[sig] = [np.asarray(raw)]
+            device_stats.harvest(dev_stats)
+            xs_np = np.asarray(xs, dtype=np.float64)
+            return [
+                space.unnormalize_one(snap_steps(space, xs_np[i]))
+                for i in range(len(xs_np))
+            ]
         args = (
             starts, Xp, yp, dev.cat_mask, maskp, jnp.asarray(n, jnp.int32),
             dev.sobol_base, inc, jax.random.PRNGKey(seed), minimum_noise,

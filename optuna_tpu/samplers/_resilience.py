@@ -218,6 +218,67 @@ def ladder_cholesky_rank1_update(L, k_row, slot, kernel_fn, *,
     return jax.lax.cond(ok, _incremental, _refactor)
 
 
+def ladder_cholesky_rank1_raise(L, v, kernel_fn, *,
+                                initial_jitter: float = _LADDER_INITIAL_JITTER):
+    """Additive rank-1 update of a ladder-Cholesky factor: the ``L'`` with
+    ``L'L'ᵀ = LLᵀ + vvᵀ`` in O(n²) — the *raise* twin of the row-append
+    :func:`ladder_cholesky_rank1_update`.
+
+    The sparse-GP scan path (:mod:`optuna_tpu.gp.sparse`) tells by adding
+    ``σ⁻²·k_m(x)·k_m(x)ᵀ`` to the m×m information matrix
+    ``A = Kmm + σ⁻²·Kmf·Kfm`` — a *sum* update to an existing factor, not a
+    dimension append, so the Schur-pivot append above does not apply. This
+    is the classical LINPACK ``dchud`` sweep: one Givens-style rotation per
+    column, carried through a ``lax.fori_loop`` (O(n) sequential steps of
+    O(n) vector work).
+
+    Health verdict is checked **in-graph**, matching the append twin: the
+    additive update of a positive-definite matrix cannot mathematically
+    lose positivity, so a non-finite entry or non-positive diagonal after
+    the sweep means f32 round-off on an ill-conditioned factor — a
+    ``lax.cond`` then falls back to a full
+    :func:`ladder_cholesky_with_rung` refactorization of ``kernel_fn()``
+    (built lazily on the fallback branch only). No host sync either way.
+
+    Returns ``(L_new, rung, refactored)`` with the same meaning as the
+    append twin, so callers feed the same device-stat channels.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = L.shape[-1]
+    idx = jnp.arange(n)
+
+    def body(k, carry):
+        Lc, w = carry
+        lkk = jnp.take(jnp.diagonal(Lc), k)
+        wk = jnp.take(w, k)
+        r = jnp.sqrt(lkk * lkk + wk * wk)
+        c = r / lkk
+        s = wk / lkk
+        col = Lc[:, k]
+        below = idx > k
+        new_col = jnp.where(below, (col + s * w) / c, col)
+        new_col = jnp.where(idx == k, r, new_col)
+        w_new = jnp.where(below, c * w - s * new_col, w)
+        return Lc.at[:, k].set(new_col), w_new
+
+    L_try, _ = jax.lax.fori_loop(0, n, body, (L, v))
+    ok = jnp.all(jnp.isfinite(L_try)) & jnp.all(jnp.diagonal(L_try) > 0)
+
+    def _incremental():
+        zero = jnp.asarray(0, jnp.int32)
+        return L_try, zero, zero
+
+    def _refactor():
+        L_new, rung = ladder_cholesky_with_rung(
+            kernel_fn(), initial_jitter=initial_jitter
+        )
+        return L_new, rung, jnp.asarray(1, jnp.int32)
+
+    return jax.lax.cond(ok, _incremental, _refactor)
+
+
 def clip_objective_values(values: np.ndarray) -> np.ndarray:
     """Clip ``±inf`` (and beyond-float32 magnitudes like ``1e308``) to the
     float32 extremes so a mean/std standardization stays finite end to end.
@@ -417,6 +478,20 @@ class GuardedSampler(BaseSampler):
                 self._pins.pop(token)
                 self._pin_reasons.pop(token, None)
         return True
+
+    def autopilot_densify(self):
+        """Delegate the ``gp.densify`` actuator to the wrapped sampler.
+
+        Containment is orthogonal to posterior density: the sparse reduced
+        state quacks like an exact ``GPState``, so the guard keeps working
+        unchanged after the inner engine widens or falls back to exact.
+        """
+        inner = getattr(self._sampler, "autopilot_densify", None)
+        if inner is None:
+            raise AttributeError(
+                f"{type(self._sampler).__name__} has no sparse-GP engine to densify"
+            )
+        return inner()
 
     # -------------------------------------------------------------- plumbing
 

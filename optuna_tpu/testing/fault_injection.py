@@ -243,6 +243,14 @@ DEVICE_STAT_CHAOS_MATRIX: dict[str, str] = {
     "gp.proposal_fallback_coords": "fault-free fused ask; the count matches the plan exactly (0 — "
     "no coordinate walked non-finite)",
     "gp.best_acq": "run a fused GP ask; the reported best acquisition value is finite",
+    "gp.inducing_count": "run a sparse fused ask above the exact-size threshold; the reported "
+    "count is >= 1 and <= the inducing capacity, the below-threshold twin never reports it",
+    "gp.sparsity_ratio": "run a sparse fused ask with n real rows and capacity m < n; the "
+    "reported ratio equals m/n within f32 tolerance",
+    "gp.inducing_swaps": "run a sparse scan chunk on a drifting objective; swap-ins report >= 0 "
+    "and equal the SGPR rebuilds the chunk performed",
+    "gp.sparse_heldout_err": "run a sparse scan chunk; the reported one-step-ahead residual is "
+    "finite and non-negative (an exactly-predicted chunk reports ~0)",
     "executor.quarantined": "inject NaN at scheduled batch slots; the harvested total equals the "
     "plan's slot count exactly, the fault-free twin reports 0",
     "scan.rank1_updates": "run a fault-free scan study on a well-conditioned objective; updates "
@@ -337,6 +345,8 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "floor; the labels are named in the finding",
     "gp.ladder_escalation": "publish device.gp.ladder_rung.max at the escalation rung; "
     "the gauge alone flags",
+    "gp.sparse_degraded": "publish device.gp.sparse_heldout_err.last at/above the "
+    "standardized-unit threshold; the gauge alone flags, the well-covered twin stays clean",
     "worker.dead": "plant a stale worker snapshot (plant_dead_worker — what a SIGKILL'd "
     "worker leaves); liveness derives dead from snapshot age vs interval",
     "shard.imbalance": "publish shard.trials.<coord> throughput gauges with one shard >= 2x "
@@ -470,6 +480,10 @@ AUTOPILOT_CHAOS_MATRIX: dict[str, str] = {
     "service.shed_earlier": "count shed asks past the backpressure threshold against a "
     "live hub; the action halves the ShedPolicy thresholds, doubles ready-queue prewarm, "
     "and the undo restores both exactly",
+    "gp.densify": "publish device.gp.sparse_heldout_err.last past the degradation "
+    "threshold against a study carrying a scan-loop control dict; the action doubles its "
+    "inducing capacity (exact-posterior fallback once at cap) and the undo restores the "
+    "previous thresholds exactly",
 }
 
 

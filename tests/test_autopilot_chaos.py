@@ -549,6 +549,79 @@ def test_doctor_gains_a_would_act_column_when_autopilot_is_configured(
     assert "would act" not in capsys.readouterr().out
 
 
+def test_densify_widens_the_sparse_engine_and_undo_restores_exactly():
+    """gp.densify: a sparse-GP study whose published held-out error crosses
+    the standardized-unit threshold doubles the scan loop's inducing
+    capacity through the control dict actuator; an error that keeps growing
+    (widening did not help) rolls the dict back to its exact prior value."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    study._scan_gp_control = {"n_exact_max": 2048, "n_inducing": 64}
+    before = dict(study._scan_gp_control)
+    pilot = _direct_pilot(study)
+    telemetry.set_gauge(
+        "device.gp.sparse_heldout_err.last", health.SPARSE_HELDOUT_ERR_WARN
+    )
+    telemetry.set_gauge("device.gp.inducing_count.last", 64.0)
+    telemetry.set_gauge("device.gp.sparsity_ratio.last", 64.0 / 4096.0)
+    decided = pilot.step()
+    assert [r.action for r in decided] == ["gp.densify"]
+    assert study._scan_gp_control == {"n_exact_max": 2048, "n_inducing": 128}
+
+    # Coverage keeps degrading after the widen: the rollback pass restores
+    # the control dict bit-exactly.
+    _complete_trials(study, 2)
+    telemetry.set_gauge(
+        "device.gp.sparse_heldout_err.last",
+        health.SPARSE_HELDOUT_ERR_WARN * 2.0,
+    )
+    pilot.step()
+    assert decided[0].state == "rolled_back"
+    assert study._scan_gp_control == before
+
+
+def test_densify_at_capacity_falls_back_to_the_exact_posterior():
+    """The top rung of the densify ladder: once the inducing capacity is at
+    N_INDUCING_MAX the action raises the exact-size threshold out of reach
+    instead of doubling further, and the undo restores both knobs."""
+    from optuna_tpu.gp.sparse import N_INDUCING_MAX
+
+    control = {"n_exact_max": 2048, "n_inducing": N_INDUCING_MAX}
+    before = dict(control)
+    undo = autopilot._densify(control)
+    assert control["n_inducing"] == N_INDUCING_MAX
+    assert control["n_exact_max"] == autopilot._DENSIFY_EXACT_LIMIT
+    undo()
+    assert control == before
+
+
+def test_densify_resolves_the_sampler_when_no_scan_control_is_registered():
+    """A per-trial study exposes the knob through its (Guarded-wrapped)
+    sampler; a bare RandomSampler study records no_target, never a guess."""
+    from optuna_tpu.samplers import GPSampler
+
+    study = optuna_tpu.create_study(
+        sampler=GuardedSampler(GPSampler(seed=0, n_exact_max=32, n_inducing=16))
+    )
+    pilot = _direct_pilot(study)
+    telemetry.set_gauge(
+        "device.gp.sparse_heldout_err.last", health.SPARSE_HELDOUT_ERR_WARN
+    )
+    decided = pilot.step()
+    assert [r.action for r in decided] == ["gp.densify"]
+    assert decided[0].state == "executed"
+    inner = study.sampler.sampler
+    assert (inner._n_exact_max, inner._n_inducing) == (32, 32)
+
+    bare = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    bare_pilot = _direct_pilot(bare)
+    telemetry.set_gauge(
+        "device.gp.sparse_heldout_err.last", health.SPARSE_HELDOUT_ERR_WARN
+    )
+    bare_decided = bare_pilot.step()
+    assert [r.action for r in bare_decided] == ["gp.densify"]
+    assert bare_decided[0].state == "no_target"
+
+
 def test_chaos_matrix_names_every_action():
     """Belt and braces beside ACT001's static check: the runtime matrix
     covers the runtime vocabulary exactly, every trigger is a doctor

@@ -1,0 +1,344 @@
+"""Large-n sparse GP engine (gp/sparse.py + the scan/sampler switches):
+SGPR-vs-exact posterior parity at full inducing coverage, the pathological-
+history resilience matrix through the sparse fit, bit-identity below the
+switch threshold, NaN-quarantine containment of the inducing set, the
+GuardedSampler wrap, and the four sparse device-stat scenarios of
+``DEVICE_STAT_CHAOS_MATRIX``.
+
+Documented parity tolerance (asserted here, quoted by ARCHITECTURE.md):
+with every history point inducing (Z = X) the whitened-Titsias posterior
+matches the exact posterior to ~1e-2 in mean and ~1e-2 in variance on a
+history whose fitted noise is realistic (sigma ~ 0.05). The tolerance
+degrades as the fitted noise approaches the f32 floor — the whitened Gram
+carries w = 1/noise, so a ~1e-5 noise floor amplifies f32 rounding ~1e5x —
+which is why the sparse engine targets noisy large-n regimes and the exact
+engine keeps everything below the threshold. With m < n the approximation
+is variational, so parity claims become containment claims (finite,
+bounded-rung, honest variance saturation).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import device_stats, flight, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.models.benchmarks import hartmann6_jax
+from optuna_tpu.parallel import VectorizedObjective, optimize_scan
+from optuna_tpu.samplers import GPSampler
+from optuna_tpu.samplers._resilience import GuardedSampler
+from optuna_tpu.testing.fault_injection import PATHOLOGICAL_HISTORY_PLANS
+from optuna_tpu.trial._state import TrialState
+
+optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+
+SPACE3 = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(3)}
+SPACE6 = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(6)}
+
+MEAN_ATOL = 2e-2  # the documented Z=X mean tolerance (see module docstring)
+VAR_ATOL = 2e-2  # the documented Z=X variance tolerance
+
+
+@pytest.fixture(autouse=True)
+def _observability_off():
+    telemetry.disable()
+    flight.disable()
+    yield
+    telemetry.disable()
+    flight.disable()
+
+
+def _smooth_history(n: int, d: int, seed: int = 0):
+    """A smooth target plus sigma=0.05 observation noise: the fitted noise
+    stays well above the f32 floor, the regime the documented parity
+    tolerance is quoted for (see module docstring)."""
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(0.0, 1.0, size=(n, d)).astype(np.float32)
+    y = (
+        np.sin(3.0 * X[:, 0])
+        + 0.5 * np.cos(2.0 * X[:, 1 % d])
+        + 0.05 * rng.normal(size=n)
+    )
+    return X, y.astype(np.float32)
+
+
+# ------------------------------------------------------------------ parity
+
+
+def test_sgpr_posterior_matches_exact_at_full_inducing_coverage():
+    """Z = X: the Titsias posterior is mathematically the exact posterior;
+    the whitened f32 factorization must reproduce it within the documented
+    tolerance on both mean and variance."""
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp import sparse as gps
+    from optuna_tpu.gp.gp import fit_gp, posterior
+
+    X, y = _smooth_history(48, 3)
+    is_cat = np.zeros(3, dtype=bool)
+    state, _raw, _stats = fit_gp(X, y, is_cat)
+
+    cat_mask = jnp.zeros(3, dtype=bool)
+    sp_state, _Lmm, _L_B, _b, rung = gps.sgpr_reduce(
+        state.params, state.X, state.y, state.mask, state.X, state.y,
+        state.mask, cat_mask,
+    )
+    q = jnp.asarray(_smooth_history(32, 3, seed=9)[0])
+    mean_e, var_e = posterior(state, q, cat_mask)
+    mean_s, var_s = posterior(sp_state, q, cat_mask)
+    np.testing.assert_allclose(
+        np.asarray(mean_s), np.asarray(mean_e), atol=MEAN_ATOL
+    )
+    np.testing.assert_allclose(
+        np.asarray(var_s), np.asarray(var_e), atol=VAR_ATOL
+    )
+    assert int(rung) <= 2
+
+
+def test_sparse_tell_matches_rebuilt_posterior_mean():
+    """The O(m²) incremental tell and a from-scratch sgpr_reduce over the
+    grown history agree on the posterior mean within f32 accumulation."""
+    import jax.numpy as jnp
+
+    from optuna_tpu.gp import sparse as gps
+    from optuna_tpu.gp.gp import fit_gp, posterior
+
+    X, y = _smooth_history(40, 3)
+    is_cat = np.zeros(3, dtype=bool)
+    state, _raw, _stats = fit_gp(X, y, is_cat)
+    cat_mask = jnp.zeros(3, dtype=bool)
+
+    sp, Lmm, L_B, b, _ = gps.sgpr_reduce(
+        state.params, state.X, state.y, state.mask, state.X, state.y,
+        state.mask, cat_mask,
+    )
+    x_new = jnp.asarray(np.full(3, 0.37, np.float32))
+    y_new = jnp.asarray(np.float32(0.8))
+    sp2, L_B2, b2, refac = gps.sparse_tell(sp, Lmm, L_B, b, x_new, y_new, cat_mask)
+    assert int(refac) == 0  # well-conditioned: the rank-1 raise sticks
+
+    # Rebuild from scratch with the new row appended to the full history.
+    N = state.X.shape[0]
+    Xg = np.asarray(state.X).copy()
+    yg = np.asarray(state.y).copy()
+    mg = np.asarray(state.mask).copy()
+    slot = int(mg.sum())
+    assert slot < N  # padded bucket has room
+    Xg[slot], yg[slot], mg[slot] = np.asarray(x_new), float(y_new), 1.0
+    sp_ref, *_ = gps.sgpr_reduce(
+        state.params, state.X, state.y, state.mask, jnp.asarray(Xg),
+        jnp.asarray(yg), jnp.asarray(mg), cat_mask,
+    )
+    q = jnp.asarray(_smooth_history(16, 3, seed=11)[0])
+    mean_inc, _ = posterior(sp2, q, cat_mask)
+    mean_ref, _ = posterior(sp_ref, q, cat_mask)
+    np.testing.assert_allclose(
+        np.asarray(mean_inc), np.asarray(mean_ref), atol=MEAN_ATOL
+    )
+
+
+@pytest.mark.parametrize(
+    "plan", PATHOLOGICAL_HISTORY_PLANS, ids=lambda p: p.name
+)
+def test_pathological_history_matrix_through_the_sparse_fit(plan):
+    """Every degenerate history the exact engine must survive, the sparse
+    engine must survive too: seeded with the pathology and forced over the
+    switch threshold, a GPSampler study finishes a fresh budget with finite
+    params and zero aborts — the same contract test_sampler_faults.py pins
+    for the exact path."""
+    sampler = GPSampler(
+        seed=0, n_startup_trials=2, n_exact_max=max(2, plan.n_trials - 2),
+        n_inducing=4, precompile_ahead=False,
+    )
+    study = optuna_tpu.create_study(sampler=sampler)
+    plan.populate(study, SPACE3, seed=0)
+
+    def objective(trial):
+        return sum(
+            (trial.suggest_float(k, 0.0, 1.0) - 0.5) ** 2 for k in SPACE3
+        )
+
+    study.optimize(objective, n_trials=6)
+    fresh = study.trials[plan.n_trials:]
+    assert len(fresh) == 6
+    for t in fresh:
+        assert t.state == TrialState.COMPLETE
+        assert all(np.isfinite(v) for v in t.params.values())
+
+
+# ------------------------------------------------------- switch threshold
+
+
+def test_below_threshold_is_bit_identical_to_the_exact_engine():
+    """The large-n switch is a host-side size check: a sampler carrying
+    sparse knobs that are never crossed proposes bit-identically to the
+    stock exact sampler, trial for trial."""
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0.0, 1.0)
+        y = trial.suggest_float("y", 0.0, 1.0)
+        return (x - 0.3) ** 2 + (y - 0.7) ** 2
+
+    runs = []
+    for sampler in (
+        GPSampler(seed=7, n_startup_trials=4, precompile_ahead=False),
+        GPSampler(
+            seed=7, n_startup_trials=4, n_exact_max=64, n_inducing=8,
+            precompile_ahead=False,
+        ),
+    ):
+        study = optuna_tpu.create_study(sampler=sampler)
+        study.optimize(objective, n_trials=14)
+        runs.append([tuple(sorted(t.params.items())) for t in study.trials])
+    assert runs[0] == runs[1]
+
+
+def test_guarded_sampler_wraps_the_sparse_engine_identically():
+    """Containment is orthogonal to posterior density: a GuardedSampler-
+    wrapped sparse engine proposes exactly what the bare one proposes on a
+    fault-free run (the guard only reroutes on faults)."""
+
+    def objective(trial):
+        x = trial.suggest_float("x", 0.0, 1.0)
+        y = trial.suggest_float("y", 0.0, 1.0)
+        return (x - 0.3) ** 2 + (y - 0.7) ** 2
+
+    runs = []
+    for wrap in (False, True):
+        sampler = GPSampler(
+            seed=0, n_startup_trials=4, n_exact_max=8, n_inducing=6,
+            precompile_ahead=False,
+        )
+        if wrap:
+            sampler = GuardedSampler(sampler)
+        study = optuna_tpu.create_study(sampler=sampler)
+        study.optimize(objective, n_trials=16)
+        runs.append([tuple(sorted(t.params.items())) for t in study.trials])
+    assert runs[0] == runs[1]
+
+
+# ------------------------------------------------- scan-loop sparse chaos
+
+
+def _poison_objective(threshold: float = 0.35):
+    import jax.numpy as jnp
+
+    def fn(params):
+        vals = hartmann6_jax(params)
+        return jnp.where(params["x0"] < threshold, jnp.nan, vals)
+
+    return VectorizedObjective(fn=fn, search_space=dict(SPACE6))
+
+
+def test_nan_quarantine_never_enters_the_inducing_set():
+    """Sparse scan chaos: NaN slots are quarantined by the in-graph verdict
+    and told FAIL — device channel == storage truth == containment counter —
+    and the inducing set never ingests them: the held-out error and every
+    inducing gauge stay finite, the swap counter equals the SGPR rebuilds,
+    and no COMPLETE trial carries a non-finite value."""
+    telemetry.enable(telemetry.get_registry())
+    telemetry.reset()
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study, _poison_objective(), n_trials=48, sync_every=8,
+        n_startup_trials=8, seed=3, n_exact_max=12, n_inducing=8,
+    )
+    trials = study.trials
+    states = Counter(t.state for t in trials)
+    assert states.get(TrialState.RUNNING, 0) == 0
+    n_fail = states.get(TrialState.FAIL, 0)
+    assert n_fail > 0  # the poison region was hit
+    gauges = device_stats.stat_gauges()
+    scan_quar = int(gauges.get("device.scan.quarantined.total", 0))
+    startup_fails = sum(1 for t in trials[:8] if t.state == TrialState.FAIL)
+    assert scan_quar == n_fail - startup_fails
+    assert telemetry.get_registry().counter_value("executor.quarantine") == n_fail
+    # The inducing channel stayed clean through the storm.
+    m_live = gauges.get("device.gp.inducing_count.last")
+    assert m_live is not None and 1 <= m_live <= 16  # pow2 pad of 8
+    herr = gauges.get("device.gp.sparse_heldout_err.last")
+    assert herr is not None and np.isfinite(herr) and herr >= 0.0
+    for t in trials:
+        if t.state == TrialState.COMPLETE:
+            assert np.isfinite(t.value)
+        else:
+            assert "quarantined" in t.system_attrs["fail_reason"]
+
+
+# --------------------------------------- DEVICE_STAT_CHAOS_MATRIX scenarios
+
+
+_SCAN_RUNS: dict = {}
+
+
+def _sparse_scan_study(*, n_exact_max: int, n_trials: int = 88):
+    """Run (once per arg tuple, memoized module-wide — three tests assert
+    different contracts on the same steady-state run) and return
+    ``(study, stat_gauges_snapshot)`` captured right after the run."""
+    key = (n_exact_max, n_trials)
+    if key not in _SCAN_RUNS:
+        telemetry.enable(telemetry.get_registry())
+        telemetry.reset()
+        study = optuna_tpu.create_study()
+        optimize_scan(
+            study,
+            VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6)),
+            n_trials=n_trials, sync_every=8, n_startup_trials=8, seed=1,
+            n_exact_max=n_exact_max, n_inducing=16,
+        )
+        _SCAN_RUNS[key] = (study, device_stats.stat_gauges())
+    return _SCAN_RUNS[key]
+
+
+def test_sparse_device_stats_report_the_regime_and_twin_reports_none():
+    """The four sparse rows of DEVICE_STAT_CHAOS_MATRIX: an above-threshold
+    scan publishes inducing_count in [1, capacity], sparsity_ratio == count
+    over live history within f32 tolerance, a non-negative swap total, and
+    a finite non-negative held-out error; the below-threshold twin (same
+    study shape, threshold out of reach) never reports any of the four."""
+    study, gauges = _sparse_scan_study(n_exact_max=12)
+    count = gauges.get("device.gp.inducing_count.last")
+    assert count is not None and 1 <= count <= 16
+    n_live = sum(1 for t in study.trials if t.state == TrialState.COMPLETE)
+    ratio = gauges.get("device.gp.sparsity_ratio.last")
+    # The gauge is count / live-history-rows *at the last chunk boundary*;
+    # re-derive loosely: within one chunk of the final tally.
+    assert ratio is not None and 0.0 < ratio <= 1.0
+    assert abs(ratio - count / n_live) < count * 8.0 / max(n_live - 8, 1) / n_live + 1e-6
+    swaps = gauges.get("device.gp.inducing_swaps.total")
+    assert swaps is not None and swaps >= 0 and float(swaps).is_integer()
+    herr = gauges.get("device.gp.sparse_heldout_err.last")
+    assert herr is not None and np.isfinite(herr) and herr >= 0.0
+
+    _, twin = _sparse_scan_study(n_exact_max=10**9, n_trials=24)
+    for stat in (
+        "device.gp.inducing_count.last",
+        "device.gp.sparsity_ratio.last",
+        "device.gp.inducing_swaps.total",
+        "device.gp.sparse_heldout_err.last",
+    ):
+        assert stat not in twin
+
+
+def test_sparse_scan_steady_state_has_zero_full_refits():
+    """The acceptance evidence behind the n=4096 bench: on well-conditioned
+    history the sparse scan's warm-up swap-ins settle and every later tell
+    is an O(m²) rank-1 raise — zero full refactorizations across the study
+    and a bounded ladder rung."""
+    study, gauges = _sparse_scan_study(n_exact_max=12)
+    assert int(gauges["device.scan.refactorizations.total"]) == 0
+    assert int(gauges["device.scan.rank1_updates.total"]) > 0
+    assert int(gauges.get("device.gp.ladder_rung.max", 0)) <= 2
+    best = min(t.value for t in study.trials if t.state == TrialState.COMPLETE)
+    assert best < -1.0  # the sparse posterior still optimizes hartmann6
+
+
+def test_scan_storage_contract_holds_through_the_sparse_switch():
+    from tests.test_scan_loop import _assert_per_trial_path_state
+
+    study, _ = _sparse_scan_study(n_exact_max=12)
+    _assert_per_trial_path_state(study, 88, SPACE6)

@@ -465,6 +465,33 @@ def test_ladder_escalation_gauge():
     assert [f.check for f in findings] == ["gp.ladder_escalation"]
 
 
+def test_sparse_degraded_gauge_threshold_and_override():
+    """gp.sparse_degraded thresholds the one-step-ahead held-out error
+    gauge: below the standardized-unit bar (or absent: exact engine) is
+    silent, at the bar it flags with the inducing evidence, and the
+    kw-override tightens the bar without touching the module constant."""
+    assert health.diagnose(_fleet(), [], MIN) == []
+    below = _fleet(gauges={
+        "device.gp.sparse_heldout_err.last": health.SPARSE_HELDOUT_ERR_WARN - 0.01,
+    })
+    assert health.diagnose(below, [], MIN) == []
+    at = _fleet(gauges={
+        "device.gp.sparse_heldout_err.last": health.SPARSE_HELDOUT_ERR_WARN,
+        "device.gp.inducing_count.last": 128.0,
+        "device.gp.sparsity_ratio.last": 0.03125,
+    })
+    findings = health.diagnose(at, [], MIN)
+    assert [f.check for f in findings] == ["gp.sparse_degraded"]
+    assert findings[0].severity == "WARNING"
+    assert findings[0].evidence == {
+        "heldout_err": health.SPARSE_HELDOUT_ERR_WARN,
+        "inducing_count": 128.0,
+        "sparsity_ratio": 0.03125,
+    }
+    tightened = health.diagnose(below, [], MIN, sparse_heldout_err_warn=0.5)
+    assert [f.check for f in tightened] == ["gp.sparse_degraded"]
+
+
 def test_dead_worker_finding_and_severity_ordering():
     workers = [
         {"worker": "a", "alive": True, "age_s": 1.0},
@@ -723,6 +750,18 @@ def _trajectory_file(tmp_path):
                     "slo": "ok",
                 },
             },
+            {
+                "round": "local-5", "captured": "2026-08-07T00:00:00",
+                "metric": "gp_scan_trials_per_sec_hartmann20d_n4096",
+                "mode": "quick", "platform": "cpu", "value": 5.5,
+                "device_stats": {
+                    "max_ladder_rung": 0, "fit_iterations": 64,
+                    "quarantined": 0, "scan_rank1_updates": 120,
+                    "scan_refactorizations": 0, "inducing_count": 64,
+                    "sparsity_ratio": 0.1702, "inducing_swaps": 3,
+                    "sparse_heldout_err": 0.41,
+                },
+            },
         ],
     }
     path = tmp_path / "BENCH_TRAJECTORY.json"
@@ -744,11 +783,14 @@ def test_trajectory_cli_table_and_json(tmp_path, capsys):
     # ok|burn verdict beside the wall-clock figures (ISSUE 14).
     assert "p99=2.16ms/1cl=23.4ms q=250/6 w=48" in table
     assert "sk99=2.3ms" in table and "slo=ok" in table
+    # Large-n sparse-engine entries condense the inducing regime beside the
+    # tell-path split (bench --loop=scan --trials=N, ISSUE 18).
+    assert "r1=120/rf=0 ind=64 sp=0.1702" in table
 
     assert cli_main(["trajectory", "--path", path, "-f", "json"]) == 0
     payload = json.loads(capsys.readouterr().out)
     assert [e["round"] for e in payload["entries"]] == [
-        "r03", "r04", "r05", "local-4",
+        "r03", "r04", "r05", "local-4", "local-5",
     ]
     assert payload["entries"][1]["device_stats"]["fit_iterations"] == 120
     assert payload["entries"][3]["serve"]["serve_ask_p99_ms"] == 2.16

@@ -288,6 +288,42 @@ def test_duplicate_proposals_flag_on_retry_clone_history():
     assert report["findings"][0]["evidence"]["duplicates"] == clones.n_trials // 2
 
 
+def test_sparse_degradation_flags_through_the_fleet_channel():
+    """gp.sparse_degraded (DEVICE_STAT/HEALTH chaos matrix): a published
+    held-out-error gauge at the standardized-unit threshold flags with the
+    inducing evidence attached; the well-covered twin (same engine, error
+    below threshold) stays clean."""
+    study = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _publish_snapshot(
+        study, "w1",
+        gauges={
+            "device.gp.sparse_heldout_err.last": health.SPARSE_HELDOUT_ERR_WARN,
+            "device.gp.inducing_count.last": 64.0,
+            "device.gp.sparsity_ratio.last": 64.0 / 4096.0,
+        },
+    )
+    report = health.health_report(
+        study._storage, study._study_id, now=1_000_000.0
+    )
+    assert [f["check"] for f in report["findings"]] == ["gp.sparse_degraded"]
+    evidence = report["findings"][0]["evidence"]
+    assert evidence["heldout_err"] == health.SPARSE_HELDOUT_ERR_WARN
+    assert evidence["inducing_count"] == 64.0
+    assert evidence["sparsity_ratio"] == 64.0 / 4096.0
+
+    twin = optuna_tpu.create_study(sampler=RandomSampler(seed=0))
+    _publish_snapshot(
+        twin, "w1",
+        gauges={
+            "device.gp.sparse_heldout_err.last":
+                health.SPARSE_HELDOUT_ERR_WARN / 2.0,
+            "device.gp.inducing_count.last": 64.0,
+        },
+    )
+    clean = health.health_report(twin._storage, twin._study_id, now=1_000_000.0)
+    assert clean["findings"] == []
+
+
 def test_chaos_matrix_names_every_check():
     """Belt and braces beside OBS004's static check: the runtime matrix
     covers the runtime vocabulary exactly, and this module plus

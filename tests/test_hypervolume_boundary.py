@@ -1,0 +1,79 @@
+"""The WFG_MIN_OBJECTIVES boundary (ops/hypervolume.py): three-way parity
+at M = 4 (last slicing regime) and M = 5 (first WFG regime) between the
+slicing decomposition, the WFG stack machine, and the host NumPy oracle —
+the test the constant's docstring points at. The boundary is a pure
+performance crossover: both device engines must be exact on both sides of
+it, so moving the constant can never change results, only throughput.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from optuna_tpu.hypervolume.wfg import _compute_hv_recursive
+from optuna_tpu.ops.hypervolume import (
+    WFG_MIN_OBJECTIVES,
+    _hssp_greedy,
+    _padded,
+    hypervolume_masked,
+    solve_hssp_device,
+)
+from optuna_tpu.ops.wfg import hypervolume_wfg
+
+
+def _front(n: int, m: int, seed: int) -> np.ndarray:
+    """A noisy spherical front: mostly non-dominated with a few dominated
+    stragglers, the shape HSSP scoring actually sees."""
+    rng = np.random.RandomState(seed)
+    raw = rng.uniform(0.1, 1.0, size=(n, m))
+    pts = raw / np.linalg.norm(raw, axis=1, keepdims=True)
+    pts += rng.uniform(0.0, 0.05, size=(n, m))
+    return pts.astype(np.float32)
+
+
+def test_boundary_is_the_documented_constant():
+    assert WFG_MIN_OBJECTIVES == 5
+
+
+@pytest.mark.parametrize("m", [4, 5])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_three_way_parity_across_the_boundary(m, seed):
+    """Slicing, the WFG stack, and the host oracle agree at both M = 4 and
+    M = 5 — the two regimes the crossover constant separates."""
+    pts = _front(12, m, seed)
+    ref = np.full(m, 1.3, np.float32)
+    padded, mask = _padded(pts, ref)
+    ref_j = jnp.asarray(ref)
+
+    hv_slice = float(hypervolume_masked(padded, ref_j, mask))
+    hv_wfg = float(hypervolume_wfg(padded, ref_j, mask, use_pallas=False))
+    hv_host = _compute_hv_recursive(pts.astype(np.float64), ref.astype(np.float64))
+
+    assert hv_slice == pytest.approx(hv_host, rel=2e-4)
+    assert hv_wfg == pytest.approx(hv_host, rel=2e-4)
+    assert hv_slice == pytest.approx(hv_wfg, rel=2e-4)
+
+
+@pytest.mark.parametrize("m", [4, 5])
+def test_hssp_selection_is_scorer_invariant_at_the_boundary(m):
+    """Moving the boundary must never change selections: greedy HSSP picks
+    the same subset whichever scorer runs, at the M on each side of it."""
+    pts = _front(10, m, seed=7)
+    ref = np.full(m, 1.3, np.float32)
+    padded, mask = _padded(pts, ref)
+    k, k_pad = 4, 4
+    picks = {
+        use_wfg: np.asarray(
+            _hssp_greedy(
+                padded, jnp.asarray(ref), mask, k, k_pad, use_wfg=use_wfg
+            )
+        )[:k]
+        for use_wfg in (False, True)
+    }
+    np.testing.assert_array_equal(picks[False], picks[True])
+    # The public entry routes by the constant and must agree with both.
+    routed = solve_hssp_device(pts, ref, k)
+    np.testing.assert_array_equal(routed, picks[m >= WFG_MIN_OBJECTIVES])

@@ -1,0 +1,211 @@
+"""Pallas kernel parity (ops/pallas/): every kernel runs through
+``interpret=True`` on CPU tier-1 and must agree with its XLA twin — the
+fused Matérn-5/2 Gram against the reference ``gp.gp.matern52``, the
+NSGA-II dominance tile against the broadcast comparison, and the WFG
+limit+filter step against the stack-body original (checked both directly
+and through end-to-end hypervolume equality against the host oracle).
+
+Fast small-shape parity is tier-1; the large shapes that exercise real
+tile grids are slow-marked.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from optuna_tpu.gp.gp import GPParams, matern52
+from optuna_tpu.hypervolume.wfg import _compute_hv_recursive
+from optuna_tpu.ops.pallas import interpret_mode, pallas_default
+from optuna_tpu.ops.pallas.matern import matern52_gram
+from optuna_tpu.ops.pallas.nds import TILE, dominance_matrix
+from optuna_tpu.ops.pallas.wfg import limit_and_filter
+from optuna_tpu.ops.pareto import non_domination_rank, non_domination_rank_np
+from optuna_tpu.ops.wfg import hypervolume_wfg
+
+MATERN_ATOL = 7e-7  # f32: MXU-contraction vs broadcast-distance rounding
+
+
+def _params(rng, d):
+    return GPParams(
+        inv_sq_lengthscales=jnp.asarray(
+            rng.uniform(0.1, 3.0, size=d).astype(np.float32)
+        ),
+        scale=jnp.asarray(np.float32(rng.uniform(0.5, 2.0))),
+        noise=jnp.asarray(np.float32(1e-3)),
+    )
+
+
+def test_interpret_mode_is_on_for_cpu_tier1():
+    """The whole point of interpret mode: tier-1 runs the real kernel
+    bodies on CPU, while the throughput default stays TPU-only."""
+    assert interpret_mode()
+    assert not pallas_default()
+
+
+# ------------------------------------------------------------------ matern
+
+
+@pytest.mark.parametrize("n1,n2,d", [(37, 23, 5), (16, 16, 2), (1, 48, 7)])
+def test_matern52_gram_interpret_parity(n1, n2, d):
+    rng = np.random.RandomState(n1 + n2 + d)
+    x1 = jnp.asarray(rng.uniform(0, 1, size=(n1, d)).astype(np.float32))
+    x2 = jnp.asarray(rng.uniform(0, 1, size=(n2, d)).astype(np.float32))
+    p = _params(rng, d)
+    cat = jnp.zeros(d, dtype=bool)
+    ours = matern52_gram(
+        x1, x2, p.inv_sq_lengthscales, p.scale, cat, use_pallas=True
+    )
+    ref = matern52(x1, x2, p, cat)
+    assert ours.shape == (n1, n2)
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(ref), atol=MATERN_ATOL
+    )
+
+
+def test_matern52_gram_categorical_routes_to_the_xla_twin():
+    """Hamming distance does not factor through the MXU contraction: a
+    space with categorical dims must take the XLA path and still match the
+    reference kernel exactly."""
+    rng = np.random.RandomState(0)
+    d = 4
+    x1 = jnp.asarray(
+        np.round(rng.uniform(0, 1, size=(12, d))).astype(np.float32)
+    )
+    x2 = jnp.asarray(
+        np.round(rng.uniform(0, 1, size=(9, d))).astype(np.float32)
+    )
+    p = _params(rng, d)
+    cat = jnp.asarray(np.array([True, False, True, False]))
+    ours = matern52_gram(
+        x1, x2, p.inv_sq_lengthscales, p.scale, cat,
+        use_pallas=True, has_categorical=True,
+    )
+    # Same algebra, separately compiled graphs: XLA fusion ordering may
+    # differ by an ulp.
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(matern52(x1, x2, p, cat)), atol=1e-7
+    )
+
+
+# --------------------------------------------------------------- dominance
+
+
+def test_dominance_matrix_interpret_parity():
+    rng = np.random.RandomState(1)
+    values = jnp.asarray(rng.uniform(0, 1, size=(TILE, 3)).astype(np.float32))
+    tiled = dominance_matrix(values, use_pallas=True)
+    plain = dominance_matrix(values, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(tiled), np.asarray(plain))
+    # Spot-check semantics: a point dominates iff <= everywhere, < somewhere.
+    v = np.asarray(values)
+    dom = np.asarray(tiled)
+    assert dom[0, 0] == 0.0
+    i, j = 3, 7
+    expected = float(np.all(v[i] <= v[j]) and np.any(v[i] < v[j]))
+    assert dom[i, j] == expected
+
+
+def test_non_domination_rank_parity_through_the_kernel():
+    """The public sort API on a padded pool agrees with the numpy oracle
+    whichever dominance body it runs."""
+    rng = np.random.RandomState(2)
+    n = TILE
+    values = rng.uniform(0, 1, size=(n, 4)).astype(np.float32)
+    mask = jnp.ones(n, dtype=bool)
+    oracle = non_domination_rank_np(values)
+    for use_pallas in (True, False):
+        ranks = non_domination_rank(
+            jnp.asarray(values), mask, use_pallas=use_pallas
+        )
+        np.testing.assert_array_equal(np.asarray(ranks), oracle)
+
+
+# --------------------------------------------------------------------- wfg
+
+
+def _wfg_frame(rng, n, m):
+    pts = rng.uniform(0, 1, size=(n, m)).astype(np.float32)
+    p = rng.uniform(0, 0.6, size=m).astype(np.float32)
+    eligible = rng.uniform(size=n) < 0.8
+    ref = np.full(m, 1.5, np.float32)
+    return (
+        jnp.asarray(pts), jnp.asarray(p), jnp.asarray(eligible),
+        jnp.asarray(ref),
+    )
+
+
+@pytest.mark.parametrize("n,m", [(32, 5), (8, 6)])
+def test_limit_and_filter_interpret_parity(n, m):
+    rng = np.random.RandomState(n * m)
+    pts, p, eligible, ref = _wfg_frame(rng, n, m)
+    pts_k, msk_k = limit_and_filter(pts, p, eligible, ref, use_pallas=True)
+    pts_x, msk_x = limit_and_filter(pts, p, eligible, ref, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(msk_k), np.asarray(msk_x))
+    np.testing.assert_allclose(np.asarray(pts_k), np.asarray(pts_x), atol=0)
+
+
+def test_hypervolume_wfg_pallas_equals_xla_and_the_host_oracle():
+    rng = np.random.RandomState(5)
+    n, m = 16, 5
+    pts = rng.uniform(0, 1, size=(n, m)).astype(np.float32)
+    ref = np.full(m, 1.2, np.float32)
+    mask = jnp.ones(n, dtype=bool)
+    hv_k = float(
+        hypervolume_wfg(jnp.asarray(pts), jnp.asarray(ref), mask, use_pallas=True)
+    )
+    hv_x = float(
+        hypervolume_wfg(jnp.asarray(pts), jnp.asarray(ref), mask, use_pallas=False)
+    )
+    assert hv_k == hv_x  # identical graph modulo the kernel body
+    oracle = _compute_hv_recursive(pts.astype(np.float64), ref.astype(np.float64))
+    assert hv_k == pytest.approx(oracle, rel=1e-4)
+
+
+# ------------------------------------------------------------- slow shapes
+
+
+@pytest.mark.slow
+def test_matern52_gram_interpret_parity_large():
+    rng = np.random.RandomState(10)
+    d = 20
+    x1 = jnp.asarray(rng.uniform(0, 1, size=(1024, d)).astype(np.float32))
+    x2 = jnp.asarray(rng.uniform(0, 1, size=(512, d)).astype(np.float32))
+    p = _params(rng, d)
+    cat = jnp.zeros(d, dtype=bool)
+    ours = matern52_gram(
+        x1, x2, p.inv_sq_lengthscales, p.scale, cat, use_pallas=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(matern52(x1, x2, p, cat)), atol=2e-6
+    )
+
+
+@pytest.mark.slow
+def test_dominance_matrix_interpret_parity_multi_tile():
+    rng = np.random.RandomState(11)
+    values = jnp.asarray(
+        rng.uniform(0, 1, size=(4 * TILE, 6)).astype(np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dominance_matrix(values, use_pallas=True)),
+        np.asarray(dominance_matrix(values, use_pallas=False)),
+    )
+
+
+@pytest.mark.slow
+def test_hypervolume_wfg_pallas_parity_large_frame():
+    rng = np.random.RandomState(12)
+    n, m = 64, 6
+    pts = rng.uniform(0, 1, size=(n, m)).astype(np.float32)
+    ref = np.full(m, 1.1, np.float32)
+    mask = jnp.ones(n, dtype=bool)
+    hv_k = float(
+        hypervolume_wfg(jnp.asarray(pts), jnp.asarray(ref), mask, use_pallas=True)
+    )
+    hv_x = float(
+        hypervolume_wfg(jnp.asarray(pts), jnp.asarray(ref), mask, use_pallas=False)
+    )
+    assert hv_k == hv_x
