@@ -292,6 +292,118 @@ def run_ours_gp_scan(n_total: int, sync_every: int = 32) -> tuple[float, float]:
     return n_total / dt, study.best_value
 
 
+def _scan_preempt_child(cfg: dict) -> None:
+    """Child half of ``--preempt-at`` (driven by the
+    ``OPTUNA_TPU_BENCH_SCAN_CHILD`` env hook in ``__main__``): run the scan
+    study against the shared journal file, and — on the kill leg —
+    ``SIGKILL`` our own process the moment chunk ``preempt-at``'s tells hit
+    storage. A real preemption gives no cleanup window, so neither does
+    this: no flush, no atexit, torn state and RUNNING strays left behind
+    exactly as a cluster eviction leaves them."""
+    import optuna_tpu
+    from optuna_tpu import telemetry
+    from optuna_tpu.distributions import FloatDistribution
+    from optuna_tpu.models.benchmarks import hartmann20_jax
+    from optuna_tpu.parallel import VectorizedObjective, optimize_scan
+    from optuna_tpu.storages import JournalFileBackend, JournalStorage
+
+    _silence()
+    storage = JournalStorage(JournalFileBackend(cfg["journal"]))
+    try:
+        study = optuna_tpu.create_study(
+            study_name="scan-preempt", storage=storage, direction="minimize"
+        )
+    except optuna_tpu.exceptions.DuplicatedStudyError:
+        study = optuna_tpu.load_study(study_name="scan-preempt", storage=storage)
+    space = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(20)}
+    obj = VectorizedObjective(fn=hartmann20_jax, search_space=space)
+    callbacks = None
+    kill_after = cfg.get("kill_after_tells")
+    if kill_after:
+        told = [0]
+
+        def _kill(_study, _trial):
+            told[0] += 1
+            if told[0] >= kill_after:
+                os.kill(os.getpid(), _signal.SIGKILL)
+
+        callbacks = [_kill]
+    telemetry.enable(telemetry.MetricsRegistry())
+    optimize_scan(
+        study, obj, n_trials=cfg["n_trials"], sync_every=cfg["sync_every"],
+        n_startup_trials=16, seed=0, resume=cfg.get("resume", False),
+        callbacks=callbacks,
+    )
+    phases = telemetry.phase_totals()
+    counters = telemetry.snapshot()["counters"]
+    with open(cfg["result"], "w") as f:
+        json.dump(
+            {
+                "best": study.best_value,
+                "resume_overhead_s": phases.get("ckpt.restore", {}).get(
+                    "total_s", 0.0
+                ),
+                "restores": int(counters.get("checkpoint.restore", 0)),
+                "fallbacks": int(counters.get("checkpoint.fallback", 0)),
+                "n_finished": sum(
+                    1 for t in study.trials if t.state.is_finished()
+                ),
+            },
+            f,
+        )
+
+
+def run_ours_gp_scan_preempt(
+    n_total: int, preempt_at: int, sync_every: int = 32
+) -> tuple[float, float, dict]:
+    """``--loop=scan --preempt-at=K``: the preemption acceptance as a bench —
+    a child process runs the scan study over a shared journal file and
+    SIGKILLs itself as chunk K's tells land; a second child relaunches with
+    ``resume=True`` and finishes the remaining budget from the durable
+    checkpoint. Returns (end-to-end trials/s across both incarnations, best
+    value, ckpt detail with the restore count and ``resume_overhead_s`` —
+    the seconds the resumed run spent inside the ``ckpt.restore`` phase)."""
+    import subprocess
+
+    workdir = tempfile.mkdtemp(prefix="scan_preempt_")
+    result = os.path.join(workdir, "result.json")
+    base_cfg = {
+        "journal": os.path.join(workdir, "study.journal"),
+        "result": result,
+        "n_trials": n_total,
+        "sync_every": sync_every,
+    }
+
+    def _run(cfg: dict) -> int:
+        env = dict(os.environ)
+        env["OPTUNA_TPU_BENCH_SCAN_CHILD"] = json.dumps(cfg)
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env
+        ).returncode
+
+    t0 = time.time()
+    rc = _run({**base_cfg, "kill_after_tells": preempt_at * sync_every})
+    if rc != -_signal.SIGKILL:
+        raise RuntimeError(
+            f"preempt child was expected to die by SIGKILL at chunk "
+            f"{preempt_at}; it exited with {rc} instead (did the study "
+            "finish before the kill point?)"
+        )
+    _log(f"  child SIGKILLed at chunk {preempt_at}; relaunching with resume...")
+    rc = _run({**base_cfg, "resume": True})
+    if rc != 0:
+        raise RuntimeError(f"resume child failed with exit code {rc}")
+    wall = time.time() - t0
+    with open(result) as f:
+        res = json.load(f)
+    detail = {
+        "restores": res["restores"],
+        "fallbacks": res["fallbacks"],
+        "resume_overhead_s": round(res["resume_overhead_s"], 3),
+    }
+    return n_total / wall, res["best"], detail
+
+
 def run_ours_gp_scan_large(
     n_total: int,
     window_start: int,
@@ -1628,6 +1740,16 @@ def main() -> None:
         "(gp_scan_trials_per_sec_hartmann20d_n4096) so the default scan "
         "gate baseline is untouched",
     )
+    parser.add_argument(
+        "--preempt-at",
+        type=int,
+        default=None,
+        help="scan-loop only: SIGKILL the (subprocess) scan run as chunk K's "
+        "tells land, then relaunch it with resume — the preemption "
+        "acceptance (ISSUE 19) as a bench; the JSON line carries a ckpt "
+        "block with the restore count and resume_overhead_s, and its own "
+        "metric so the default scan gate baseline is untouched",
+    )
     args = parser.parse_args()
     if args.hubs != 1 and args.loop != "serve":
         parser.error("--hubs is only defined for --loop=serve")
@@ -1637,6 +1759,13 @@ def main() -> None:
         parser.error("--trials is only defined for --loop=scan")
     if args.trials is not None and args.trials < 64:
         parser.error("--trials must be >= 64")
+    if args.preempt_at is not None:
+        if args.loop != "scan" or args.trials is not None:
+            parser.error(
+                "--preempt-at is only defined for --loop=scan (without --trials)"
+            )
+        if args.preempt_at < 1:
+            parser.error("--preempt-at must be >= 1")
     watchdog.phase(f"run:{args.config}:{args.loop}")
     watchdog.update(quick=bool(args.quick))
     provenance = "live"  # how vs_baseline's denominator was obtained
@@ -1775,22 +1904,47 @@ def main() -> None:
         # vs the per-trial ask/tell path on the SAME GP config at n=512
         # (n=128 in quick mode), both end-to-end on this box.
         n_total = 128 if args.quick else 512
-        _log(f"running ours (scan loop / 20D Hartmann, n={n_total} end-to-end, sync_every=32)...")
-        ours_rate, ours_best = run_ours_gp_scan(n_total)
-        n_timed = n_total
-        # Capture the scan window's breakdown NOW: the per-trial twin below
-        # is instrumented too (it is ours-side code), and letting the
-        # generic capture at the bottom run after it would fold the twin's
-        # phases/compiles into the scan entry.
-        extra["phases"] = _phase_breakdown()
-        extra["device_stats"] = _device_stats_breakdown()
-        extra["compile"] = _compile_breakdown()
-        _log(f"ours(scan): {ours_rate:.3f} trials/s (best {ours_best:.4f}); running per-trial twin...")
-        watchdog.update(value=round(ours_rate, 3))
-        watchdog.phase("baseline:gp_per_trial")
-        base = run_ours_gp_per_trial(n_total)
-        provenance = "live-ours-per-trial-path"
-        metric = "gp_scan_trials_per_sec_hartmann20d_end_to_end"
+        if args.preempt_at is not None:
+            # Preemption leg: both incarnations run in subprocesses (the
+            # SIGKILL must take the whole interpreter), so the parent's
+            # telemetry registry stays empty — the ckpt detail the children
+            # report IS the breakdown for this mode.
+            _log(
+                f"running ours (scan loop / 20D Hartmann, n={n_total}, "
+                f"SIGKILL at chunk {args.preempt_at} then resume)..."
+            )
+            ours_rate, ours_best, ckpt_detail = run_ours_gp_scan_preempt(
+                n_total, args.preempt_at
+            )
+            n_timed = n_total
+            extra["ckpt"] = ckpt_detail
+            extra["preempt_at"] = args.preempt_at
+            _log(
+                f"ours(scan+preempt): {ours_rate:.3f} trials/s across both "
+                f"incarnations (best {ours_best:.4f}, resume overhead "
+                f"{ckpt_detail['resume_overhead_s']}s)"
+            )
+            watchdog.update(value=round(ours_rate, 3))
+            base = None
+            provenance = "preempt-no-baseline"
+            metric = "gp_scan_trials_per_sec_hartmann20d_preempt_resume"
+        else:
+            _log(f"running ours (scan loop / 20D Hartmann, n={n_total} end-to-end, sync_every=32)...")
+            ours_rate, ours_best = run_ours_gp_scan(n_total)
+            n_timed = n_total
+            # Capture the scan window's breakdown NOW: the per-trial twin
+            # below is instrumented too (it is ours-side code), and letting
+            # the generic capture at the bottom run after it would fold the
+            # twin's phases/compiles into the scan entry.
+            extra["phases"] = _phase_breakdown()
+            extra["device_stats"] = _device_stats_breakdown()
+            extra["compile"] = _compile_breakdown()
+            _log(f"ours(scan): {ours_rate:.3f} trials/s (best {ours_best:.4f}); running per-trial twin...")
+            watchdog.update(value=round(ours_rate, 3))
+            watchdog.phase("baseline:gp_per_trial")
+            base = run_ours_gp_per_trial(n_total)
+            provenance = "live-ours-per-trial-path"
+            metric = "gp_scan_trials_per_sec_hartmann20d_end_to_end"
     elif args.config == "gp":
         # Headline = BASELINE.json's own form: the WHOLE n=1000 study
         # end-to-end. A per-window ratio misleads both ways (shallow windows
@@ -2001,6 +2155,12 @@ def _record_trajectory(out: dict, mode: str) -> None:
 
 
 if __name__ == "__main__":
+    _child_cfg = os.environ.get("OPTUNA_TPU_BENCH_SCAN_CHILD")
+    if _child_cfg:
+        # Preemption-leg child (run_ours_gp_scan_preempt): no watchdog, no
+        # JSON emit — the parent bench owns the one output line.
+        _scan_preempt_child(json.loads(_child_cfg))
+        sys.exit(0)
     try:
         main()
     except Exception as exc:

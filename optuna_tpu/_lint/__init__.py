@@ -26,6 +26,7 @@ CONC004  lock sanitizer vocabularies drifted from the canonical one
 SRV001   suggestion-service shed policy sets drifted from the canonical one
 ACT001   autopilot action vocabularies drifted from the canonical one
 FLT001   hub-fleet event vocabularies drifted from the canonical one
+CKPT001  checkpoint event vocabularies drifted from the canonical one
 EXE001   non-finite quarantine policy sets drifted from the canonical one
 SMP001   sampler fallback policy sets drifted from the canonical one
 SMP002   bare Cholesky in sampler code (route through ladder_cholesky)
@@ -77,6 +78,7 @@ def all_rules() -> list[Rule]:
     )
     from optuna_tpu._lint.rules_storage import (
         ACT001ActionRegistrySync,
+        CKPT001CheckpointEventSync,
         EXE001NonFinitePolicySync,
         FLT001FleetEventSync,
         SRV001ShedPolicySync,
@@ -103,6 +105,7 @@ def all_rules() -> list[Rule]:
         SRV001ShedPolicySync(),
         ACT001ActionRegistrySync(),
         FLT001FleetEventSync(),
+        CKPT001CheckpointEventSync(),
         EXE001NonFinitePolicySync(),
         SMP001FallbackPolicySync(),
         SMP002LadderCholeskyOnly(),

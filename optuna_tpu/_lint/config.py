@@ -113,6 +113,10 @@ class Config:
     flt001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.FLEET_EVENT_REGISTRY
     )
+    ckpt001_targets: tuple[tuple[str, str, str], ...] = registry.CKPT001_TARGETS
+    ckpt001_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.CHECKPOINT_EVENT_REGISTRY
+    )
     smp002_paths: tuple[str, ...] = registry.SMP002_SAMPLER_PATHS
     smp002_helper: str = registry.SMP002_CHOLESKY_HELPER
     sto002_paths: tuple[str, ...] = ("optuna_tpu/storages/",)

@@ -139,6 +139,8 @@ TELEMETRY_PHASE_REGISTRY: dict[str, str] = {
     "serve.ask": "one suggestion-service ask served end to end (queue pop, shed rung, or coalesced dispatch)",
     "serve.coalesce": "one fused proposal dispatch answering a whole coalesced ask batch",
     "serve.ready_queue": "one speculative ask-ahead refill dispatch (background, off the RPC path)",
+    "ckpt.write": "one best-effort durable checkpoint write at a loop boundary (encode + attr write)",
+    "ckpt.restore": "one resume's checkpoint validation + carry reconstruction (load, verify, rebuild)",
 }
 
 #: The containment-counter families: canonical mirror of
@@ -160,6 +162,8 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
     "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
     "locksan.verdict": "(suffixed by kind) the lock sanitizer reported a potential deadlock cycle or a blocking window under held locks",
+    "checkpoint": "(suffixed by checkpoint event) a durable-checkpoint lifecycle event: write, rejection, restore, fallback, or warm load",
+    "journal.snapshot_rejected": "a journal snapshot failed its CRC/unpickle validation and was replaced by a full log replay",
 }
 
 #: The flight recorder's event-kind vocabulary: canonical mirror of
@@ -265,6 +269,7 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
     "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
     "service.hub_dead": "a suggestion hub's -serve snapshot went stale: the fleet re-homes its studies to ring successors",
+    "checkpoint.stale": "resume is rejecting checkpoint blobs (torn, corrupt, or watermark-stale): restores are paying full recomputes",
 }
 
 #: The hand-maintained copies OBS004 cross-checks, as
@@ -410,6 +415,42 @@ FLT001_TARGETS: tuple[tuple[str, str, str], ...] = (
         "optuna_tpu/testing/fault_injection.py",
         "HUB_CHAOS_MATRIX",
         "chaos matrix: every fleet event must have a hub-fault scenario that forces it",
+    ),
+)
+
+#: The durable-checkpoint event vocabulary: every lifecycle event the
+#: preemption-safe checkpoint layer (``optuna_tpu/checkpoint.py``) can take
+#: on a blob — and every ``checkpoint.*`` counter and doctor evidence field
+#: derived from one — carries one of these ids. Canonical mirror of
+#: ``checkpoint.CHECKPOINT_EVENTS`` (rule **CKPT001**, the STO001 machinery
+#: pointed at crash recovery itself). Values say what each event means for
+#: a preempted study; every id must have a preemption scenario in
+#: ``testing/fault_injection.py::CHECKPOINT_CHAOS_MATRIX`` (same rule) — a
+#: restore path nobody has SIGKILLed a loop through is a path that loses
+#: its first real study to the fleet's *default* failure mode.
+CHECKPOINT_EVENT_REGISTRY: dict[str, str] = {
+    "write": "a loop boundary persisted a CRC-framed state blob into the ckpt: ring",
+    "write_error": "a best-effort checkpoint write failed; the loop continued without it",
+    "restore": "a resume rebuilt loop state from the newest valid blob",
+    "rejected": "a blob failed CRC / schema-version / decode validation and was skipped",
+    "stale": "a blob's trial-count watermark trailed the synced history and was skipped",
+    "fallback": "no valid blob survived validation; state was recomputed from COMPLETE history",
+    "warm_load": "a re-homing hub successor restored the dead hub's fitted sampler state",
+}
+
+#: The hand-maintained copies CKPT001 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+CKPT001_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/checkpoint.py",
+        "CHECKPOINT_EVENTS",
+        "the checkpoint layer's accepted lifecycle events (each counted as checkpoint.<event>)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "CHECKPOINT_CHAOS_MATRIX",
+        "chaos matrix: every checkpoint event must have a preemption scenario that forces it",
     ),
 )
 

@@ -231,6 +231,26 @@ class FLT001FleetEventSync(_RegistrySyncRule):
         return config.flt001_targets
 
 
+class CKPT001CheckpointEventSync(_RegistrySyncRule):
+    """The STO001/.../FLT001 anti-drift machinery pointed at the durable
+    checkpoint layer's event vocabulary: ``checkpoint.CHECKPOINT_EVENTS``
+    and the chaos matrix ``fault_injection.py::CHECKPOINT_CHAOS_MATRIX``
+    must both equal the canonical ``registry.CHECKPOINT_EVENT_REGISTRY`` —
+    a checkpoint lifecycle event added without a preemption scenario that
+    forces it is a lint failure: an unexercised restore path loses its
+    first real study to the spot fleet's *default* failure mode."""
+
+    id = "CKPT001"
+    title = "checkpoint event vocabularies out of sync"
+    noun = "checkpoint events"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.ckpt001_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.ckpt001_targets
+
+
 # --------------------------------------------------------------------- STO002
 
 

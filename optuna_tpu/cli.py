@@ -599,9 +599,24 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
         stats = entry.get("device_stats") or {}
         mesh = entry.get("mesh") or {}
         serve = entry.get("serve") or {}
-        if not stats and not mesh and not serve:
+        ckpt = entry.get("ckpt") or {}
+        if not stats and not mesh and not serve and not ckpt:
             return ""
         parts = []
+        if ckpt:
+            # Preemption-leg scan entries (bench --loop=scan --preempt-at=K)
+            # lead with the checkpoint evidence: how many restores the run
+            # paid and what the resumed incarnation spent in ckpt.restore.
+            # Every field reads through .get so an entry written by a newer
+            # bench with extra (or missing) ckpt keys still renders.
+            parts.append(
+                f"ckpt={ckpt.get('restores', 0)}"
+                f"/{ckpt.get('resume_overhead_s', 0)}s"
+            )
+            if entry.get("preempt_at") is not None:
+                parts.append(f"pre@{entry['preempt_at']}")
+            if ckpt.get("fallbacks"):
+                parts.append(f"fb={ckpt['fallbacks']}")
         if serve:
             # Serve-loop entries (bench --loop=serve) lead with the latency
             # contract: steady-state per-ask p99 vs the single-client twin's

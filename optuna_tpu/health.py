@@ -115,6 +115,7 @@ HEALTH_CHECKS: dict[str, str] = {
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
     "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
     "service.hub_dead": "a suggestion hub's -serve snapshot went stale: the fleet re-homes its studies to ring successors",
+    "checkpoint.stale": "resume is rejecting checkpoint blobs (torn, corrupt, or watermark-stale): restores are paying full recomputes",
 }
 
 #: Finding severities, mildest first. CRITICAL findings are additionally
@@ -144,6 +145,7 @@ CHECK_SEVERITIES: dict[str, str] = {
     "service.ready_queue_starved": "WARNING",
     "service.slo_burn": "CRITICAL",
     "service.hub_dead": "CRITICAL",
+    "checkpoint.stale": "WARNING",
 }
 
 #: Study system-attr namespace the reporter publishes under; one attr per
@@ -201,6 +203,12 @@ BACKPRESSURE_SHED_MIN = 3  # shed asks before the service is flagged overloaded
 READY_QUEUE_MISS_MIN = 8  # ready-queue misses before starvation can flag
 READY_QUEUE_MISS_RATE = 0.5  # ...and misses must be this share of lookups
 SLO_BURN_MIN_VIOLATIONS = 3  # fleet-wide long-window violations before slo_burn can flag
+# A single rejected/stale checkpoint blob already flags: each one means a
+# resume (or hub re-home) silently paid a full recompute instead of a
+# restore — invisible in the study's results, expensive at the next
+# preemption, and usually systematic (torn writes, version drift, a
+# watermark bug) rather than a one-off.
+CHECKPOINT_REJECT_MIN = 1
 
 #: Gauge prefixes a worker snapshot carries (bounded: the device-stat,
 #: jit-label and mesh-coordinate vocabularies are small by construction;
@@ -1266,6 +1274,43 @@ def _check_slo_burn(
     )
 
 
+def _check_checkpoint_stale(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    counters = fleet["counters"]
+    rejected = _counter_family_total(counters, "checkpoint.rejected")
+    stale = _counter_family_total(counters, "checkpoint.stale")
+    fallbacks = counters.get("checkpoint.fallback", 0)
+    total = rejected + stale
+    if total < kw.get("checkpoint_reject_min", CHECKPOINT_REJECT_MIN):
+        return None
+    return HealthFinding(
+        check="checkpoint.stale",
+        severity=CHECK_SEVERITIES["checkpoint.stale"],
+        summary=(
+            f"{total} checkpoint blob(s) were rejected at restore "
+            f"({rejected} corrupt/torn/version-drifted, {stale} watermark-stale); "
+            f"{fallbacks} resume(s) fell back to a full recompute from history"
+        ),
+        evidence={
+            "rejected": rejected,
+            "stale": stale,
+            "fallbacks": fallbacks,
+            "writes": counters.get("checkpoint.write", 0),
+            "write_errors": counters.get("checkpoint.write_error", 0),
+            "restores": counters.get("checkpoint.restore", 0),
+        },
+        remediation=(
+            "resumes still complete (recompute-from-COMPLETE-history is the "
+            "fallback) but pay the full refit at every preemption: check the "
+            "storage for torn attr writes, whether writers and resumers run "
+            "the same CHECKPOINT_SCHEMA_VERSION, and whether checkpoints are "
+            "written often enough that their watermark keeps up with the "
+            "synced history"
+        ),
+    )
+
+
 #: The rule table: one function per check id, keyed exactly by
 #: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py`` — a check in
 #: the vocabulary without a rule, or vice versa, is a test failure).
@@ -1284,6 +1329,7 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "service.ready_queue_starved": _check_ready_queue_starved,
     "service.slo_burn": _check_slo_burn,
     "service.hub_dead": _check_hub_dead,
+    "checkpoint.stale": _check_checkpoint_stale,
 }
 
 _SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}

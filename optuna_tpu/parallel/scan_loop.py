@@ -36,6 +36,18 @@ itself lives in HBM:
   is never ingested: the carry's finite verdict skips the buffer write and
   the factor update entirely (the history cursor does not advance), and
   the slot is told FAIL at the next chunk sync.
+* **Preemption-safe carry** — after every chunk sync (and once after the
+  startup block) the loop-top carry — history buckets, cursor, inducing
+  set, warm-fit params, PRNG counters, the host RNG state — is persisted
+  best-effort into the study's 2-slot ``ckpt:scan:*`` ring
+  (:mod:`optuna_tpu.checkpoint`), and every synced trial is stamped with a
+  deterministic op token. ``optimize_scan(resume=True)`` rebuilds the
+  carry from the newest *valid* blob (CRC + schema + watermark checked;
+  anything torn, corrupt, or stale degrades to the recompute-from-COMPLETE
+  -history path, never an abort), re-runs the interrupted chunk
+  bit-identically, and skips — never re-tells — ops the dead process
+  already synced. With ``resume=True``, ``n_trials`` is the study's
+  *total* budget, not an increment.
 * **Observability without host syncs** — the scan carry threads a
   fixed-shape device-stats struct (ladder rung, rank-1 update vs
   refactorization counts, quarantined slots, chunk fill — the PR-9
@@ -63,6 +75,7 @@ from typing import TYPE_CHECKING, Any, Callable, Sequence
 import numpy as np
 
 from optuna_tpu import _tracing, autopilot, device_stats, flight, health, telemetry
+from optuna_tpu import checkpoint as _ckpt
 from optuna_tpu.distributions import (
     BaseDistribution,
     CategoricalDistribution,
@@ -665,6 +678,7 @@ def optimize_scan(
     lbfgs_iters: int = 16,
     n_exact_max: int | None = None,
     n_inducing: int | None = None,
+    resume: bool = False,
 ) -> None:
     """Run ``n_trials`` GP-BO trials with the ask/evaluate/tell cycle
     resident in HBM (see the module docstring for the architecture).
@@ -692,6 +706,19 @@ def optimize_scan(
     bit-identical to before the switch existed. The thresholds are live in
     ``study._scan_gp_control`` — the autopilot's ``gp.densify`` action
     adjusts them between chunks when the doctor flags sparse degradation.
+
+    **Preemption resume.** With ``resume=True``, ``n_trials`` is the
+    study's *total* tell budget: the loop first reaps RUNNING strays a
+    dead process left behind, then rebuilds the device carry from the
+    newest valid ``ckpt:scan:*`` blob (written after every chunk sync)
+    and re-runs the interrupted chunk bit-identically, skipping ops the
+    dead run already told — an uninterrupted twin and a kill-then-resume
+    run land on the same trials and the same best value. When no blob
+    survives validation (counted ``checkpoint.fallback``), the loop
+    degrades to its ordinary recompute-from-COMPLETE-history warm start
+    with the already-synced tells still counted against the budget. The
+    ``seed`` / ``sync_every`` of the original call must be passed again;
+    a ``sync_every`` or search-space mismatch rejects the blob.
     """
     from optuna_tpu.study._study_direction import StudyDirection
 
@@ -740,6 +767,7 @@ def optimize_scan(
                 lbfgs_iters=lbfgs_iters,
                 maximize=study.direction == StudyDirection.MAXIMIZE,
                 control=control,
+                resume=resume,
             )
     finally:
         study._thread_local.in_optimize_loop = False
@@ -761,6 +789,7 @@ def _run_scan(
     lbfgs_iters: int,
     maximize: bool,
     control: dict,
+    resume: bool = False,
 ) -> None:
     import jax
     import jax.numpy as jnp
@@ -774,75 +803,165 @@ def _run_scan(
     d = space.dim
     dev = _device_space(objective, space, n_preliminary_samples)
     rng = np.random.RandomState(seed)
+    storage = study._storage
 
-    # Resume from any COMPLETE history over this space (the sampler's own
-    # convention), direction-applied and clipped to the f32-safe score.
-    prior = [
-        t
-        for t in study._get_trials(
-            deepcopy=False, states=(TrialState.COMPLETE,), use_cache=True
-        )
-        if all(p in t.params for p in space_dict)
-    ]
-    if prior:
-        X_hist = space.normalize([t.params for t in prior]).astype(np.float32)
-        vals = np.asarray([t.value for t in prior])
-        scores = _clip_scores(vals if maximize else -vals)
-    else:
-        X_hist = np.zeros((0, d), dtype=np.float32)
-        scores = np.zeros((0,), dtype=np.float32)
-
+    # Exactly-once bookkeeping: a resume classifies the history's op tokens
+    # and validates the newest checkpoint; a fresh run just claims the next
+    # run id so its tokens never collide with a dead incarnation's.
     told = 0
-    # ---------------------------------------------------- random startup
-    n_startup = max(0, min(n_startup_trials - len(prior), n_trials))
-    if n_startup:
-        x0 = space.sample_normalized(
-            n_startup, seed=int(rng.randint(0, 2**31 - 1))
-        ).astype(np.float32)
-        startup = _startup_program(objective, space, n_startup)
-        with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"), \
-                flight.span("dispatch"):
-            vals0, fins0 = startup(jnp.asarray(x0))
-            vals0 = np.asarray(vals0)
-            fins0 = np.asarray(fins0)
-        _sync_results(study, space, space_dict, x0, vals0, fins0, callbacks)
-        told += n_startup
-        keep = fins0
-        if keep.any():
-            X_hist = np.concatenate([X_hist, x0[keep]])
-            scores = np.concatenate(
-                [scores, _clip_scores(vals0[keep] if maximize else -vals0[keep])]
+    resume_state = None
+    ledger: _ResumeLedger | None = None
+    if resume:
+        with telemetry.span("ckpt.restore"), flight.span("ckpt.restore"):
+            resume_state, ledger, run_id, told = _restore_scan(
+                study, space_dict, sync_every=sync_every
             )
-        if study._stop_flag or told >= n_trials:
+    else:
+        run_id = (
+            _ckpt.synced_ops(
+                study._get_trials(deepcopy=False, use_cache=True)
+            ).max_run_id
+            + 1
+        )
+    ckpt_seq = _ckpt.max_slot_seq(storage, study._study_id, "scan") + 1
+
+    if resume_state is None:
+        # Resume from any COMPLETE history over this space (the sampler's
+        # own convention), direction-applied and clipped to the f32-safe
+        # score.
+        prior = [
+            t
+            for t in study._get_trials(
+                deepcopy=False, states=(TrialState.COMPLETE,), use_cache=True
+            )
+            if all(p in t.params for p in space_dict)
+        ]
+        if prior:
+            X_hist = space.normalize([t.params for t in prior]).astype(np.float32)
+            vals = np.asarray([t.value for t in prior])
+            scores = _clip_scores(vals if maximize else -vals)
+        else:
+            X_hist = np.zeros((0, d), dtype=np.float32)
+            scores = np.zeros((0,), dtype=np.float32)
+
+        # ------------------------------------------------ random startup
+        n_startup = max(0, min(n_startup_trials - len(prior), n_trials - told))
+        if n_startup:
+            x0 = space.sample_normalized(
+                n_startup, seed=int(rng.randint(0, 2**31 - 1))
+            ).astype(np.float32)
+            startup = _startup_program(objective, space, n_startup)
+            with _tracing.annotate(_TRACE_DISPATCH), telemetry.span("dispatch"), \
+                    flight.span("dispatch"):
+                vals0, fins0 = startup(jnp.asarray(x0))
+                vals0 = np.asarray(vals0)
+                fins0 = np.asarray(fins0)
+            _sync_results(
+                study, space, space_dict, x0, vals0, fins0, callbacks,
+                ops=[_ckpt.op_token(run_id, "s", i) for i in range(n_startup)],
+                ledger=ledger,
+            )
+            told += n_startup
+            keep = fins0
+            if keep.any():
+                X_hist = np.concatenate([X_hist, x0[keep]])
+                scores = np.concatenate(
+                    [scores, _clip_scores(vals0[keep] if maximize else -vals0[keep])]
+                )
+            if study._stop_flag or told >= n_trials:
+                return
+
+        # ----------------------------------------------- HBM bucket setup
+        n_hist = len(X_hist)
+        bucket = _bucket(n_hist + sync_every)
+        Xb = jnp.zeros((bucket, d), dtype=jnp.float32)
+        yb = jnp.zeros((bucket,), dtype=jnp.float32)
+        mb = jnp.zeros((bucket,), dtype=jnp.float32)
+        if n_hist:
+            Xb = Xb.at[:n_hist].set(X_hist)
+            yb = yb.at[:n_hist].set(scores)
+            mb = mb.at[:n_hist].set(1.0)
+        n_dev = jnp.asarray(n_hist, jnp.int32)
+        n_upper = n_hist  # host-side bound on the cursor (quarantines may lag it)
+        key_seed = int(rng.randint(0, 2**31 - 1))
+        warm_raw = None  # previous chunk's fitted raw params (device array)
+        chunk_idx = 0
+        Zb = zyb = zmb = None
+        m_pad = 0
+    else:
+        # ------------------------------------- carry restore (checkpoint)
+        # Rebuild the exact loop-top state the dead process stashed: the
+        # interrupted chunk re-dispatches bit-identically (same buckets,
+        # same PRNG fold, same host RNG stream), so its re-told slots are
+        # the dead run's slots and the dup ledger can skip them safely.
+        st = resume_state
+        bucket = int(st["bucket"])
+        Xb = jnp.asarray(st["X"], dtype=jnp.float32)
+        yb = jnp.asarray(st["y"], dtype=jnp.float32)
+        mb = jnp.asarray(st["m"], dtype=jnp.float32)
+        n_dev = jnp.asarray(int(st["n_dev"]), jnp.int32)
+        n_upper = int(st["n_upper"])
+        key_seed = int(st["key_seed"])
+        warm_raw = (
+            jnp.asarray(st["warm_raw"], dtype=jnp.float32)
+            if st["warm_raw"] is not None
+            else None
+        )
+        chunk_idx = int(st["chunk_idx"])
+        rng.set_state(st["rng_state"])
+        m_pad = int(st["m_pad"])
+        Zb = jnp.asarray(st["Z"], dtype=jnp.float32) if st["Z"] is not None else None
+        zyb = jnp.asarray(st["zy"], dtype=jnp.float32) if st["zy"] is not None else None
+        zmb = jnp.asarray(st["zm"], dtype=jnp.float32) if st["zm"] is not None else None
+        if told >= n_trials:
             return
 
-    # --------------------------------------------------- HBM bucket setup
-    n_hist = len(X_hist)
-    bucket = _bucket(n_hist + sync_every)
-    Xb = jnp.zeros((bucket, d), dtype=jnp.float32)
-    yb = jnp.zeros((bucket,), dtype=jnp.float32)
-    mb = jnp.zeros((bucket,), dtype=jnp.float32)
-    if n_hist:
-        Xb = Xb.at[:n_hist].set(X_hist)
-        yb = yb.at[:n_hist].set(scores)
-        mb = mb.at[:n_hist].set(1.0)
-    n_dev = jnp.asarray(n_hist, jnp.int32)
-    n_upper = n_hist  # host-side bound on the cursor (quarantines may lag it)
-    base_key = jax.random.PRNGKey(int(rng.randint(0, 2**31 - 1)))
+    base_key = jax.random.PRNGKey(key_seed)
     default_start = np.zeros(d + 2, dtype=np.float32)
     default_start[d + 1] = np.log(1e-2)
-    warm_raw = None  # previous chunk's fitted raw params (device array)
-    chunk_idx = 0
-    pending: tuple | None = None  # (xs, vals, finites, stats, n_tell)
+    pending: tuple | None = None  # (xs, vals, finites, stats, n_tell, ops, n_new)
     has_cat = bool(np.any(space.is_categorical))
-    # Sparse-regime carry: the fixed-shape inducing buffers live on the host
-    # loop (device arrays, host references) across chunks. None until the
-    # history first crosses the exact-size threshold.
-    Zb = zyb = zmb = None
-    m_pad = 0
+    # (The sparse-regime inducing buffers Zb/zyb/zmb — device arrays, host
+    # references — ride the host loop across chunks; None until the history
+    # first crosses the exact-size threshold. Both setup branches above
+    # initialize them.)
 
+    def _stash_carry() -> dict:
+        """The loop-top carry as a checkpointable dict. Captured *before*
+        this iteration mutates anything (bucket growth, RNG draws, inducing
+        reseed, chunk_idx bump): the stash is the state needed to
+        re-dispatch chunk ``chunk_idx``, durable only once the previous
+        chunk's tells are synced (so its watermark matches storage)."""
+        return {
+            "param_names": tuple(space_dict),
+            "sync_every": int(sync_every),
+            "run_id": int(run_id),
+            "bucket": int(bucket),
+            "n_upper": int(n_upper),
+            "chunk_idx": int(chunk_idx),
+            "key_seed": int(key_seed),
+            "rng_state": rng.get_state(),
+            "X": Xb,
+            "y": yb,
+            "m": mb,
+            "n_dev": n_dev,
+            "warm_raw": warm_raw,
+            "Z": Zb,
+            "zy": zyb,
+            "zm": zmb,
+            "m_pad": int(m_pad),
+        }
+
+    # First durable point: covers a death during chunk 0/1 (before the
+    # first chunk-sync write) with a restore instead of a full fallback.
+    _write_scan_checkpoint(storage, study._study_id, _stash_carry(), told=told, seq=ckpt_seq)
+    ckpt_seq += 1
+    dup_counts = ledger.dup_counts if ledger is not None else {}
     remaining = n_trials - told
     while remaining > 0 and not study._stop_flag:
+        # Stash the loop-top carry NOW (pre-growth, pre-RNG-draw, pre-fold):
+        # it becomes durable after this iteration syncs the pending chunk.
+        carry_stash = _stash_carry()
         if n_upper + sync_every > bucket:
             # Bucket crossing: migrate the buffers to the next power-of-two
             # capacity (one device-side copy; the old program is never
@@ -894,6 +1013,7 @@ def _run_scan(
                 maximize=maximize, n_local_search=n_local_search,
                 lbfgs_iters=lbfgs_iters,
             )
+        this_chunk = chunk_idx
         key = jax.random.fold_in(base_key, chunk_idx)
         chunk_idx += 1
         # Dispatch chunk k+1, THEN sync chunk k: jax dispatch is
@@ -911,25 +1031,48 @@ def _run_scan(
                     starts, Xb, yb, mb, n_dev, key
                 )
         n_upper += sync_every
-        n_tell = min(sync_every, remaining)
-        remaining -= n_tell
+        # Budget algebra with resume dups: ops of this chunk the dead run
+        # already synced re-run (bit-identical) but are skipped at tell
+        # time, so they ride inside n_tell without consuming new budget.
+        dups = dup_counts.pop(this_chunk, 0) if dup_counts else 0
+        n_tell = min(sync_every, remaining + dups)
+        remaining -= n_tell - dups
         if pending is not None:
-            _sync_chunk(study, space, space_dict, pending, callbacks)
+            _sync_chunk(study, space, space_dict, pending, callbacks, ledger)
+            told += pending[6]
             if study._stop_flag:
                 # The just-dispatched chunk's trials were never created in
                 # storage — discarding the device work leaves nothing
                 # RUNNING and nothing told past the stop.
                 return
-        pending = (xs, vals, fins, stats, n_tell)
+            # The pending chunk's tells are durable: persist the loop-top
+            # stash (the state that re-dispatches THIS iteration's chunk).
+            _write_scan_checkpoint(
+                storage, study._study_id, carry_stash, told=told, seq=ckpt_seq
+            )
+            ckpt_seq += 1
+        pending = (
+            xs, vals, fins, stats, n_tell,
+            [_ckpt.op_token(run_id, this_chunk, i) for i in range(n_tell)],
+            n_tell - dups,
+        )
 
     if pending is not None and not study._stop_flag:
-        _sync_chunk(study, space, space_dict, pending, callbacks)
+        exit_stash = _stash_carry()
+        _sync_chunk(study, space, space_dict, pending, callbacks, ledger)
+        told += pending[6]
+        if not study._stop_flag:
+            # Terminal checkpoint: a resume of a completed study restores
+            # this, sees the budget spent, and returns without dispatching.
+            _write_scan_checkpoint(
+                storage, study._study_id, exit_stash, told=told, seq=ckpt_seq
+            )
 
 
-def _sync_chunk(study, space, space_dict, pending, callbacks) -> None:
+def _sync_chunk(study, space, space_dict, pending, callbacks, ledger=None) -> None:
     """Realize one finished chunk (this is where the host blocks on the
     device) and commit its trials; publish the chunk's device stats."""
-    xs, vals, fins, stats, n_tell = pending
+    xs, vals, fins, stats, n_tell, ops, _n_new = pending
     with _tracing.annotate(_TRACE_SYNC), telemetry.span("scan.sync"), \
             flight.span("scan.sync"):
         xs_np = np.asarray(xs)
@@ -939,26 +1082,63 @@ def _sync_chunk(study, space, space_dict, pending, callbacks) -> None:
         _sync_results(
             study, space, space_dict,
             xs_np[:n_tell], vals_np[:n_tell], fins_np[:n_tell], callbacks,
+            ops=ops, ledger=ledger,
         )
 
 
-def _sync_results(study, space, space_dict, xs, vals, fins, callbacks) -> None:
+def _sync_results(
+    study, space, space_dict, xs, vals, fins, callbacks, *, ops=None, ledger=None
+) -> None:
     """Commit one chunk's results: create the trials (one storage batch),
     pin each trial's params to the evaluated point, tell COMPLETE/FAIL —
     the same logical end state the per-trial executor leaves. A mid-loop
     error (or ``Study.stop()`` from a callback) fails the not-yet-told
-    remainder instead of stranding it RUNNING."""
+    remainder instead of stranding it RUNNING.
+
+    ``ops`` stamps each slot's deterministic op token (``ckpt:op`` attr,
+    written before any tell) for exactly-once resume. On a resumed re-run
+    chunk ``ledger`` filters the slots: ops the dead run already told are
+    skipped outright (never re-told, no new trial row), and its
+    token-stamped RUNNING strays are adopted — told into the existing
+    trial instead of a duplicate."""
     if len(xs) == 0:
         return
     storage = study._storage
-    trial_ids = storage.create_new_trials(study._study_id, len(xs))
+    # Plan each slot before touching storage: (slot index, token, adopted
+    # trial id or None). Already-told ops drop out of the plan entirely.
+    plan = []
+    for i in range(len(xs)):
+        token = ops[i] if ops is not None else None
+        if ledger is not None and token is not None:
+            if token in ledger.told:
+                continue
+            plan.append((i, token, ledger.running.pop(token, None)))
+        else:
+            plan.append((i, token, None))
+    if not plan:
+        return
+    n_new = sum(1 for _, _, tid in plan if tid is None)
+    new_ids = iter(
+        storage.create_new_trials(study._study_id, n_new) if n_new else ()
+    )
     study._thread_local.cached_all_trials = None
-    trials = [Trial(study, tid) for tid in trial_ids]
-    i = 0
+    trials = [
+        Trial(study, tid if tid is not None else next(new_ids))
+        for _, _, tid in plan
+    ]
+    j = 0
     try:
-        for i, trial in enumerate(trials):
+        for j, trial in enumerate(trials):
             if study._stop_flag:
                 break
+            i, token, _adopted = plan[j]
+            if token is not None:
+                # Token before tell: a death in between leaves a
+                # token-stamped RUNNING stray the resume adopts; a death
+                # before leaves a tokenless stray the resume reaps.
+                storage.set_trial_system_attr(
+                    trial._trial_id, _ckpt.OP_TOKEN_ATTR, token
+                )
             params = space.unnormalize_one(xs[i])
             # Pin the evaluated point as the trial's relative proposal so
             # _suggest records it under its distributions without touching
@@ -1000,17 +1180,151 @@ def _sync_results(study, space, space_dict, xs, vals, fins, callbacks) -> None:
         # trials must not strand RUNNING (and must not COMPLETE past the
         # budget) — quarantine them as FAIL, executor parity.
         _fail_remaining(
-            study, trials[i:], "study stopped (Study.stop()) before this trial was told"
+            study, trials[j:], "study stopped (Study.stop()) before this trial was told"
         )
     except Exception:  # graphlint: ignore[PY001] -- containment sweep: a storage blip mid-sync must not strand the chunk's already-created trials RUNNING; the original error re-raises after the sweep
         _fail_remaining(
-            study, trials[i:], "scan chunk sync aborted before this trial was told"
+            study, trials[j:], "scan chunk sync aborted before this trial was told"
         )
         raise
     finally:
         health.maybe_report(study)
         # Chunk-boundary autopilot step (one dict lookup while disabled).
         autopilot.maybe_step(study)
+
+
+class _ResumeLedger:
+    """Exactly-once resume bookkeeping, consulted at every chunk sync."""
+
+    __slots__ = ("told", "running", "dup_counts")
+
+    def __init__(self, told, running, dup_counts) -> None:
+        #: Op tokens the dead run durably told — never re-told.
+        self.told = frozenset(told)
+        #: Token -> trial id of the dead run's adoptable RUNNING strays.
+        self.running = dict(running)
+        #: Chunk index -> told-op count past the checkpoint watermark: the
+        #: budget to refund when that chunk is re-dispatched.
+        self.dup_counts = dict(dup_counts)
+
+
+def _restore_scan(study, space_dict, *, sync_every):
+    """Resume bookkeeping (trust-but-verify): classify the history's op
+    tokens, reap unidentifiable strays, and validate the newest scan
+    checkpoint against this study's configuration and synced watermark.
+
+    Returns ``(state, ledger, run_id, told)``. ``state`` is the restored
+    carry dict, or None — the caller falls back to its ordinary
+    recompute-from-COMPLETE-history warm start (counted
+    ``checkpoint.fallback``) under a fresh run id. Either way no
+    already-synced op is ever re-told, and no stray stays RUNNING.
+    """
+    storage = study._storage
+    ops = _ckpt.synced_ops(study.get_trials(deepcopy=False))
+    rec = _ckpt.load_checkpoint(
+        storage,
+        study._study_id,
+        "scan",
+        synced_told=len(ops.told),
+        # The 2-slot ring means the newest *valid* blob can trail the
+        # synced history by up to two write intervals (a torn newest slot
+        # hands the older slot the win); beyond that it is stale.
+        max_lag=2 * sync_every,
+    )
+    state = rec.state if rec is not None else None
+    if state is not None and (
+        tuple(state.get("param_names", ())) != tuple(space_dict)
+        or int(state.get("sync_every", 0)) != int(sync_every)
+    ):
+        telemetry.count(
+            "checkpoint.rejected",
+            meta={"kind": "scan", "defect": "config_mismatch"},
+        )
+        _logger.warning(
+            "Scan checkpoint was written under a different search space or "
+            "sync_every; rejecting it and recomputing from COMPLETE history."
+        )
+        state = None
+    if state is not None:
+        run_id = int(state["run_id"])
+        chunk_floor = int(state["chunk_idx"])
+        # Told ops of this run at/after the restored chunk landed past the
+        # watermark: the re-run chunks regenerate them bit-identically, so
+        # they are skipped at tell time and refunded at dispatch time.
+        dup_counts: dict[int, int] = {}
+        for token in ops.told:
+            parsed = _ckpt.parse_op_token(token)
+            if parsed is None or parsed[0] != run_id or parsed[1] is None:
+                continue
+            if parsed[1] >= chunk_floor:
+                dup_counts[parsed[1]] = dup_counts.get(parsed[1], 0) + 1
+        told = int(state["told"]) + sum(dup_counts.values())
+        adoptable: dict[str, int] = {}
+        reap = list(ops.stranded)
+        for token, tid in ops.running.items():
+            parsed = _ckpt.parse_op_token(token)
+            if parsed is not None and parsed[0] == run_id:
+                adoptable[token] = tid
+            else:
+                reap.append(tid)
+        ledger = _ResumeLedger(ops.told, adoptable, dup_counts)
+        _logger.info(
+            f"Resuming scan run {run_id} from checkpoint seq {rec.seq}: "
+            f"re-dispatching from chunk {chunk_floor} with {told} tells "
+            f"already synced ({sum(dup_counts.values())} past the watermark "
+            "will be re-run and skipped, not re-told)."
+        )
+    else:
+        telemetry.count("checkpoint.fallback", meta={"kind": "scan"})
+        run_id = ops.max_run_id + 1
+        told = len(ops.told)
+        reap = list(ops.stranded) + list(ops.running.values())
+        ledger = _ResumeLedger(ops.told, {}, {})
+        _logger.warning(
+            f"No usable scan checkpoint; resuming as run {run_id} via the "
+            f"recompute-from-COMPLETE-history path ({told} synced tells "
+            "already count against the budget)."
+        )
+    _reap_strays(
+        study,
+        reap,
+        reason="stranded RUNNING stray from a preempted scan run, reaped at resume",
+    )
+    return state, ledger, run_id, told
+
+
+def _reap_strays(study, trial_ids, *, reason: str) -> None:
+    """FAIL out RUNNING strays a dead process left behind, marked
+    ``ckpt:stranded`` so resume budget accounting excludes them forever."""
+    storage = study._storage
+    for tid in trial_ids:
+        try:
+            storage.set_trial_system_attr(tid, _ckpt.STRANDED_ATTR, True)
+            storage.set_trial_system_attr(tid, "fail_reason", reason)
+            storage.set_trial_state_values(tid, state=TrialState.FAIL)
+        except Exception as err:  # graphlint: ignore[PY001] -- reaping is best-effort cleanup; a blip must not abort the resume (the stray stays RUNNING until a later resume retries)
+            _logger.warning(
+                f"reaping stranded trial id {tid} raised {err!r}; continuing."
+            )
+    if trial_ids:
+        study._thread_local.cached_all_trials = None
+
+
+def _write_scan_checkpoint(storage, study_id, stash, *, told: int, seq: int) -> None:
+    """Persist one loop-top carry stash into the ``ckpt:scan:*`` ring.
+
+    Device arrays are realized to numpy here — always after the stash's
+    originating chunk has been synced (the host already blocked on it), so
+    the transfers never stall the dispatch pipeline."""
+    state = dict(stash)
+    state["told"] = int(told)
+    for field in ("X", "y", "m"):
+        state[field] = np.asarray(state[field])
+    state["n_dev"] = int(np.asarray(state["n_dev"]))
+    for field in ("warm_raw", "Z", "zy", "zm"):
+        if state[field] is not None:
+            state[field] = np.asarray(state[field])
+    _ckpt.write_checkpoint(storage, study_id, "scan", state, n_told=told, seq=seq)
 
 
 def _fail_remaining(study, trials, reason: str) -> None:

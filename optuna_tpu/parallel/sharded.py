@@ -55,6 +55,7 @@ from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 import numpy as np
 
 from optuna_tpu import _tracing, device_stats, flight, health, telemetry
+from optuna_tpu import checkpoint as _ckpt
 from optuna_tpu.logging import get_logger
 from optuna_tpu.parallel.executor import ResilientBatchExecutor, build_non_finite_guard
 from optuna_tpu.parallel.ici_journal import IciJournalBackend
@@ -520,6 +521,16 @@ class ShardedBatchExecutor(ResilientBatchExecutor):
         # index, so bisected/halved re-dispatches still attribute their
         # throughput and quarantines to the right shard.
         self._shard_of: dict[int, int] = {}
+        # Durable batch-boundary progress marker (ckpt:sharded ring). The
+        # seq continues above any dead incarnation's; both the peek and the
+        # per-batch counters are derived purely from merged-journal state
+        # and batch outcomes, so every lockstep pod host computes them
+        # identically.
+        self._ckpt_seq = (
+            _ckpt.max_slot_seq(study._storage, study._study_id, "sharded") + 1
+        )
+        self._ckpt_batches = 0
+        self._ckpt_advanced = 0
 
     # ------------------------------------------------------------- sharding
 
@@ -604,6 +615,28 @@ class ShardedBatchExecutor(ResilientBatchExecutor):
             # The documented exchange point: one pod-wide collective closes
             # every batch, aligning lockstep hosts and flushing the round.
             self._pod.barrier()
+        # Durable batch-boundary checkpoint. Every pod process makes the
+        # SAME deterministic call: the leader appends the attr, and each
+        # follower's PodFollowerStorage mirrors it by pacing one collective
+        # — a literal leader-only call would leave the followers one
+        # exchange behind and deadlock the pod. (The per-trial state itself
+        # already lives in storage; this marker is what a resume's doctor
+        # and the fleet's re-homing read for batch-level progress.)
+        self._ckpt_batches += 1
+        self._ckpt_advanced += int(advanced)
+        _ckpt.write_checkpoint(
+            self._study._storage,
+            self._study._study_id,
+            "sharded",
+            {
+                "batch_idx": self._ckpt_batches,
+                "trials_advanced": self._ckpt_advanced,
+                "n_shards": self._n_shards,
+            },
+            n_told=self._ckpt_advanced,
+            seq=self._ckpt_seq,
+        )
+        self._ckpt_seq += 1
         return advanced
 
 

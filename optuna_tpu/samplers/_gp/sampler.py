@@ -12,7 +12,7 @@ the host only encodes/decodes params and sequences the pipeline.
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Any, Callable, Sequence
+from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
 import numpy as np
 
@@ -215,6 +215,37 @@ class GPSampler(BaseSampler):
     def reseed_rng(self) -> None:
         self._rng.seed()
         self._independent_sampler.reseed_rng()
+
+    # -------------------------------------------- fitted-state checkpoints
+
+    def export_fitted_state(self) -> "dict[str, Any] | None":
+        """The sampler's picklable fitted state (:mod:`optuna_tpu.checkpoint`
+        duck-typed hook): the kernel-param warm-start cache, keyed by
+        search-space signature. None while nothing has been fitted — there
+        is nothing for a successor to warm-load. Device-space constants,
+        speculative queues, and AOT executables are deliberately excluded:
+        they are recomputed/recompiled per process and carry no posterior."""
+        if not self._kernel_params_cache:
+            return None
+        return {
+            "kernel_params_cache": {
+                sig: [np.asarray(p) for p in params]
+                for sig, params in self._kernel_params_cache.items()
+            },
+        }
+
+    def restore_fitted_state(self, state: "Mapping[str, Any]") -> bool:
+        """Warm-load an exported kernel-param cache (True iff anything was
+        accepted). Existing entries win — a live fit is never overwritten
+        by a dead process's older one."""
+        cache = state.get("kernel_params_cache") if isinstance(state, Mapping) else None
+        if not isinstance(cache, dict) or not cache:
+            return False
+        for sig, params in cache.items():
+            self._kernel_params_cache.setdefault(
+                tuple(sig), [np.asarray(p) for p in params]
+            )
+        return True
 
     # ------------------------------------------------------- large-n switch
 

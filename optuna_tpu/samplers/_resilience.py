@@ -44,7 +44,7 @@ from __future__ import annotations
 import itertools
 import math
 import time
-from typing import TYPE_CHECKING, Any, Callable, Sequence
+from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
 import numpy as np
 
@@ -428,6 +428,22 @@ class GuardedSampler(BaseSampler):
 
     def __str__(self) -> str:
         return f"GuardedSampler({self._sampler})"
+
+    # -------------------------------------------- fitted-state checkpoints
+
+    def export_fitted_state(self) -> "dict[str, Any] | None":
+        """Delegate :mod:`optuna_tpu.checkpoint`'s duck-typed fitted-state
+        export to the wrapped sampler — the guard itself holds no posterior
+        worth persisting (pins and fallback bookkeeping are per-process)."""
+        hook = getattr(self._sampler, "export_fitted_state", None)
+        return None if hook is None else hook()
+
+    def restore_fitted_state(self, state: "Mapping[str, Any]") -> bool:
+        """Warm-load a dead guard's exported fitted state into the wrapped
+        sampler (True iff accepted); a re-homing hub calls this instead of
+        paying a cold fit."""
+        hook = getattr(self._sampler, "restore_fitted_state", None)
+        return False if hook is None else bool(hook(state))
 
     # -------------------------------------------------- autopilot actuator
 

@@ -49,6 +49,7 @@ from bisect import bisect_right
 from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
 from optuna_tpu import flight, locksan, telemetry
+from optuna_tpu import checkpoint as _ckpt
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._retry import RetryPolicy, TransientStorageError
 
@@ -578,10 +579,40 @@ class FleetHub:
                 "serve.fleet.hub_rehome",
                 meta={"study": study_id, "dead": primary, "to": self.name},
             )
+            warm = self._warm_load(study_id)
             _logger.warning(
                 f"study {study_id} re-homed from dead hub {primary!r} to "
-                f"{self.name!r}; serve state rebuilt from the shared journal."
+                f"{self.name!r}; serve state rebuilt from the shared journal"
+                + (" with the dead hub's fitted sampler state warm-loaded."
+                   if warm else "; no warm fitted state was available.")
             )
+
+    def _warm_load(self, study_id: int) -> bool:
+        """Warm-load the dead primary's ``ckpt:hub`` checkpoint into this
+        hub's handle: its fitted sampler state (so the successor's first
+        fit is warm, not cold) and its ready-queue epoch watermark (a
+        second floor beside the replicator's, for the window where the
+        dead hub checkpointed past its last watermark publish). Best-effort
+        trust-but-verify: a torn/stale blob just means a cold fit."""
+        record = _ckpt.load_checkpoint(
+            self.service._storage, study_id, "hub"
+        )
+        if record is None:
+            return False
+        handle = self.service._handle(study_id)
+        with handle.lock:
+            warmed = _ckpt.restore_sampler_state(
+                handle.guarded, record.state.get("sampler")
+            )
+            epoch_floor = int(record.state.get("epoch", 0))
+            while handle.queue.epoch < epoch_floor:
+                handle.queue.invalidate()
+        if warmed:
+            telemetry.count(
+                "checkpoint.warm_load",
+                meta={"study": study_id, "to": self.name, "seq": record.seq},
+            )
+        return warmed
 
     def _publish_watermark(self, study_id: int) -> None:
         if self.solo:

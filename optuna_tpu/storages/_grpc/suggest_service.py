@@ -73,6 +73,7 @@ from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
 from optuna_tpu import flight, locksan, telemetry
+from optuna_tpu import checkpoint as _ckpt
 from optuna_tpu.distributions import (
     BaseDistribution,
     distribution_to_json,
@@ -508,6 +509,11 @@ class _StudyHandle:
         #: increments are fine: this is a nonzero/zero heuristic, not a
         #: counter anything aggregates.
         self.asks_since_fill = 0
+        #: Tells this handle has observed over its lifetime — the
+        #: ``ckpt:hub`` watermark — and the ring's write counter (lazily
+        #: seeded above any dead hub's seq at the first write).
+        self.tells_total = 0
+        self.ckpt_seq: int | None = None
         self.lock = locksan.lock("suggest.handle")
 
 
@@ -570,6 +576,7 @@ class SuggestService:
         clock: Callable[[], float] = time.monotonic,
         health_reporting: bool = True,
         health_worker_id: str | None = None,
+        checkpoint_every: int = 8,
     ) -> None:
         self._storage = storage
         self._sampler_factory = sampler_factory
@@ -584,6 +591,12 @@ class SuggestService:
         #: refill swap is in flight. 0 is the strict mode: any invalidation
         #: stales the queue immediately and misses pay a real fit.
         self.max_stale_epochs = max(0, int(max_stale_epochs))
+        #: Tell-tick cadence of the durable ``ckpt:hub`` fitted-state
+        #: snapshot (0 disables): every N observed tells the handle's
+        #: GuardedSampler exports its fit + ready-queue epoch into the
+        #: study's checkpoint ring, so a re-homing successor hub warm-loads
+        #: instead of paying a cold fit.
+        self.checkpoint_every = max(0, int(checkpoint_every))
         self.shed_policy = shed_policy if shed_policy is not None else ShedPolicy(clock=clock)
         self._clock = clock
         self._health_reporting = health_reporting
@@ -1116,6 +1129,12 @@ class SuggestService:
             # for ownership would cost a read per tell — invalidation is
             # per-service evidence instead, conservative by design.
             handle.tells_since_fill += 1
+            handle.tells_total += 1
+            if (
+                self.checkpoint_every > 0
+                and handle.tells_total % self.checkpoint_every == 0
+            ):
+                self._write_hub_checkpoint(study_id, handle)
             if handle.tells_since_fill >= self.invalidate_after:
                 if handle.queue.fresh_len() > 0:
                     telemetry.count("serve.ready_queue.invalidate")
@@ -1141,6 +1160,30 @@ class SuggestService:
             from optuna_tpu import autopilot
 
             autopilot.maybe_step(handle.study, service=self)
+
+    def _write_hub_checkpoint(self, study_id: int, handle: _StudyHandle) -> None:
+        """Persist the handle's fitted sampler state + ready-queue epoch
+        into the study's ``ckpt:hub`` ring (best-effort, tell-tick
+        cadence). Skipped when the sampler exports no fitted state —
+        there is nothing for a successor to warm-load. The export runs
+        under ``handle.lock`` (it reads the one server-resident sampler's
+        fit); the storage write deliberately does not."""
+        with handle.lock:
+            state = _ckpt.export_sampler_state(handle.guarded)
+            epoch = handle.queue.epoch
+        if state is None:
+            return
+        if handle.ckpt_seq is None:
+            handle.ckpt_seq = _ckpt.max_slot_seq(self._storage, study_id, "hub") + 1
+        _ckpt.write_checkpoint(
+            self._storage,
+            study_id,
+            "hub",
+            {"sampler": state, "epoch": int(epoch)},
+            n_told=handle.tells_total,
+            seq=handle.ckpt_seq,
+        )
+        handle.ckpt_seq += 1
 
     # ------------------------------------------------------------ lifecycle
 

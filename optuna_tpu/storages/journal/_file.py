@@ -54,18 +54,26 @@ def unframe_snapshot(data: bytes | None, *, source: str) -> bytes | None:
         return None
     header = len(SNAPSHOT_MAGIC) + _SNAPSHOT_CRC_STRUCT.size
     if len(data) < header or not data.startswith(SNAPSHOT_MAGIC):
+        # Name the defect precisely: a replay-from-logs decision should be
+        # debuggable from the log line alone (what was there vs. expected).
         _logger.warning(
-            f"Journal snapshot at {source} lacks the CRC header (legacy or "
-            "corrupt); ignoring it and replaying the journal from scratch."
+            f"Journal snapshot at {source} lacks the CRC header: got "
+            f"{len(data)} bytes, need >= {header} starting with "
+            f"{SNAPSHOT_MAGIC!r} (found {data[:len(SNAPSHOT_MAGIC)]!r}). "
+            "Legacy or corrupt snapshot; ignoring it and replaying the "
+            "journal from its logs instead."
         )
         return None
     (expected,) = _SNAPSHOT_CRC_STRUCT.unpack_from(data, len(SNAPSHOT_MAGIC))
     payload = data[header:]
-    if zlib.crc32(payload) != expected:
+    computed = zlib.crc32(payload)
+    if computed != expected:
         _logger.warning(
-            f"Journal snapshot at {source} failed its CRC32 check (torn "
-            "write or corruption); ignoring it and replaying the journal "
-            "from scratch."
+            f"Journal snapshot at {source} failed its CRC32 check: payload "
+            f"of {len(payload)} bytes at offset {header} computed "
+            f"0x{computed:08x}, header claims 0x{expected:08x} (torn write "
+            "or corruption). Ignoring it and replaying the journal from "
+            "its logs instead."
         )
         return None
     return payload
@@ -327,4 +335,15 @@ class JournalFileBackend(BaseJournalBackend):
                 data = f.read()
         except OSError:
             return None
-        return unframe_snapshot(data, source=self._snapshot_path)
+        payload = unframe_snapshot(data, source=self._snapshot_path)
+        if payload is None:
+            # Bytes existed on disk but failed integrity: that is a rejected
+            # snapshot (counted), not a missing one (silent). The counter
+            # lives at the consumer, not in unframe_snapshot, because the
+            # checkpoint module reuses the framing and must not pollute the
+            # journal's rejection metric.
+            telemetry.count(
+                "journal.snapshot_rejected",
+                meta={"source": self._snapshot_path, "defect": "crc"},
+            )
+        return payload

@@ -28,6 +28,7 @@ from optuna_tpu.distributions import (
     distribution_to_json,
     json_to_distribution,
 )
+from optuna_tpu import telemetry
 from optuna_tpu.exceptions import DuplicatedStudyError, UpdateFinishedTrialError
 from optuna_tpu.logging import get_logger
 from optuna_tpu.storages._base import DEFAULT_STUDY_NAME_PREFIX, BaseStorage
@@ -322,8 +323,17 @@ class JournalStorage(BaseStorage):
                 if isinstance(restored, _ReplayResult):
                     self._replay = restored
                     self._replay.own_results = {}
-            except (pickle.UnpicklingError, AttributeError, ImportError):
-                _logger.warning("Failed to load journal snapshot; replaying from scratch.")
+            except (pickle.UnpicklingError, AttributeError, ImportError) as err:
+                telemetry.count(
+                    "journal.snapshot_rejected",
+                    meta={"defect": "unpickle", "error": type(err).__name__},
+                )
+                _logger.warning(
+                    f"Journal snapshot passed its CRC but failed to unpickle "
+                    f"({type(err).__name__}: {err}); likely written by a "
+                    "different release. Replaying the journal from its logs "
+                    "instead."
+                )
         self._sync()
 
     def __getstate__(self) -> dict[str, Any]:

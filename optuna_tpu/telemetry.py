@@ -98,6 +98,8 @@ PHASES: dict[str, str] = {
     "serve.ask": "one suggestion-service ask served end to end (queue pop, shed rung, or coalesced dispatch)",
     "serve.coalesce": "one fused proposal dispatch answering a whole coalesced ask batch",
     "serve.ready_queue": "one speculative ask-ahead refill dispatch (background, off the RPC path)",
+    "ckpt.write": "one best-effort durable checkpoint write at a loop boundary (encode + attr write)",
+    "ckpt.restore": "one resume's checkpoint validation + carry reconstruction (load, verify, rebuild)",
 }
 
 #: The containment-counter vocabulary: one entry per event family the
@@ -121,6 +123,8 @@ COUNTERS: dict[str, str] = {
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
     "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
     "locksan.verdict": "(suffixed by kind) the lock sanitizer reported a potential deadlock cycle or a blocking window under held locks",
+    "checkpoint": "(suffixed by checkpoint event) a durable-checkpoint lifecycle event: write, rejection, restore, fallback, or warm load",
+    "journal.snapshot_rejected": "a journal snapshot failed its CRC/unpickle validation and was replaced by a full log replay",
 }
 
 _PHASE_METRIC_PREFIX = "phase."

@@ -361,6 +361,10 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "service.hub_dead": "SIGKILL one FakeHubFleet hub mid-burst (HubChaosPlan): its -serve "
     "snapshot goes stale past grace, the doctor names the dead hub, and the healthy-fleet "
     "twin stays clean",
+    "checkpoint.stale": "garble every ckpt: ring slot before a resume (CheckpointChaosPlan's "
+    "corrupt-blob leg): each blob is CRC-rejected and counted, the resume falls back to the "
+    "recompute-from-history path, and the doctor reports the rejection totals; the "
+    "clean-resume twin stays unflagged",
 }
 
 
@@ -759,6 +763,77 @@ def hub_chaos_plan() -> HubChaosPlan:
     """The default :class:`HubChaosPlan` the chaos suite runs — kill one of
     four hubs after six trials, with two committed-but-unacked drops."""
     return HubChaosPlan()
+
+
+# The preemption scenario required for every checkpoint lifecycle event.
+# Canonical key source: ``checkpoint.CHECKPOINT_EVENTS``; graphlint rule
+# CKPT001 cross-checks both against
+# ``_lint/registry.py::CHECKPOINT_EVENT_REGISTRY`` — adding a checkpoint
+# event without a preemption scenario that forces it is a lint failure (the
+# STO001/.../FLT001 pattern), because an unexercised restore path loses its
+# first real study to the spot fleet's *default* failure mode.
+CHECKPOINT_CHAOS_MATRIX: dict[str, str] = {
+    "write": "run a scan study over a journal storage; every chunk sync (and the startup "
+    "sync) leaves a CRC-framed blob in the ckpt: ring and bumps the write counter",
+    "write_error": "blip set_study_system_attr under FaultInjectorStorage exactly when the "
+    "checkpoint write lands; the loop continues uncheckpointed and the error is counted",
+    "restore": "SIGKILL the loop mid-chunk-sync (SimulatedWorkerDeath in-process; bench "
+    "--preempt-at for the real signal); optimize_scan(resume=True) rebuilds the carry from "
+    "the newest valid blob and reaches the fault-free twin's best value",
+    "rejected": "garble a ring slot (bad base64 / torn CRC / wrong schema version) before "
+    "the resume; the blob is skipped and counted, the surviving slot (or fallback) serves",
+    "stale": "plant a valid blob whose n_told watermark trails the synced history by more "
+    "than one write interval; the resume skips it as stale and recomputes",
+    "fallback": "garble every ring slot; the resume counts the fallback, recomputes the "
+    "carry from COMPLETE history, and still finishes the exact remaining budget",
+    "warm_load": "kill a FakeHubFleet hub after its sampler fitted; the ring successor's "
+    "adopt warm-loads the dead hub's exported sampler state and answers the next ask "
+    "without a cold fit",
+}
+
+
+@dataclass(frozen=True)
+class CheckpointChaosPlan:
+    """One deterministic preemption chaos scenario: a scan study over a
+    durable (journal) storage, a SIGKILL mid-chunk-sync after
+    :attr:`preempt_after_tells` budget-consuming tells, and a relaunch with
+    ``optimize_scan(resume=True)`` — plus the exact outcome the acceptance
+    test asserts (``tests/test_checkpoint_chaos.py``): the resumed study
+    completes exactly ``n_trials`` budget-consuming tells, zero trials are
+    left RUNNING, no op token is ever told twice, and the best value equals
+    the uninterrupted same-seed twin's bit-for-bit. The corrupt-blob leg
+    additionally garbles :attr:`corrupt_slots` of the ckpt: ring before the
+    resume and asserts every garbled blob is CRC-rejected + counted, the
+    doctor reports ``checkpoint.stale``, and the study still completes via
+    the recompute-from-history fallback.
+
+    ``preempt_after_tells`` deliberately lands *inside* a chunk sync
+    (neither 0 nor a multiple of ``sync_every``): the hard case is a chunk
+    half-told at death, which exercises dup-skip (already-told ops) and
+    adoption (token-stamped RUNNING strays) in the same resumed chunk.
+    """
+
+    n_trials: int = 96
+    sync_every: int = 8
+    n_startup_trials: int = 8
+    seed: int = 11
+    #: Budget-consuming tells after which the SIGKILL (stand-in) strikes —
+    #: mid-chunk by construction (see class docstring).
+    preempt_after_tells: int = 44
+    #: Ring slots to garble before the resume in the corrupt-blob leg.
+    corrupt_slots: tuple[int, ...] = (0, 1)
+
+    @property
+    def preempt_chunk(self) -> int:
+        """The chunk index the kill lands in (0-based, after startup)."""
+        return (self.preempt_after_tells - self.n_startup_trials) // self.sync_every
+
+
+def checkpoint_chaos_plan() -> CheckpointChaosPlan:
+    """The default :class:`CheckpointChaosPlan` the chaos suite runs — kill
+    a 96-trial scan study 44 tells in (mid-chunk), resume, and compare to
+    the uninterrupted twin."""
+    return CheckpointChaosPlan()
 
 
 class FakeHubFleet:

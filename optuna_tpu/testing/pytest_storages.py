@@ -328,6 +328,129 @@ class StorageTestCase:
         with pytest.raises(KeyError):
             storage.get_trial(987654321)
 
+    # -------------------------------------------- checkpoint attr namespace
+    # The preemption checkpoints (optuna_tpu/checkpoint.py) persist through
+    # the plain study-system-attr surface, so the `ckpt:` namespace is part
+    # of the storage contract: every backend must round-trip the framed
+    # blobs, keep the two-slot ring bounded, and never clobber neighboring
+    # system attrs — including under injected transient faults (the
+    # under-faults matrix reruns these through FaultInjectorStorage).
+
+    def test_checkpoint_round_trip(self, storage: BaseStorage) -> None:
+        from optuna_tpu import checkpoint as ckpt
+
+        sid = storage.create_new_study(MINIMIZE)
+        state = {"told": 3, "x": [1.0, 2.0], "names": ("a", "b")}
+        ckpt.write_checkpoint(storage, sid, "scan", state, n_told=3, seq=0)
+        rec = ckpt.load_checkpoint(storage, sid, "scan")
+        assert rec is not None
+        assert (rec.kind, rec.seq, rec.n_told) == ("scan", 0, 3)
+        assert rec.state["x"] == [1.0, 2.0]
+        assert rec.state["names"] == ("a", "b")
+        # Kinds are independent namespaces.
+        assert ckpt.load_checkpoint(storage, sid, "hub") is None
+
+    def test_checkpoint_newest_slot_wins_ring_bounded(
+        self, storage: BaseStorage
+    ) -> None:
+        from optuna_tpu import checkpoint as ckpt
+
+        sid = storage.create_new_study(MINIMIZE)
+        for seq in range(5):
+            ckpt.write_checkpoint(
+                storage, sid, "scan", {"echo": seq}, n_told=seq, seq=seq
+            )
+        rec = ckpt.load_checkpoint(storage, sid, "scan")
+        assert rec is not None and rec.seq == 4 and rec.state["echo"] == 4
+        keys = [
+            k
+            for k in storage.get_study_system_attrs(sid)
+            if k.startswith(ckpt.CKPT_ATTR_PREFIX)
+        ]
+        # Bounded ring: five writes leave exactly RING_SLOTS keys, not five.
+        assert len(keys) == ckpt.RING_SLOTS
+        assert ckpt.max_slot_seq(storage, sid, "scan") == 4
+
+    def test_checkpoint_corrupt_newest_falls_back_to_older(
+        self, storage: BaseStorage
+    ) -> None:
+        from optuna_tpu import checkpoint as ckpt
+
+        sid = storage.create_new_study(MINIMIZE)
+        ckpt.write_checkpoint(storage, sid, "scan", {"n": 6}, n_told=6, seq=6)
+        ckpt.write_checkpoint(storage, sid, "scan", {"n": 7}, n_told=7, seq=7)
+        slot = 7 % ckpt.RING_SLOTS
+        storage.set_study_system_attr(
+            sid, f"{ckpt.CKPT_ATTR_PREFIX}scan:{slot}", "!not-base64!"
+        )
+        rec = ckpt.load_checkpoint(storage, sid, "scan")
+        assert rec is not None and rec.seq == 6 and rec.state["n"] == 6
+
+    def test_checkpoint_future_watermark_rejected(
+        self, storage: BaseStorage
+    ) -> None:
+        from optuna_tpu import checkpoint as ckpt
+
+        sid = storage.create_new_study(MINIMIZE)
+        ckpt.write_checkpoint(storage, sid, "scan", {}, n_told=10, seq=0)
+        # A checkpoint claiming MORE synced tells than the storage holds is
+        # from a future the storage never saw — refused, not trusted.
+        assert ckpt.load_checkpoint(storage, sid, "scan", synced_told=4) is None
+        assert (
+            ckpt.load_checkpoint(storage, sid, "scan", synced_told=10) is not None
+        )
+
+    def test_checkpoint_op_token_round_trip(self, storage: BaseStorage) -> None:
+        from optuna_tpu import checkpoint as ckpt
+
+        sid = storage.create_new_study(MINIMIZE)
+        tid = storage.create_new_trial(sid)
+        token = ckpt.op_token(2, 5, 1)
+        storage.set_trial_system_attr(tid, ckpt.OP_TOKEN_ATTR, token)
+        storage.set_trial_state_values(tid, TrialState.COMPLETE, [0.5])
+        ops = ckpt.synced_ops(storage.get_all_trials(sid, deepcopy=False))
+        assert token in ops.told
+        assert ops.max_run_id == 2
+        assert ckpt.parse_op_token(token) == (2, 5, 1)
+
+    def test_retry_clone_fixed_params_survive_checkpointed_study(
+        self, storage: BaseStorage
+    ) -> None:
+        from optuna_tpu import checkpoint as ckpt
+
+        sid = storage.create_new_study(MINIMIZE)
+        dist = FloatDistribution(0.0, 1.0)
+        tid = storage.create_new_trial(sid)
+        storage.set_trial_param(tid, "x", 0.25, dist)
+        storage.set_trial_state_values(tid, TrialState.FAIL)
+        clone = FrozenTrial(
+            number=-1,
+            state=TrialState.WAITING,
+            value=None,
+            datetime_start=None,
+            datetime_complete=None,
+            params={"x": 0.25},
+            distributions={"x": dist},
+            user_attrs={},
+            system_attrs={
+                "failed_trial": 0,
+                "retry_history": [0],
+                "fixed_params": {"x": 0.25},
+            },
+            intermediate_values={},
+            trial_id=-1,
+        )
+        clone_id = storage.create_new_trial(sid, template_trial=clone)
+        # A mid-study checkpoint lands in the same study attr table; the
+        # retry lineage must survive beside it, unclobbered, at resume.
+        ckpt.write_checkpoint(storage, sid, "scan", {"told": 1}, n_told=1, seq=0)
+        got = storage.get_trial(clone_id)
+        assert got.system_attrs["fixed_params"] == {"x": 0.25}
+        assert got.system_attrs["retry_history"] == [0]
+        assert got.system_attrs["failed_trial"] == 0
+        rec = ckpt.load_checkpoint(storage, sid, "scan")
+        assert rec is not None and rec.n_told == 1
+
     # ------------------------------------------------ end-to-end over a Study
 
     def test_study_end_to_end_over_storage(self, storage: BaseStorage) -> None:

@@ -1,0 +1,5 @@
+# graphlint fixture: CKPT001 negative — both copies agree with the registry.
+CHECKPOINT_CHAOS_MATRIX = {
+    "preempt_resume": "SIGKILL the loop mid-chunk; resume restores the newest valid blob",
+    "torn_blob": "tear a blob mid-write; its CRC rejects it and the older slot wins",
+}

@@ -1,0 +1,5 @@
+# graphlint fixture: CKPT001 negative — both copies agree with the registry.
+CHECKPOINT_EVENTS = {
+    "preempt_resume": "what the event means for a preempted study",
+    "torn_blob": "what the event means for a preempted study",
+}

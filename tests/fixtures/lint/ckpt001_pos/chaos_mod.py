@@ -1,0 +1,2 @@
+# graphlint fixture: CKPT001 — this copy DRIFTED: 'torn_blob' is missing.
+CHECKPOINT_CHAOS_MATRIX = {"preempt_resume": "scenario"}  # EXPECT: CKPT001

@@ -1,0 +1,6 @@
+# graphlint fixture: CKPT001 — this copy DRIFTED: 'ghost_event' is extra.
+CHECKPOINT_EVENTS = {  # EXPECT: CKPT001
+    "preempt_resume": "scenario",
+    "torn_blob": "scenario",
+    "ghost_event": "scenario",
+}

@@ -1,0 +1,321 @@
+"""Checkpoint/restore unit contracts (ISSUE 19, ``optuna_tpu/checkpoint.py``).
+
+The blob contract (CRC framing, schema versioning, the 2-slot ring, the
+trial-count watermark), op-token parsing and resume classification, the
+seq-monotonicity peek, the duck-typed fitted-sampler hooks (GPSampler +
+GuardedSampler delegation), the sharded batch-boundary write, and the
+in-process stop-then-resume determinism of the scan loop. The SIGKILL
+chaos acceptance lives in ``tests/test_checkpoint_chaos.py``; the
+per-backend attr round-trips ride the storage-contract matrix
+(``optuna_tpu/testing/pytest_storages.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import optuna_tpu
+from optuna_tpu import checkpoint as ckpt
+from optuna_tpu import telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.models.benchmarks import hartmann6_jax
+from optuna_tpu.parallel import VectorizedObjective, optimize_scan
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.trial._state import TrialState
+
+optuna_tpu.logging.set_verbosity(optuna_tpu.logging.ERROR)
+
+SPACE6 = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(6)}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability():
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    yield
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _counters() -> dict:
+    return telemetry.get_registry().snapshot()["counters"]
+
+
+def _study_sid():
+    storage = InMemoryStorage()
+    sid = storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    return storage, sid
+
+
+# ----------------------------------------------------------- blob contract
+
+
+def test_write_then_load_counts_events():
+    storage, sid = _study_sid()
+    assert ckpt.write_checkpoint(storage, sid, "scan", {"a": 1}, n_told=4, seq=0)
+    rec = ckpt.load_checkpoint(storage, sid, "scan")
+    assert rec == ckpt.CheckpointRecord(kind="scan", seq=0, n_told=4, state={"a": 1})
+    counters = _counters()
+    assert counters["checkpoint.write"] == 1
+    assert counters["checkpoint.restore"] == 1
+
+
+def test_write_is_best_effort_on_storage_failure():
+    class _Broken:
+        def set_study_system_attr(self, *a, **k):
+            raise RuntimeError("disk on fire")
+
+    assert ckpt.write_checkpoint(_Broken(), 0, "scan", {}, n_told=0, seq=0) is False
+    assert _counters()["checkpoint.write_error"] == 1
+
+
+def test_schema_version_mismatch_rejected():
+    storage, sid = _study_sid()
+    blob = ckpt.encode_checkpoint("scan", {}, n_told=0, seq=0)
+    storage.set_study_system_attr(sid, "ckpt:scan:0", blob)
+    real = ckpt.CHECKPOINT_SCHEMA_VERSION
+    try:
+        ckpt.CHECKPOINT_SCHEMA_VERSION = real + 1
+        assert ckpt.load_checkpoint(storage, sid, "scan") is None
+    finally:
+        ckpt.CHECKPOINT_SCHEMA_VERSION = real
+    assert _counters()["checkpoint.rejected"] == 1
+
+
+def test_kind_mismatch_and_nonstring_rejected():
+    storage, sid = _study_sid()
+    # A "hub" blob parked under a "scan" slot key must not restore as scan
+    # state (cross-kind confusion is a correctness bug, not a degradation).
+    blob = ckpt.encode_checkpoint("hub", {}, n_told=0, seq=0)
+    storage.set_study_system_attr(sid, "ckpt:scan:0", blob)
+    storage.set_study_system_attr(sid, "ckpt:scan:1", 12345)
+    assert ckpt.load_checkpoint(storage, sid, "scan") is None
+    assert _counters()["checkpoint.rejected"] == 2
+
+
+def test_stale_watermark_counted_and_skipped():
+    storage, sid = _study_sid()
+    ckpt.write_checkpoint(storage, sid, "scan", {}, n_told=10, seq=0)
+    assert (
+        ckpt.load_checkpoint(storage, sid, "scan", synced_told=40, max_lag=16)
+        is None
+    )
+    counters = _counters()
+    assert counters["checkpoint.stale"] == 1
+    # Within the lag bound the same blob restores.
+    assert (
+        ckpt.load_checkpoint(storage, sid, "scan", synced_told=20, max_lag=16)
+        is not None
+    )
+
+
+def test_max_slot_seq_survives_corrupt_newest_without_counting():
+    storage, sid = _study_sid()
+    assert ckpt.max_slot_seq(storage, sid, "scan") == -1
+    ckpt.write_checkpoint(storage, sid, "scan", {}, n_told=0, seq=4)
+    ckpt.write_checkpoint(storage, sid, "scan", {}, n_told=0, seq=5)
+    storage.set_study_system_attr(sid, "ckpt:scan:1", "@@not base64@@")
+    write_count = _counters().get("checkpoint.write", 0)
+    assert ckpt.max_slot_seq(storage, sid, "scan") == 4
+    # The peek neither counts nor restores: the registry is untouched.
+    counters = _counters()
+    assert counters.get("checkpoint.rejected", 0) == 0
+    assert counters.get("checkpoint.restore", 0) == 0
+    assert counters.get("checkpoint.write", 0) == write_count
+
+
+# --------------------------------------------------------------- op tokens
+
+
+def test_op_token_round_trip_and_malformed():
+    assert ckpt.parse_op_token(ckpt.op_token(3, 17, 2)) == (3, 17, 2)
+    assert ckpt.parse_op_token(ckpt.op_token(0, "s", 5)) == (0, None, 5)
+    for bad in (None, "", "r1:c2", "x1:c2:3", "r1:d2:3", "r1:c2:3:4", "r:c:s", 7):
+        assert ckpt.parse_op_token(bad) is None
+
+
+def test_synced_ops_classification():
+    storage, sid = _study_sid()
+    study = optuna_tpu.load_study(
+        study_name=storage.get_study_name_from_id(sid), storage=storage
+    )
+    # told: finished + tokened
+    t_told = storage.create_new_trial(sid)
+    storage.set_trial_system_attr(t_told, ckpt.OP_TOKEN_ATTR, ckpt.op_token(1, 0, 0))
+    storage.set_trial_state_values(t_told, TrialState.COMPLETE, [0.5])
+    # adoptable: RUNNING + tokened
+    t_run = storage.create_new_trial(sid)
+    run_token = ckpt.op_token(1, 1, 0)
+    storage.set_trial_system_attr(t_run, ckpt.OP_TOKEN_ATTR, run_token)
+    # stranded: RUNNING, no token
+    t_stray = storage.create_new_trial(sid)
+    # reaped earlier: finished + tokened but marked stranded — NOT told
+    t_reaped = storage.create_new_trial(sid)
+    storage.set_trial_system_attr(t_reaped, ckpt.OP_TOKEN_ATTR, ckpt.op_token(0, 2, 1))
+    storage.set_trial_system_attr(t_reaped, ckpt.STRANDED_ATTR, True)
+    storage.set_trial_state_values(t_reaped, TrialState.FAIL)
+
+    ops = ckpt.synced_ops(study.get_trials(deepcopy=False))
+    assert ops.told == frozenset({ckpt.op_token(1, 0, 0)})
+    assert ops.running == {run_token: t_run}
+    assert ops.stranded == (t_stray,)
+    assert ops.max_run_id == 1
+
+
+# ------------------------------------------------- fitted sampler hooks
+
+
+def test_sampler_hooks_absent_degrade():
+    class _Plain:
+        pass
+
+    assert ckpt.export_sampler_state(_Plain()) is None
+    assert ckpt.restore_sampler_state(_Plain(), {"x": 1}) is False
+    assert ckpt.restore_sampler_state(_Plain(), None) is False
+
+
+def test_sampler_hooks_failure_degrades():
+    class _Angry:
+        def export_fitted_state(self):
+            raise RuntimeError("no")
+
+        def restore_fitted_state(self, state):
+            raise RuntimeError("no")
+
+    assert ckpt.export_sampler_state(_Angry()) is None
+    assert ckpt.restore_sampler_state(_Angry(), {"x": 1}) is False
+
+
+def test_gp_sampler_fitted_state_round_trip():
+    from optuna_tpu.samplers import GPSampler
+
+    cold = GPSampler(seed=0)
+    assert cold.export_fitted_state() is None  # nothing fitted yet
+    assert cold.restore_fitted_state(None) is False
+    assert cold.restore_fitted_state({}) is False
+
+    donor = GPSampler(seed=0)
+    donor._kernel_params_cache[("sig", 8)] = [np.ones(3), np.float64(2.0)]
+    state = donor.export_fitted_state()
+    assert state is not None
+
+    heir = GPSampler(seed=1)
+    assert heir.restore_fitted_state(state) is True
+    np.testing.assert_array_equal(
+        heir._kernel_params_cache[("sig", 8)][0], np.ones(3)
+    )
+    # Live fits win over a restored state (setdefault semantics).
+    heir._kernel_params_cache[("sig", 8)] = [np.zeros(3)]
+    assert heir.restore_fitted_state(state) is True
+    np.testing.assert_array_equal(
+        heir._kernel_params_cache[("sig", 8)][0], np.zeros(3)
+    )
+
+
+def test_guarded_sampler_delegates_hooks():
+    from optuna_tpu.samplers import GPSampler
+    from optuna_tpu.samplers._resilience import GuardedSampler
+
+    inner = GPSampler(seed=0)
+    inner._kernel_params_cache[("sig", 8)] = [np.ones(2)]
+    guarded = GuardedSampler(inner)
+    state = ckpt.export_sampler_state(guarded)
+    assert state is not None
+
+    heir = GuardedSampler(GPSampler(seed=1))
+    assert ckpt.restore_sampler_state(heir, state) is True
+    assert ("sig", 8) in heir._sampler._kernel_params_cache
+
+
+# ------------------------------------------------- sharded batch boundary
+
+
+def test_sharded_batches_write_checkpoints():
+    from optuna_tpu.parallel import build_study_mesh, optimize_sharded
+    from optuna_tpu.samplers import TPESampler
+
+    space = {"x": FloatDistribution(0.0, 1.0)}
+    obj = VectorizedObjective(
+        fn=lambda params: (params["x"] - 0.5) ** 2, search_space=space
+    )
+    storage = InMemoryStorage()
+    study = optuna_tpu.create_study(storage=storage, sampler=TPESampler(seed=0))
+    mesh = build_study_mesh({"trials": 8, "model": 1})
+    optimize_sharded(study, obj, n_trials=16, batch_size=8, mesh=mesh)
+    rec = ckpt.load_checkpoint(storage, study._study_id, "sharded")
+    assert rec is not None
+    assert rec.state["batch_idx"] == 2
+    assert rec.state["trials_advanced"] == 16
+    assert rec.n_told == 16
+    assert _counters()["checkpoint.write"] == 2
+
+
+# ------------------------------------- in-process stop-then-resume (scan)
+
+
+def test_scan_stop_then_resume_matches_uninterrupted_twin():
+    def _run_twin():
+        twin = optuna_tpu.create_study()
+        optimize_scan(
+            twin,
+            VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6)),
+            n_trials=32, sync_every=8, n_startup_trials=8, seed=5,
+        )
+        return twin
+
+    stopped = [0]
+
+    def _stop_after_20(study, _trial):
+        stopped[0] += 1
+        if stopped[0] == 20:
+            study.stop()
+
+    study = optuna_tpu.create_study()
+    optimize_scan(
+        study,
+        VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6)),
+        n_trials=32, sync_every=8, n_startup_trials=8, seed=5,
+        callbacks=[_stop_after_20],
+    )
+    n_after_stop = len(study.trials)
+    assert n_after_stop < 32
+    optimize_scan(
+        study,
+        VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6)),
+        n_trials=32, sync_every=8, n_startup_trials=8, seed=5,
+        resume=True,
+    )
+    twin = _run_twin()
+    # Study.stop() mid-chunk quarantines the chunk's not-yet-told slots as
+    # FAIL (executor parity); resume re-tells exactly those slots, so the
+    # COMPLETE set — not the row count — is what must match the twin.
+    complete = [t for t in study.trials if t.state == TrialState.COMPLETE]
+    assert len(complete) == 32
+    assert not any(t.state == TrialState.RUNNING for t in study.trials)
+    assert study.best_value == twin.best_value
+    assert sorted(
+        tuple(sorted(t.params.items())) for t in complete
+    ) == sorted(
+        tuple(sorted(t.params.items()))
+        for t in twin.trials
+        if t.state == TrialState.COMPLETE
+    )
+    counters = _counters()
+    assert counters["checkpoint.restore"] == 1
+    assert counters.get("checkpoint.fallback", 0) == 0
+
+
+def test_resume_of_finished_study_is_a_noop():
+    study = optuna_tpu.create_study()
+    obj = VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6))
+    optimize_scan(study, obj, n_trials=16, sync_every=8, n_startup_trials=8, seed=3)
+    before = [(t.number, t.state) for t in study.trials]
+    optimize_scan(
+        study, obj, n_trials=16, sync_every=8, n_startup_trials=8, seed=3,
+        resume=True,
+    )
+    assert [(t.number, t.state) for t in study.trials] == before

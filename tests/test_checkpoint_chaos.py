@@ -1,0 +1,313 @@
+"""Preemption chaos acceptance (ISSUE 19 / CheckpointChaosPlan / CHECKPOINT_CHAOS_MATRIX).
+
+SIGKILL (``SimulatedWorkerDeath``, the in-process stand-in — ``bench.py
+--preempt-at`` delivers the real signal) a scan study mid-chunk-sync over a
+durable journal storage, relaunch with ``optimize_scan(resume=True)``, and
+the resumed study completes exactly the remaining budget: zero trials left
+RUNNING, no op token ever told twice, best value equal to the uninterrupted
+same-seed twin's bit-for-bit. The corrupt-blob leg garbles the whole
+``ckpt:`` ring before the resume: every blob is CRC-rejected and counted,
+the doctor reports ``checkpoint.stale``, and the study still completes via
+the recompute-from-COMPLETE-history fallback. The hub leg kills a
+:class:`FakeHubFleet` hub after its sampler fitted and asserts the ring
+successor warm-loads the dead hub's exported fitted state
+(``checkpoint.warm_load``). Everything runs under the armed lock sanitizer;
+zero verdicts is part of the acceptance.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import checkpoint as ckpt
+from optuna_tpu import flight, health, locksan, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.models.benchmarks import hartmann6_jax
+from optuna_tpu.parallel import VectorizedObjective, optimize_scan
+from optuna_tpu.samplers import TPESampler
+from optuna_tpu.storages import InMemoryStorage, JournalFileBackend, JournalStorage
+from optuna_tpu.storages._grpc.suggest_service import SuggestService
+from optuna_tpu.testing.fault_injection import (
+    CHECKPOINT_CHAOS_MATRIX,
+    CheckpointChaosPlan,
+    FakeHubFleet,
+    FaultInjectorStorage,
+    FaultPlan,
+    SimulatedWorkerDeath,
+    checkpoint_chaos_plan,
+)
+from optuna_tpu.trial._state import TrialState
+
+SPACE6 = {f"x{i}": FloatDistribution(0.0, 1.0) for i in range(6)}
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer():
+    """Every preemption scenario runs under the armed lock sanitizer: the
+    checkpoint writers sit inside the scan sync and the hub tell observer,
+    so a blocking window or inversion provoked by a death-and-resume becomes
+    a verdict — and ZERO verdicts is part of the chaos acceptance."""
+    locksan.enable()
+    yield
+    verdicts = locksan.report()["verdicts"]
+    locksan.disable()
+    locksan.reset()
+    assert verdicts == [], verdicts
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability(_lock_sanitizer):
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    saved_flight = flight.enabled()
+    health_was = health.enabled()
+    health.enable(interval_s=0.0)
+    yield
+    health.disable()
+    if health_was:
+        health.enable()
+    flight.disable()
+    if saved_flight:
+        flight.enable()
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _objective():
+    return VectorizedObjective(fn=hartmann6_jax, search_space=dict(SPACE6))
+
+
+def _optimize(study, plan: CheckpointChaosPlan, *, resume: bool = False) -> None:
+    optimize_scan(
+        study,
+        _objective(),
+        n_trials=plan.n_trials,
+        sync_every=plan.sync_every,
+        n_startup_trials=plan.n_startup_trials,
+        seed=plan.seed,
+        resume=resume,
+    )
+
+
+def _twin_best(plan: CheckpointChaosPlan):
+    twin = optuna_tpu.create_study()
+    _optimize(twin, plan)
+    return twin
+
+
+def _op_tokens(trials):
+    return [
+        t.system_attrs.get(ckpt.OP_TOKEN_ATTR)
+        for t in trials
+        if t.system_attrs.get(ckpt.OP_TOKEN_ATTR) is not None
+    ]
+
+
+def test_checkpoint_chaos_matrix_covers_every_event():
+    assert set(CHECKPOINT_CHAOS_MATRIX) == set(ckpt.CHECKPOINT_EVENTS)
+
+
+def test_plan_preempts_mid_chunk():
+    """The hard case by construction: the kill lands inside a chunk sync,
+    so the resumed chunk mixes dup-skips, an adoption, and fresh tells."""
+    plan = checkpoint_chaos_plan()
+    assert plan.preempt_after_tells > plan.n_startup_trials
+    assert (plan.preempt_after_tells - plan.n_startup_trials) % plan.sync_every != 0
+    assert plan.preempt_after_tells < plan.n_trials
+
+
+def test_sigkill_mid_chunk_resume_reaches_twin(tmp_path):
+    """The tentpole acceptance: SIGKILL mid-chunk-sync over a durable
+    journal, resume, and the study is indistinguishable from never having
+    died — exact budget, zero RUNNING, exactly-once tells, twin-equal best."""
+    plan = checkpoint_chaos_plan()
+    backend = JournalStorage(JournalFileBackend(str(tmp_path / "chaos.log")))
+    injector = FaultInjectorStorage(
+        backend,
+        FaultPlan(
+            kill_schedule={"set_trial_state_values": (plan.preempt_after_tells,)}
+        ),
+    )
+    study = optuna_tpu.create_study(storage=injector, study_name="preempt")
+    with pytest.raises(SimulatedWorkerDeath):
+        _optimize(study, plan)
+    assert injector.kills_injected == 1
+
+    dead = optuna_tpu.load_study(study_name="preempt", storage=backend)
+    told_before = {
+        t.system_attrs[ckpt.OP_TOKEN_ATTR]
+        for t in dead.trials
+        if t.state.is_finished() and ckpt.OP_TOKEN_ATTR in t.system_attrs
+    }
+    assert len(told_before) == plan.preempt_after_tells
+    # The half-told chunk leaves a token-stamped RUNNING stray (adopted at
+    # resume) — death punched through before its tell landed.
+    assert any(t.state == TrialState.RUNNING for t in dead.trials)
+
+    # ---- the relaunch: a fresh process over the same durable storage
+    resumed = optuna_tpu.load_study(study_name="preempt", storage=backend)
+    _optimize(resumed, plan, resume=True)
+
+    trials = resumed.trials
+    complete = [t for t in trials if t.state == TrialState.COMPLETE]
+    assert len(complete) == plan.n_trials
+    assert sum(1 for t in trials if t.state == TrialState.RUNNING) == 0
+    # Exactly-once: no op token appears on two trials, and every tell the
+    # dead run durably synced still stands (never re-told).
+    tokens = _op_tokens(trials)
+    assert len(tokens) == len(set(tokens))
+    assert told_before <= set(tokens)
+    # Reaped strays are marked, FAILed, and excluded from the budget.
+    strays = [t for t in trials if t.system_attrs.get(ckpt.STRANDED_ATTR)]
+    assert all(t.state == TrialState.FAIL for t in strays)
+
+    twin = _twin_best(plan)
+    assert resumed.best_value == twin.best_value
+    assert sorted(
+        tuple(sorted(t.params.items())) for t in complete
+    ) == sorted(
+        tuple(sorted(t.params.items()))
+        for t in twin.trials
+        if t.state == TrialState.COMPLETE
+    )
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("checkpoint.restore", 0) == 1
+    assert counters.get("checkpoint.fallback", 0) == 0
+    assert counters.get("checkpoint.write", 0) >= 2
+
+
+def test_corrupt_ring_falls_back_recomputes_and_doctor_reports():
+    """Garble every ``ckpt:`` ring slot before the resume: each blob is
+    CRC-rejected and counted (never trusted), the doctor surfaces
+    ``checkpoint.stale``, and the study still completes the exact remaining
+    budget via the recompute-from-COMPLETE-history fallback."""
+    plan = checkpoint_chaos_plan()
+    backend = InMemoryStorage()
+    injector = FaultInjectorStorage(
+        backend,
+        FaultPlan(
+            kill_schedule={"set_trial_state_values": (plan.preempt_after_tells,)}
+        ),
+    )
+    study = optuna_tpu.create_study(storage=injector, study_name="corrupt")
+    with pytest.raises(SimulatedWorkerDeath):
+        _optimize(study, plan)
+
+    sid = backend.get_study_id_from_name("corrupt")
+    for slot in plan.corrupt_slots:
+        backend.set_study_system_attr(
+            sid, f"{ckpt.CKPT_ATTR_PREFIX}scan:{slot}", "@@torn mid-write@@"
+        )
+
+    resumed = optuna_tpu.load_study(study_name="corrupt", storage=backend)
+    _optimize(resumed, plan, resume=True)
+
+    trials = resumed.trials
+    complete = [t for t in trials if t.state == TrialState.COMPLETE]
+    assert len(complete) == plan.n_trials
+    assert sum(1 for t in trials if t.state == TrialState.RUNNING) == 0
+    tokens = _op_tokens(trials)
+    assert len(tokens) == len(set(tokens))
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("checkpoint.rejected", 0) >= len(plan.corrupt_slots)
+    assert counters.get("checkpoint.fallback", 0) == 1
+    assert counters.get("checkpoint.restore", 0) == 0
+
+    report = resumed.health_report()
+    findings = {f["check"]: f for f in report["findings"]}
+    assert "checkpoint.stale" in findings
+    assert findings["checkpoint.stale"]["severity"] == "WARNING"
+    assert findings["checkpoint.stale"]["evidence"]["fallbacks"] == 1
+
+
+class _HookedSampler(TPESampler):
+    """A TPESampler with the fitted-state hooks, so the hub checkpoint has
+    something observable to export and the successor's warm-load is
+    assertable (the real GPSampler exports its kernel-param cache the same
+    duck-typed way)."""
+
+    def __init__(self, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.fitted: dict = {}
+        self.restored_from: dict | None = None
+
+    def export_fitted_state(self):
+        return dict(self.fitted) if self.fitted else None
+
+    def restore_fitted_state(self, state) -> bool:
+        if not state:
+            return False
+        self.restored_from = dict(state)
+        for key, value in state.items():
+            self.fitted.setdefault(key, value)
+        return True
+
+
+def test_hub_kill_then_rehome_warm_loads_fitted_state():
+    """Kill a fleet hub after its tell observer checkpointed the fitted
+    sampler state; the ring successor's re-home warm-loads that state (the
+    deferred warm-start gap ARCHITECTURE.md used to carry) — counted
+    ``checkpoint.warm_load`` and visible on the successor's sampler."""
+    checkpoint_every = 3
+    n_tells = 7
+    storage = InMemoryStorage()
+    names = ["hub-0", "hub-1"]
+    fleet = FakeHubFleet(
+        storage,
+        names,
+        lambda name: SuggestService(
+            storage,
+            lambda: _HookedSampler(multivariate=True, n_startup_trials=2, seed=7),
+            ready_ahead=0,
+            coalesce_window_s=0.0,
+            checkpoint_every=checkpoint_every,
+        ),
+    )
+    try:
+        optuna_tpu.create_study(
+            storage=fleet.mounted[names[0]], study_name="warm", direction="minimize"
+        )
+        sid = storage.get_study_id_from_name("warm")
+        victim = fleet.router.hub_for(sid)
+        survivor = next(n for n in names if n != victim)
+
+        def run_trials(mount_name, count, seed, *, seed_fitted=False):
+            study = optuna_tpu.load_study(
+                study_name="warm",
+                storage=fleet.mounted[mount_name],
+                sampler=fleet.thin_client(seed=seed),
+            )
+            for i in range(count):
+                if seed_fitted:
+                    handle = fleet.hubs[victim].service._handles[sid]
+                    handle.guarded._sampler.fitted["k"] = i
+                trial = study.ask()
+                study.tell(trial, (trial.suggest_float("x", 0.0, 1.0) - 0.5) ** 2)
+
+        # One ask creates the victim's handle; then seed the fitted state
+        # tell by tell so each ckpt:hub write snapshots a distinct value.
+        run_trials(victim, 1, seed=100)
+        run_trials(victim, n_tells - 1, seed=101, seed_fitted=True)
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("checkpoint.write", 0) == n_tells // checkpoint_every
+
+        fleet.kill(victim)
+        run_trials(survivor, 1, seed=102)
+
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("serve.fleet.hub_rehome", 0) >= 1
+        assert counters.get("checkpoint.restore", 0) == 1
+        assert counters.get("checkpoint.warm_load", 0) == 1
+        heir = fleet.hubs[survivor].service._handles[sid].guarded._sampler
+        # The warm state is the victim's fitted dict at its LAST checkpoint
+        # (tells_total == 6 landed mid-loop at i == 4), not its live state.
+        assert heir.restored_from == {"k": 4}
+        assert heir.fitted["k"] == 4
+    finally:
+        fleet.close()

@@ -762,6 +762,20 @@ def _trajectory_file(tmp_path):
                     "sparse_heldout_err": 0.41,
                 },
             },
+            {
+                "round": "local-6", "captured": "2026-08-07T00:00:00",
+                "metric": "gp_scan_trials_per_sec_hartmann20d_preempt_resume",
+                "mode": "quick", "platform": "cpu", "value": 3.534,
+                "provenance": "preempt-no-baseline", "preempt_at": 2,
+                "ckpt": {
+                    "restores": 1, "fallbacks": 0, "resume_overhead_s": 0.04,
+                    # A field a future bench emits that this CLI predates:
+                    # rendering must .get around it, not crash.
+                    "blobs_garbled": 0,
+                },
+                # An entire block a future bench emits: ditto.
+                "hypothetical_future_block": {"anything": [1, 2, 3]},
+            },
         ],
     }
     path = tmp_path / "BENCH_TRAJECTORY.json"
@@ -790,7 +804,7 @@ def test_trajectory_cli_table_and_json(tmp_path, capsys):
     assert cli_main(["trajectory", "--path", path, "-f", "json"]) == 0
     payload = json.loads(capsys.readouterr().out)
     assert [e["round"] for e in payload["entries"]] == [
-        "r03", "r04", "r05", "local-4", "local-5",
+        "r03", "r04", "r05", "local-4", "local-5", "local-6",
     ]
     assert payload["entries"][1]["device_stats"]["fit_iterations"] == 120
     assert payload["entries"][3]["serve"]["serve_ask_p99_ms"] == 2.16
@@ -801,6 +815,29 @@ def test_trajectory_cli_table_and_json(tmp_path, capsys):
     ) == 0
     payload = json.loads(capsys.readouterr().out)
     assert [e["round"] for e in payload["entries"]] == ["r03", "r04"]
+
+
+def test_trajectory_cli_renders_ckpt_column_and_survives_unknown_blocks(
+    tmp_path, capsys
+):
+    """Preempt-resume bench entries (bench --loop=scan --preempt-at=K,
+    ISSUE 19) condense the checkpoint story — restores, resume overhead,
+    the kill chunk, fallbacks — and every unknown key or block a future
+    bench emits renders forward-compatibly instead of crashing."""
+    path = _trajectory_file(tmp_path)
+    assert cli_main(["trajectory", "--path", path]) == 0
+    table = capsys.readouterr().out
+    assert "ckpt=1/0.04s" in table
+    assert "pre@2" in table
+    assert "fb=" not in table  # zero fallbacks stay silent
+    assert "local-6" in table
+
+    # fallbacks surface only when nonzero; unknown ckpt keys still ignored.
+    payload = json.loads((tmp_path / "BENCH_TRAJECTORY.json").read_text())
+    payload["entries"][-1]["ckpt"]["fallbacks"] = 3
+    (tmp_path / "BENCH_TRAJECTORY.json").write_text(json.dumps(payload))
+    assert cli_main(["trajectory", "--path", path]) == 0
+    assert "fb=3" in capsys.readouterr().out
 
 
 def test_trajectory_cli_env_and_missing_path(tmp_path, capsys, monkeypatch):

@@ -349,6 +349,80 @@ def test_flt001_fixture_in_sync_is_silent():
     assert not result.findings, [f.format() for f in result.findings]
 
 
+def test_ckpt001_registry_matches_runtime_sets():
+    """The canonical checkpoint-event registry equals the *runtime* values
+    of both hand-written copies (the lint compares them statically) — and
+    every event has a checkpoint.<event> counter home in the telemetry
+    vocabulary (the suffixed family)."""
+    from optuna_tpu import checkpoint, telemetry
+    from optuna_tpu.testing.fault_injection import CHECKPOINT_CHAOS_MATRIX
+
+    canonical = set(lint_registry.CHECKPOINT_EVENT_REGISTRY)
+    assert set(checkpoint.CHECKPOINT_EVENTS) == canonical
+    assert set(CHECKPOINT_CHAOS_MATRIX) == canonical
+    assert "checkpoint" in telemetry.COUNTERS
+
+
+def test_ckpt001_gate_rejects_drift():
+    """Point CKPT001 at the real files with a registry containing an event
+    the code does not know: both copies must be reported as drifted —
+    adding a checkpoint lifecycle event without a preemption scenario that
+    forces it is a lint failure (the STO001/.../FLT001 discipline): a
+    restore path nobody has SIGKILLed a loop through loses its first real
+    study to the fleet's default failure mode."""
+    fat_registry = dict(lint_registry.CHECKPOINT_EVENT_REGISTRY)
+    fat_registry["phantom_thaw"] = "made-up event to prove the gate is live"
+    config = Config(ckpt001_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.ckpt001_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "CKPT001"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("phantom_thaw" in f.message for f in drifted)
+
+
+_CKPT001_FIXTURE_REGISTRY = {
+    "preempt_resume": "a loop came back from the dead",
+    "torn_blob": "a blob died mid-write",
+}
+
+
+def _ckpt001_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        ckpt001_registry=_CKPT001_FIXTURE_REGISTRY,
+        ckpt001_targets=(
+            (
+                f"fixtures/lint/{tree}/checkpoint_mod.py",
+                "CHECKPOINT_EVENTS",
+                "event vocabulary",
+            ),
+            (
+                f"fixtures/lint/{tree}/chaos_mod.py",
+                "CHECKPOINT_CHAOS_MATRIX",
+                "chaos",
+            ),
+        ),
+    )
+
+
+def test_ckpt001_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "ckpt001_pos")
+    result = run_lint([tree], _ckpt001_config("ckpt001_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "ghost_event" in by_file["checkpoint_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_ckpt001_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "ckpt001_neg")
+    result = run_lint([tree], _ckpt001_config("ckpt001_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 def test_obs002_registry_matches_runtime_sets():
     """The canonical flight event-kind registry equals the *runtime* values
     of both hand-written copies (the lint compares them statically)."""

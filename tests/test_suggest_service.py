@@ -324,7 +324,15 @@ def test_ready_queue_invalidates_after_enough_tells():
         # background worker may already be computing the replacement batch;
         # the bump itself and its counter are the invalidation contract).
         study, _ = _client_study(mounted, rpc, seed=3, max_shed_retries=0)
-        _run_trials(study, 2)
+        # An in-flight background refill completing mid-pair resets
+        # tells_since_fill and can split one pair across a fill boundary, so
+        # tell in pairs until the bump lands — the contract is "a full
+        # invalidate_after window of tells since a fill bumps the epoch",
+        # and a bounded number of windows must contain an unsplit one.
+        for _ in range(4):
+            _run_trials(study, 2)
+            if handle.queue.epoch > epoch_before:
+                break
         assert handle.queue.epoch > epoch_before
         assert telemetry.snapshot()["counters"].get(
             "serve.ready_queue.invalidate", 0

@@ -280,6 +280,68 @@ def test_journal_lock_contention_counter(tmp_path):
     assert telemetry.snapshot()["counters"]["journal.lock_contention"] == 1
 
 
+def test_journal_snapshot_rejected_counter(tmp_path):
+    """A torn/garbled snapshot file is rejected (CRC) and counted once per
+    load; the backend degrades to full log replay (returns None), never
+    raises, and a valid snapshot adds nothing."""
+    import zlib
+
+    from optuna_tpu.storages.journal._file import (
+        JournalFileBackend,
+        frame_snapshot,
+    )
+
+    backend = JournalFileBackend(str(tmp_path / "journal.log"))
+    assert backend.load_snapshot() is None  # no file: nothing to reject
+    assert telemetry.snapshot()["counters"].get("journal.snapshot_rejected", 0) == 0
+
+    framed = bytearray(frame_snapshot(b"snapshot payload"))
+    framed[-1] ^= 0xFF  # flip a payload byte so the CRC no longer matches
+    with open(str(tmp_path / "journal.log") + ".snapshot", "wb") as f:
+        f.write(bytes(framed))
+    assert backend.load_snapshot() is None
+    counters = telemetry.snapshot()["counters"]
+    assert counters["journal.snapshot_rejected"] == 1
+
+    backend.save_snapshot(zlib.compress(b""))  # any bytes; framing is valid
+    assert backend.load_snapshot() is not None
+    assert telemetry.snapshot()["counters"]["journal.snapshot_rejected"] == 1
+
+
+def test_checkpoint_counter_family_per_event():
+    """Each checkpoint.<event> name fires exactly on its lifecycle event
+    (write/restore on the happy path, write_error on a dead storage,
+    rejected on a garbled blob, stale on a trailing watermark); the
+    SIGKILL-and-resume scenarios for restore/fallback/warm_load live in
+    tests/test_checkpoint_chaos.py."""
+    from optuna_tpu import checkpoint as ckpt
+
+    storage = InMemoryStorage()
+    sid = storage.create_new_study([optuna_tpu.study.StudyDirection.MINIMIZE])
+    assert ckpt.write_checkpoint(storage, sid, "scan", {"s": 1}, n_told=8, seq=0)
+    assert ckpt.load_checkpoint(storage, sid, "scan") is not None
+    storage.set_study_system_attr(sid, "ckpt:scan:1", "!garbled!")
+    assert ckpt.load_checkpoint(storage, sid, "scan") is not None  # slot 0 wins
+    assert (
+        ckpt.load_checkpoint(storage, sid, "scan", synced_told=99, max_lag=4) is None
+    )
+
+    class _DeadStorage:
+        def set_study_system_attr(self, *a, **k):
+            raise RuntimeError("preempted mid-write")
+
+    assert not ckpt.write_checkpoint(_DeadStorage(), sid, "scan", {}, n_told=0, seq=1)
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters["checkpoint.write"] == 1
+    assert counters["checkpoint.restore"] == 2
+    assert counters["checkpoint.rejected"] == 2  # garbled slot seen by both loads
+    assert counters["checkpoint.stale"] == 1
+    assert counters["checkpoint.write_error"] == 1
+    assert counters.get("checkpoint.fallback", 0) == 0
+    assert counters.get("checkpoint.warm_load", 0) == 0
+
+
 def test_sampler_fallback_counter_families_are_phase_bucketed():
     """Per-param independent-path failures collapse into one family bucket
     (bounded cardinality), while distinct hooks stay distinguishable."""
