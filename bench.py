@@ -520,12 +520,22 @@ _SERVE_TPE_KWARGS = dict(multivariate=True, n_startup_trials=10)
 
 
 def run_ours_tpe_serve(
-    n_clients: int, asks_per_client: int, warm_trials: int = 40
+    n_clients: int,
+    asks_per_client: int,
+    warm_trials: int = 40,
+    transport: str = "handler",
 ) -> tuple[float, dict]:
     """``--loop=serve``: N simulated thin clients in a closed ask/eval/tell
     loop against ONE in-process suggestion service (ISSUE 13) — the server
     code path end to end (wire codec + op tokens + handler), mounted
     handler-direct so the measurement is the service, not loopback TCP.
+
+    ``transport="socket"`` (ISSUE 20) runs the SAME closed loop over a real
+    loopback gRPC server instead: every ask and every storage op crosses an
+    insecure channel, so the number includes serialization, HTTP/2 framing,
+    and kernel TCP — the real-channel-latency twin the handler-direct
+    capture deliberately excludes. It gates only against its own kind (the
+    trajectory entry carries ``transport``).
 
     Returns (asks/s over the timed window, detail dict with per-ask
     p50/p99 ms, coalesce width stats, and the best value seen)."""
@@ -573,19 +583,53 @@ def run_ours_tpe_serve(
         ),
         health_reporting=False,
     )
-    mounted = service.wrap_storage(storage)
-    handler = _make_handler(mounted, service)
-    method_handler = handler.service(
-        _types.SimpleNamespace(method=f"/{_wire.SERVICE_NAME}/x")
-    )
+    grpc_server = grpc_channel = None
+    if transport == "socket":
+        # Real loopback gRPC: make_grpc_server mounts the tell observer over
+        # the raw storage itself (passing a pre-wrapped mount would observe
+        # every tell twice), clients mount a GrpcStorageProxy so study
+        # create/load/tell traffic rides the wire too, and the ask closure
+        # mirrors GrpcStorageProxy._call's RPC-path shape so the server
+        # routes it like any thin client's.
+        import grpc as _grpc
 
-    def rpc(method, *args, **kwargs):
-        ok, payload = _wire.decode_response(
-            method_handler.unary_unary(_wire.encode_request(method, args, kwargs), None)
+        from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+        from optuna_tpu.storages._grpc.server import make_grpc_server
+        from optuna_tpu.testing.storages import _find_free_port
+
+        port = _find_free_port()
+        grpc_server = make_grpc_server(
+            storage, "localhost", port, thread_pool_size=n_clients + 2,
+            suggest_service=service,
         )
-        if not ok:
-            raise payload
-        return payload
+        grpc_server.start()
+        grpc_channel = _grpc.insecure_channel(f"localhost:{port}")
+        mounted = GrpcStorageProxy(host="localhost", port=port)
+
+        def rpc(method, *args, **kwargs):
+            raw = grpc_channel.unary_unary(f"/{_wire.SERVICE_NAME}/{method}")(
+                _wire.encode_request(method, args, kwargs), timeout=120.0
+            )
+            ok, payload = _wire.decode_response(raw)
+            if not ok:
+                raise payload
+            return payload
+    else:
+        mounted = service.wrap_storage(storage)
+        handler = _make_handler(mounted, service)
+        method_handler = handler.service(
+            _types.SimpleNamespace(method=f"/{_wire.SERVICE_NAME}/x")
+        )
+
+        def rpc(method, *args, **kwargs):
+            ok, payload = _wire.decode_response(
+                method_handler.unary_unary(
+                    _wire.encode_request(method, args, kwargs), None
+                )
+            )
+            if not ok:
+                raise payload
+            return payload
 
     def make_study(seed, name="serve-bench"):
         def ask(study_id, trial_id, number, token):
@@ -728,8 +772,13 @@ def run_ours_tpe_serve(
         (s for s in slo_report["slos"] if s["id"] == "serve.ask.latency"), None
     )
     service.close()
+    if grpc_server is not None:
+        mounted.remove_session()
+        grpc_channel.close()
+        grpc_server.stop(0)
     n_asks = n_clients * asks_per_client
     detail = {
+        "transport": transport,
         "n_clients": n_clients,
         "asks_per_client": asks_per_client,
         "serve_ask_p50_ms": round(1e3 * _pct(steady_lat, 0.50), 3),
@@ -759,7 +808,11 @@ def run_ours_tpe_serve(
 
 
 def run_ours_tpe_serve_fleet(
-    n_hubs: int, n_clients: int, asks_per_client: int, warm_trials: int = 40
+    n_hubs: int,
+    n_clients: int,
+    asks_per_client: int,
+    warm_trials: int = 40,
+    transport: str = "handler",
 ) -> tuple[float, dict]:
     """``--loop=serve --hubs=N``: the hub fleet (ISSUE 16) — N suggestion
     services over ONE shared journal storage behind real gRPC handlers
@@ -770,6 +823,11 @@ def run_ours_tpe_serve_fleet(
     client, so every ask walks the ring exactly as a production client
     would — routing, op tokens and replication records included.
 
+    ``transport="socket"`` (ISSUE 20) swaps the handler-direct harness for
+    :class:`~optuna_tpu.testing.fault_injection.SocketHubFleet`: each hub
+    behind its own loopback gRPC server, every client/peer RPC and every
+    storage op over a real channel. Gates only against its own kind.
+
     Returns (fleet-wide asks/s over the saturation window, detail dict)."""
     import threading as _th
 
@@ -777,7 +835,7 @@ def run_ours_tpe_serve_fleet(
     from optuna_tpu.samplers import TPESampler
     from optuna_tpu.storages import InMemoryStorage
     from optuna_tpu.storages._grpc.suggest_service import ShedPolicy, SuggestService
-    from optuna_tpu.testing.fault_injection import FakeHubFleet
+    from optuna_tpu.testing.fault_injection import FakeHubFleet, SocketHubFleet
 
     _silence()
     storage = InMemoryStorage()
@@ -807,7 +865,8 @@ def run_ours_tpe_serve_fleet(
     names = [f"bench-hub-{i}" for i in range(n_hubs)]
     # A production liveness TTL: the default 0.0 recomputes the snapshot
     # scan per ask, which measures the test harness, not the fleet.
-    fleet = FakeHubFleet(storage, names, factory, liveness_ttl_s=0.25)
+    fleet_cls = SocketHubFleet if transport == "socket" else FakeHubFleet
+    fleet = fleet_cls(storage, names, factory, liveness_ttl_s=0.25)
     mounted = fleet.mounted[names[0]]
 
     # One timed study owned per hub: probe names until the ring has given
@@ -926,6 +985,7 @@ def run_ours_tpe_serve_fleet(
     fleet.close()
     n_asks = n_clients * asks_per_client
     detail = {
+        "transport": transport,
         "hubs": n_hubs,
         "n_clients": n_clients,
         "asks_per_client": asks_per_client,
@@ -942,10 +1002,16 @@ def run_ours_tpe_serve_fleet(
             sum(v for k, v in counters.items() if k.startswith("serve.shed."))
         ),
         # Fleet health over the window: a fault-free bench must show zero
-        # forwards/replays/re-homes (clients route straight to owners).
+        # forwards/replays/re-homes (clients route straight to owners), and
+        # — post ISSUE 20 — zero lease takeovers and zero fenced writes
+        # (every hub held its studies' leases for the whole window; the
+        # fence never fired). Nonzero here means the bench measured a
+        # partition, not the fleet.
         "fleet_forwards": int(counters.get("serve.fleet.ask_forward", 0)),
         "fleet_replays": int(counters.get("serve.fleet.ask_replayed", 0)),
         "fleet_rehomes": int(counters.get("serve.fleet.hub_rehome", 0)),
+        "lease_takeovers": int(counters.get("fleet.lease.takeover", 0)),
+        "fenced_writes": int(counters.get("fleet.fenced_write", 0)),
         "best": round(min(best), 6),
     }
     return n_asks / sat_wall, detail
@@ -1730,6 +1796,17 @@ def main() -> None:
         "baseline is untouched",
     )
     parser.add_argument(
+        "--transport",
+        default="handler",
+        choices=["handler", "socket"],
+        help="serve-loop only: how clients reach the suggestion service — "
+        "'handler' calls the wire-level method handlers in-process (no "
+        "sockets; the committed default), 'socket' runs the same closed "
+        "loop over a real loopback gRPC channel so the number includes "
+        "serialization + channel latency (ISSUE 20); the trajectory entry "
+        "carries a transport field and only gates against its own kind",
+    )
+    parser.add_argument(
         "--trials",
         type=int,
         default=None,
@@ -1755,6 +1832,8 @@ def main() -> None:
         parser.error("--hubs is only defined for --loop=serve")
     if args.hubs < 1:
         parser.error("--hubs must be >= 1")
+    if args.transport != "handler" and args.loop != "serve":
+        parser.error("--transport is only defined for --loop=serve")
     if args.trials is not None and args.loop != "scan":
         parser.error("--trials is only defined for --loop=scan")
     if args.trials is not None and args.trials < 64:
@@ -1791,14 +1870,18 @@ def main() -> None:
             f"running ours (suggestion service / TPE, {n_clients} clients x "
             f"{asks_per_client} asks, closed loop"
             + (f", fleet of {args.hubs} hubs" if args.hubs > 1 else "")
+            + (", real loopback gRPC" if args.transport == "socket" else "")
             + ")..."
         )
         if args.hubs > 1:
             ours_rate, serve_detail = run_ours_tpe_serve_fleet(
-                args.hubs, n_clients, asks_per_client
+                args.hubs, n_clients, asks_per_client, transport=args.transport
             )
         else:
-            ours_rate, serve_detail = run_ours_tpe_serve(n_clients, asks_per_client)
+            ours_rate, serve_detail = run_ours_tpe_serve(
+                n_clients, asks_per_client, transport=args.transport
+            )
+        extra["transport"] = args.transport
         n_timed = n_clients * asks_per_client
         ours_best = serve_detail.pop("best")
         # Capture the serve window's breakdown NOW: the single-client twin
@@ -2141,6 +2224,7 @@ def _record_trajectory(out: dict, mode: str) -> None:
             mode=mode,
             platform=out.get("platform", "unknown"),
             value=out["value"],
+            transport=out.get("transport"),
         )
         # A failing value is recorded for the ledger but flagged so it can
         # never become the next run's baseline (no rerun-until-green).

@@ -10,9 +10,13 @@ completed ``bench.py`` run now appends its result here, and the
 ``slow``-marked gate test (``tests/test_perf_gate.py``) fails on a >10%
 ours-side drop against the last comparable entry.
 
-Comparability key: (metric, mode, platform). Quick-mode and full-mode runs
-measure different trial depths, and a CPU-fallback number must never gate
-(or be gated by) an accelerator number. Partial (watchdog-emitted) and
+Comparability key: (metric, mode, platform, transport). Quick-mode and
+full-mode runs measure different trial depths, a CPU-fallback number must
+never gate (or be gated by) an accelerator number, and a serve number
+captured over a real loopback gRPC channel (``--transport=socket``, which
+pays serialization + channel latency) must never gate the handler-direct
+figure. Entries without a ``transport`` field are handler-direct (every
+capture predating the field was). Partial (watchdog-emitted) and
 null-value entries are recorded for the historical ledger but excluded
 from gating.
 
@@ -51,16 +55,23 @@ def load_trajectory(path: str | None = None) -> dict:
 
 
 def comparable_entries(
-    trajectory: dict, metric: str, mode: str, platform: str
+    trajectory: dict,
+    metric: str,
+    mode: str,
+    platform: str,
+    transport: str | None = None,
 ) -> list[dict]:
-    """Entries this (metric, mode, platform) gates against: same key, a real
-    (non-null, non-partial) value."""
+    """Entries this (metric, mode, platform, transport) gates against: same
+    key, a real (non-null, non-partial) value. ``transport=None`` and a
+    missing ``transport`` field both mean handler-direct."""
+    want = transport or "handler"
     return [
         e
         for e in trajectory.get("entries", ())
         if e.get("metric") == metric
         and e.get("mode") == mode
         and e.get("platform") == platform
+        and (e.get("transport") or "handler") == want
         and e.get("value") is not None
         and not e.get("partial")
         and not e.get("regressed")
@@ -74,6 +85,7 @@ def check_regression(
     platform: str,
     value: float,
     threshold: float | None = None,
+    transport: str | None = None,
 ) -> str | None:
     """None when the gate passes (or has no comparable baseline yet); a
     human-readable failure message on a >threshold ours-side regression."""
@@ -81,7 +93,7 @@ def check_regression(
         threshold = float(
             trajectory.get("gate", {}).get("max_regression_frac", MAX_REGRESSION_FRAC)
         )
-    history = comparable_entries(trajectory, metric, mode, platform)
+    history = comparable_entries(trajectory, metric, mode, platform, transport)
     if not history:
         return None
     last = history[-1]
@@ -89,7 +101,9 @@ def check_regression(
     if value < floor:
         drop = 1.0 - value / last["value"]
         return (
-            f"perf gate: {metric} [{mode}/{platform}] regressed "
+            f"perf gate: {metric} [{mode}/{platform}"
+            + (f"/{transport}" if transport and transport != "handler" else "")
+            + "] regressed "
             f"{drop:.1%} ({last['value']} -> {value} trials/s; entry "
             f"{last.get('round', '?')}, floor {floor:.3f} at "
             f"{threshold:.0%} tolerance)"
@@ -175,6 +189,10 @@ def append_entry(
         # figures, queue hit/miss counts, and the single-client local-
         # sampler ask latency the p99 is contracted against.
         entry["serve"] = result["serve"]
+    if result.get("transport") and result.get("transport") != "handler":
+        # The comparability key's fourth axis (ISSUE 20): a serve capture
+        # over a real loopback gRPC channel gates only against its own kind.
+        entry["transport"] = result["transport"]
     if result.get("unit") and result.get("unit") != "trials/s":
         entry["unit"] = result["unit"]
     if result.get("steady_state_trials_per_sec") is not None:

@@ -26,6 +26,7 @@ CONC004  lock sanitizer vocabularies drifted from the canonical one
 SRV001   suggestion-service shed policy sets drifted from the canonical one
 ACT001   autopilot action vocabularies drifted from the canonical one
 FLT001   hub-fleet event vocabularies drifted from the canonical one
+FLT002   lease/fence event vocabularies drifted from the canonical one
 CKPT001  checkpoint event vocabularies drifted from the canonical one
 EXE001   non-finite quarantine policy sets drifted from the canonical one
 SMP001   sampler fallback policy sets drifted from the canonical one
@@ -81,6 +82,7 @@ def all_rules() -> list[Rule]:
         CKPT001CheckpointEventSync,
         EXE001NonFinitePolicySync,
         FLT001FleetEventSync,
+        FLT002LeaseEventSync,
         SRV001ShedPolicySync,
         STO001ReplayRegistrySync,
         STO002LockOrder,
@@ -105,6 +107,7 @@ def all_rules() -> list[Rule]:
         SRV001ShedPolicySync(),
         ACT001ActionRegistrySync(),
         FLT001FleetEventSync(),
+        FLT002LeaseEventSync(),
         CKPT001CheckpointEventSync(),
         EXE001NonFinitePolicySync(),
         SMP001FallbackPolicySync(),

@@ -113,6 +113,10 @@ class Config:
     flt001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.FLEET_EVENT_REGISTRY
     )
+    flt002_targets: tuple[tuple[str, str, str], ...] = registry.FLT002_TARGETS
+    flt002_registry: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: registry.LEASE_EVENT_REGISTRY
+    )
     ckpt001_targets: tuple[tuple[str, str, str], ...] = registry.CKPT001_TARGETS
     ckpt001_registry: Mapping[str, str] = dataclasses.field(
         default_factory=lambda: registry.CHECKPOINT_EVENT_REGISTRY

@@ -161,6 +161,9 @@ TELEMETRY_COUNTER_REGISTRY: dict[str, str] = {
     "serve.ready_queue": "(suffixed hit|miss|refill|invalidate) a speculative ready-queue event on the suggestion service",
     "autopilot.action": "(suffixed by action id, or 'rollback'/'held') the autopilot decided a guarded remediation (observe logs it, act executes it)",
     "serve.fleet": "(suffixed by fleet event) a hub-fleet routing decision: forward, replay, re-home, or a declared hub death",
+    "fleet.lease": "(suffixed by lease event) a study-ownership lease transition: acquire, renew, takeover, or a fence-tripped hub's self-demotion",
+    "fleet.fenced_write": "a stale-epoch serve-state write from a zombie hub was rejected by the lease fence (StaleLeaseError)",
+    "grpc.op_token_evicted_live": "an op-token dedupe entry younger than the client retry window was evicted (server LRU or fleet replay ring): a delayed duplicate would re-execute",
     "locksan.verdict": "(suffixed by kind) the lock sanitizer reported a potential deadlock cycle or a blocking window under held locks",
     "checkpoint": "(suffixed by checkpoint event) a durable-checkpoint lifecycle event: write, rejection, restore, fallback, or warm load",
     "journal.snapshot_rejected": "a journal snapshot failed its CRC/unpickle validation and was replaced by a full log replay",
@@ -269,6 +272,9 @@ HEALTH_CHECK_REGISTRY: dict[str, str] = {
     "service.ready_queue_starved": "steady-state asks keep missing the speculative ready queue",
     "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
     "service.hub_dead": "a suggestion hub's -serve snapshot went stale: the fleet re-homes its studies to ring successors",
+    "service.hub_flapping": "a study's lease bounced between hubs repeatedly inside a window (asymmetric partition / liveness disagreement)",
+    "service.hub_zombie_fenced": "a declared-dead hub is still writing: the lease fence is rejecting its stale-epoch serve-state writes",
+    "service.partition_suspected": "a study's lease was taken over while the deposed hub still publishes live snapshots: partition, not crash",
     "checkpoint.stale": "resume is rejecting checkpoint blobs (torn, corrupt, or watermark-stale): restores are paying full recomputes",
 }
 
@@ -418,6 +424,41 @@ FLT001_TARGETS: tuple[tuple[str, str, str], ...] = (
     ),
 )
 
+#: The lease/fence event vocabulary: every study-ownership transition the
+#: lease layer (``storages/_grpc/fleet.py::StudyLeases`` + the
+#: ``LeaseFencedStorage`` write fence) can take — and every
+#: ``fleet.lease.*`` counter plus the standalone ``fleet.fenced_write``
+#: derived from one — carries one of these ids. Canonical mirror of
+#: ``fleet.LEASE_EVENTS`` (rule **FLT002**, the STO001 machinery pointed at
+#: split-brain protection itself). Values say what each transition means
+#: for the study's write fence; every id must have a gray-failure scenario
+#: in ``testing/fault_injection.py::LEASE_CHAOS_MATRIX`` (same rule) — a
+#: fence nobody has run a zombie hub into is a fence that admits its first
+#: double-applied write in production.
+LEASE_EVENT_REGISTRY: dict[str, str] = {
+    "acquire": "a hub claimed an unleased study: epoch 1, the fence baseline every later takeover bumps past",
+    "renew": "the lease owner re-asserted its claim at the adaptive renewal cadence (read-check-then-write, injectable clock)",
+    "takeover": "a successor (re-home) or the returning ring primary (failback) bumped the epoch and displaced the recorded owner",
+    "demote": "a hub observed its claim was stale (fence trip or renewal check) and stopped writing serve state for the study",
+    "fenced_write": "a stale-epoch serve-state write was rejected by the lease fence with a typed StaleLeaseError",
+}
+
+#: The hand-maintained copies FLT002 cross-checks, as
+#: ``(path suffix, module-level symbol, why this site keeps its own copy)``.
+#: Each symbol must statically evaluate to exactly the registry's key set.
+FLT002_TARGETS: tuple[tuple[str, str, str], ...] = (
+    (
+        "optuna_tpu/storages/_grpc/fleet.py",
+        "LEASE_EVENTS",
+        "the lease layer's accepted ownership transitions (counted as fleet.lease.<event> / fleet.fenced_write)",
+    ),
+    (
+        "optuna_tpu/testing/fault_injection.py",
+        "LEASE_CHAOS_MATRIX",
+        "chaos matrix: every lease event must have a gray-failure scenario that forces it",
+    ),
+)
+
 #: The durable-checkpoint event vocabulary: every lifecycle event the
 #: preemption-safe checkpoint layer (``optuna_tpu/checkpoint.py``) can take
 #: on a blob — and every ``checkpoint.*`` counter and doctor evidence field
@@ -475,6 +516,7 @@ LOCKSAN_REGISTRY: dict[str, str] = {
     "server.op_token": "the gRPC server's op-token replay cache + in-flight coalescing map",
     "fleet.liveness": "a fleet hub's liveness-TTL cache of dead hub ids",
     "fleet.adopt": "a fleet hub's adopted-studies set (re-home decisions)",
+    "fleet.lease": "a hub's study-lease tables: held epochs, renewal deadlines, fence cache",
     "fleet.peer": "a remote peer stub's in-flight forward bookkeeping",
     "telemetry.registry": "the metrics registry's counter/gauge/histogram maps",
     "flight.jit_totals": "the flight recorder's per-label jit compile totals",

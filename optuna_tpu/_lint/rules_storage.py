@@ -231,6 +231,27 @@ class FLT001FleetEventSync(_RegistrySyncRule):
         return config.flt001_targets
 
 
+class FLT002LeaseEventSync(_RegistrySyncRule):
+    """The STO001/.../FLT001 anti-drift machinery pointed at the lease
+    layer's ownership-transition vocabulary:
+    ``storages/_grpc/fleet.py::LEASE_EVENTS`` and the chaos matrix
+    ``fault_injection.py::LEASE_CHAOS_MATRIX`` must both equal the
+    canonical ``registry.LEASE_EVENT_REGISTRY`` — a lease/fence transition
+    added without a gray-failure scenario that forces it is a lint failure:
+    an unexercised fence admits its first double-applied zombie write in
+    production, during exactly the partition it was built for."""
+
+    id = "FLT002"
+    title = "lease/fence event vocabularies out of sync"
+    noun = "lease events"
+
+    def _canonical(self, config) -> dict:
+        return dict(config.flt002_registry)
+
+    def _targets(self, config) -> Sequence[tuple[str, str, str]]:
+        return config.flt002_targets
+
+
 class CKPT001CheckpointEventSync(_RegistrySyncRule):
     """The STO001/.../FLT001 anti-drift machinery pointed at the durable
     checkpoint layer's event vocabulary: ``checkpoint.CHECKPOINT_EVENTS``

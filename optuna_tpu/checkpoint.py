@@ -96,20 +96,33 @@ class CheckpointRecord:
     seq: int
     n_told: int
     state: dict[str, Any]
+    #: Lease fencing epoch the writer held when it captured the state
+    #: (ISSUE 20; 0 = written outside any lease — solo hubs, loop kinds).
+    #: Carried for provenance/diagnosis: *rejection* of stale-epoch writes
+    #: happens at write time in the hub's fenced storage layer, so a frame
+    #: that landed was valid when written.
+    fence: int = 0
 
 
 def _slot_key(kind: str, slot: int) -> str:
     return f"{CKPT_ATTR_PREFIX}{kind}:{slot}"
 
 
-def encode_checkpoint(kind: str, state: Mapping[str, Any], *, n_told: int, seq: int) -> str:
-    """Pickle + CRC-frame + base64 a checkpoint record into an attr value."""
+def encode_checkpoint(
+    kind: str, state: Mapping[str, Any], *, n_told: int, seq: int, fence: int = 0
+) -> str:
+    """Pickle + CRC-frame + base64 a checkpoint record into an attr value.
+
+    ``fence`` stamps the writer's lease fencing epoch into the frame (an
+    additive dict key: version-1 blobs without it decode as fence 0, so no
+    schema bump)."""
     payload = pickle.dumps(
         {
             "version": CHECKPOINT_SCHEMA_VERSION,
             "kind": kind,
             "seq": int(seq),
             "n_told": int(n_told),
+            "fence": int(fence),
             "state": dict(state),
         },
         protocol=pickle.HIGHEST_PROTOCOL,
@@ -125,6 +138,7 @@ def write_checkpoint(
     *,
     n_told: int,
     seq: int,
+    fence: int = 0,
 ) -> bool:
     """Best-effort durable write of one checkpoint into the 2-slot ring.
 
@@ -139,9 +153,9 @@ def write_checkpoint(
     key = _slot_key(kind, int(seq) % RING_SLOTS)
     try:
         with telemetry.span("ckpt.write"):
-            blob = encode_checkpoint(kind, state, n_told=n_told, seq=seq)
+            blob = encode_checkpoint(kind, state, n_told=n_told, seq=seq, fence=fence)
             storage.set_study_system_attr(study_id, key, blob)
-    except Exception as err:  # graphlint: ignore[PY001] -- best-effort by contract: any storage/pickle failure must degrade to "no checkpoint", not kill the optimization loop
+    except Exception as err:  # graphlint: ignore[PY001] -- best-effort by contract: any storage/pickle failure must degrade to "no checkpoint", not kill the optimization loop (a StaleLeaseError from a fenced hub storage lands here too: the fence already counted and demoted, and a zombie's checkpoint is exactly a write to skip)
         _count("write_error", meta={"kind": kind, "seq": int(seq)})
         _logger.warning(
             f"Best-effort checkpoint write ({kind!r} seq {seq}) failed and was "
@@ -197,7 +211,11 @@ def _decode_slot(blob: Any, *, kind: str, key: str) -> CheckpointRecord | None:
         _count("rejected", meta={"key": key, "defect": "state_shape"})
         return None
     return CheckpointRecord(
-        kind=kind, seq=int(record.get("seq", 0)), n_told=int(record.get("n_told", 0)), state=state
+        kind=kind,
+        seq=int(record.get("seq", 0)),
+        n_told=int(record.get("n_told", 0)),
+        state=state,
+        fence=int(record.get("fence", 0)),
     )
 
 

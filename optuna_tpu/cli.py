@@ -627,6 +627,10 @@ def _cmd_trajectory(args: argparse.Namespace) -> None:
                 f"p99={serve.get('serve_ask_p99_ms')}ms"
                 f"/1cl={serve.get('single_client_ask_ms')}ms"
             )
+            if entry.get("transport") and entry["transport"] != "handler":
+                # The comparability key's fourth axis: a socket capture is a
+                # different figure and must be readable as one.
+                parts.append(f"tr={entry['transport']}")
             if serve.get("hubs") is not None:
                 parts.append(f"hubs={serve['hubs']}")
             parts.append(

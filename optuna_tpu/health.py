@@ -116,6 +116,9 @@ HEALTH_CHECKS: dict[str, str] = {
     "service.slo_burn": "an SLO is burning its error budget (severity escalates with the burn rate)",
     "service.hub_dead": "a suggestion hub's -serve snapshot went stale: the fleet re-homes its studies to ring successors",
     "checkpoint.stale": "resume is rejecting checkpoint blobs (torn, corrupt, or watermark-stale): restores are paying full recomputes",
+    "service.hub_flapping": "a study's lease bounced between hubs repeatedly inside the window: asymmetric partition or liveness disagreement, not a clean failover",
+    "service.hub_zombie_fenced": "a deposed hub is still writing serve state: the lease fence is rejecting its stale-epoch writes",
+    "service.partition_suspected": "a lease takeover displaced a hub whose -serve snapshot is still fresh: partition, not crash",
 }
 
 #: Finding severities, mildest first. CRITICAL findings are additionally
@@ -146,6 +149,9 @@ CHECK_SEVERITIES: dict[str, str] = {
     "service.slo_burn": "CRITICAL",
     "service.hub_dead": "CRITICAL",
     "checkpoint.stale": "WARNING",
+    "service.hub_flapping": "WARNING",
+    "service.hub_zombie_fenced": "WARNING",
+    "service.partition_suspected": "WARNING",
 }
 
 #: Study system-attr namespace the reporter publishes under; one attr per
@@ -209,6 +215,12 @@ SLO_BURN_MIN_VIOLATIONS = 3  # fleet-wide long-window violations before slo_burn
 # preemption, and usually systematic (torn writes, version drift, a
 # watermark bug) rather than a one-off.
 CHECKPOINT_REJECT_MIN = 1
+# Lease flapping: takeovers are normal one at a time (a failover, a
+# failback); this many inside the window means ownership is oscillating —
+# two hubs disagree about liveness, usually an asymmetric partition — and
+# every bounce pays a warm-load plus a fence-demotion round trip.
+HUB_FLAP_MIN_TAKEOVERS = 3
+HUB_FLAP_WINDOW_S = 600.0
 
 #: Gauge prefixes a worker snapshot carries (bounded: the device-stat,
 #: jit-label and mesh-coordinate vocabularies are small by construction;
@@ -747,6 +759,9 @@ def fleet_snapshot(
             for key in ("objective", "target_s", "quantile"):
                 if key in entry:
                     agg[key] = entry[key]
+    # Lazy: fleet.py imports this module for the liveness grace factor.
+    from optuna_tpu.storages._grpc.fleet import read_lease
+
     return {
         "workers": workers,
         "n_workers": len(workers),
@@ -756,6 +771,7 @@ def fleet_snapshot(
         "histograms": histograms,
         "jit": jit,
         "slo": slo,
+        "lease": read_lease(storage, study_id),
     }
 
 
@@ -1311,6 +1327,149 @@ def _check_checkpoint_stale(
     )
 
 
+def _lease_history(fleet: dict) -> list[dict]:
+    lease = fleet.get("lease") or {}
+    return [h for h in lease.get("history", ()) if isinstance(h, Mapping)]
+
+
+def _check_hub_flapping(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    """Takeovers are normal one at a time — a failover, then maybe a
+    failback. Several inside one window mean study ownership is
+    *oscillating*: two hubs keep declaring each other dead (asymmetric
+    partition, clock skew, a liveness TTL tighter than the real RTT), and
+    every bounce pays a warm-load plus a fence-demotion round trip. The
+    window anchors on the newest takeover, not wall-clock now, so an old
+    resolved flap ages out of the report identically everywhere."""
+    history = _lease_history(fleet)
+    takeovers = [h for h in history if int(h.get("epoch", 0)) > 1]
+    if not takeovers:
+        return None
+    window = kw.get("hub_flap_window_s", HUB_FLAP_WINDOW_S)
+    ref = max(float(h.get("unix", 0.0)) for h in takeovers)
+    recent = [h for h in takeovers if ref - float(h.get("unix", 0.0)) <= window]
+    if len(recent) < kw.get("hub_flap_min_takeovers", HUB_FLAP_MIN_TAKEOVERS):
+        return None
+    lease = fleet.get("lease") or {}
+    hubs = sorted({str(h.get("owner")) for h in recent})
+    return HealthFinding(
+        check="service.hub_flapping",
+        severity=CHECK_SEVERITIES["service.hub_flapping"],
+        summary=(
+            f"study ownership changed hands {len(recent)} times inside "
+            f"{window:g}s across hubs {', '.join(hubs)} (lease epoch now "
+            f"{int(lease.get('epoch', 0))})"
+        ),
+        evidence={
+            "takeovers_in_window": len(recent),
+            "window_s": window,
+            "hubs": hubs,
+            "owner": lease.get("owner"),
+            "epoch": int(lease.get("epoch", 0)),
+        },
+        remediation=(
+            "repeated takeovers mean the hubs disagree about liveness: check "
+            "for an asymmetric partition between them, raise the lease TTL / "
+            "liveness grace above the real inter-hub RTT, and verify the "
+            "hubs' clocks — each bounce costs a warm-load and a fenced "
+            "demotion, so the flap itself is burning serve latency"
+        ),
+    )
+
+
+def _check_hub_zombie_fenced(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    """``fleet.fenced_write`` only ever counts a *rejected* stale-epoch
+    write: a hub the fleet deposed is still running and still trying to
+    write serve state. The fence held (nothing reached the journal), but a
+    zombie that keeps writing is a partitioned process an operator should
+    find and stop — it is also still burning accelerator time on a study
+    it no longer owns."""
+    fenced = int(fleet["counters"].get("fleet.fenced_write", 0))
+    if fenced <= 0:
+        return None
+    lease = fleet.get("lease") or {}
+    demotions = int(fleet["counters"].get("fleet.lease.demote", 0))
+    return HealthFinding(
+        check="service.hub_zombie_fenced",
+        severity=CHECK_SEVERITIES["service.hub_zombie_fenced"],
+        summary=(
+            f"{fenced} stale-epoch serve-state write(s) were fenced "
+            f"(StaleLeaseError) — a deposed hub kept writing; current owner "
+            f"{lease.get('owner')!r} at epoch {int(lease.get('epoch', 0))}"
+        ),
+        evidence={
+            "fenced_writes": fenced,
+            "demotions": demotions,
+            "owner": lease.get("owner"),
+            "epoch": int(lease.get("epoch", 0)),
+        },
+        remediation=(
+            "the journal is safe — every counted write was rejected — but a "
+            "zombie hub is live behind a partition: find the deposed process "
+            "(the lease history names past owners), confirm it self-demoted "
+            "(fleet.lease.demote) and is redialing clients to the successor, "
+            "then heal the partition or retire the process"
+        ),
+    )
+
+
+def _check_partition_suspected(
+    fleet: dict, trials: Sequence["FrozenTrial"], directions, **kw
+) -> HealthFinding | None:
+    """The latest lease takeover displaced a hub whose ``-serve`` snapshot
+    is still *fresh*: a crashed hub goes stale (that is ``service.hub_dead``'s
+    story), so a live deposed hub means the fleet split-brained — partition,
+    not crash. A recent intentional restart-and-failback also matches (the
+    reclaimed-from successor is alive by design); the finding is a WARNING
+    pointing at the disagreement, not a page."""
+    history = _lease_history(fleet)
+    if len(history) < 2:
+        return None
+    latest, prev = history[-1], history[-2]
+    if int(latest.get("epoch", 0)) <= 1:
+        return None
+    deposed = str(prev.get("owner"))
+    if deposed == str(latest.get("owner")):
+        return None
+    snapshot = next(
+        (
+            w
+            for w in fleet["workers"]
+            if w["worker"] == deposed + HUB_WORKER_ID_SUFFIX
+        ),
+        None,
+    )
+    if snapshot is None or not snapshot["alive"]:
+        return None  # stale or absent: a crash, service.hub_dead's story
+    return HealthFinding(
+        check="service.partition_suspected",
+        severity=CHECK_SEVERITIES["service.partition_suspected"],
+        summary=(
+            f"hub {latest.get('owner')!r} took the study lease (epoch "
+            f"{int(latest.get('epoch', 0))}) from {deposed!r}, whose -serve "
+            f"snapshot is still fresh ({snapshot['age_s']:g}s old): the "
+            f"deposed hub is alive — partition suspected, not a crash"
+        ),
+        evidence={
+            "owner": latest.get("owner"),
+            "epoch": int(latest.get("epoch", 0)),
+            "deposed": deposed,
+            "deposed_age_s": snapshot["age_s"],
+        },
+        remediation=(
+            "both hubs are running but disagreed about liveness: check "
+            "connectivity between them (one-way partitions produce exactly "
+            "this), confirm the deposed hub self-demoted rather than serving "
+            "stale state (its writes would land as fleet.fenced_write), and "
+            "expect a failback takeover when the partition heals; if this was "
+            "an intentional restart, no action is needed"
+        ),
+    )
+
+
 #: The rule table: one function per check id, keyed exactly by
 #: :data:`HEALTH_CHECKS` (asserted by ``tests/test_health.py`` — a check in
 #: the vocabulary without a rule, or vice versa, is a test failure).
@@ -1330,6 +1489,9 @@ _CHECK_FUNCS: dict[str, Callable[..., HealthFinding | None]] = {
     "service.slo_burn": _check_slo_burn,
     "service.hub_dead": _check_hub_dead,
     "checkpoint.stale": _check_checkpoint_stale,
+    "service.hub_flapping": _check_hub_flapping,
+    "service.hub_zombie_fenced": _check_hub_zombie_fenced,
+    "service.partition_suspected": _check_partition_suspected,
 }
 
 _SEVERITY_ORDER = {name: i for i, name in enumerate(SEVERITIES)}

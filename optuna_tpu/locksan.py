@@ -66,6 +66,7 @@ LOCK_NAMES: frozenset[str] = frozenset(
         "server.op_token",
         "fleet.liveness",
         "fleet.adopt",
+        "fleet.lease",
         "fleet.peer",
         "telemetry.registry",
         "flight.jit_totals",

@@ -115,6 +115,12 @@ _ERROR_TYPES: dict[str, type[Exception]] = {
     "DuplicatedStudyError": _exc.DuplicatedStudyError,
     "UpdateFinishedTrialError": _exc.UpdateFinishedTrialError,
     "StorageInternalError": getattr(_exc, "StorageInternalError", RuntimeError),
+    # Typed fence rejection (ISSUE 20): a zombie hub's stale-epoch write must
+    # cross the wire as StaleLeaseError so the hub-side demotion ladder (and
+    # a client's never-retry classification) see the type, not a RuntimeError.
+    # Additive entry, so no WIRE_VERSION bump: an old peer decodes it as a
+    # plain RuntimeError carrying the same message.
+    "StaleLeaseError": getattr(_exc, "StaleLeaseError", RuntimeError),
 }
 
 

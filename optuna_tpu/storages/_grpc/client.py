@@ -54,6 +54,20 @@ _OP_TOKEN_METHODS = frozenset(
 # in bounded time so the retry loop can engage.
 _UNBOUNDED_ATTEMPT_TIMEOUT = 120.0
 
+# The op-token replay window: the longest interval after a replay-unsafe
+# write completes during which a retry of it can still legally arrive, so
+# the longest its recorded response must stay replayable. It equals the
+# per-attempt bound above because that is the outermost client-side clock:
+# every retry policy's overall deadline is either finite and enforced by
+# the client, or None — in which case each attempt is individually capped
+# at ``_UNBOUNDED_ATTEMPT_TIMEOUT``, after which the client stops retrying
+# that attempt and mints no further use of the token. Dedupe caches on the
+# other side (the server's in-process LRU, the fleet's shared replay ring)
+# compare evicted-entry ages against this window: evicting an entry YOUNGER
+# than it risks silently re-executing a write, which is exactly what the
+# loud ``grpc.op_token_evicted_live`` counter reports.
+OP_TOKEN_REPLAY_WINDOW_S = _UNBOUNDED_ATTEMPT_TIMEOUT
+
 
 def _default_retry_policy() -> RetryPolicy:
     # UNAVAILABLE during a proxy-server restart resolves in seconds; five

@@ -32,12 +32,29 @@ every hub already mounts) into a fleet:
   so an overloaded hub forwards an ask to the least-burning alive peer one
   rung before shedding to the client (``shed_forward``); only a fleet-wide
   burst walks the client-visible shed ladder.
+* **Lease-fenced ownership** (ISSUE 20) — liveness alone cannot stop a
+  *zombie*: a hub declared dead (partition, GC/SIGSTOP pause) that is still
+  alive and still writing. A hub's claim on a study is therefore an
+  epoch-numbered lease persisted as the ``lease:study:<id>`` system attr
+  (:class:`StudyLeases`); a successor's re-home bumps the epoch, and every
+  serve-state write from a hub (replay records, epoch watermarks,
+  ``ckpt:hub`` blobs) carries and is checked against its fencing epoch by
+  :class:`LeaseFencedStorage` — a stale-epoch write raises the typed
+  :class:`~optuna_tpu.exceptions.StaleLeaseError` and the zombie
+  self-demotes (drains asks toward the lease owner, never aborts a
+  client). When the ring prefers the deposed hub again (the partition
+  healed, or the interim owner died) it *fails back* by re-acquiring with
+  a further epoch bump, so ownership converges instead of flapping.
 
 The event vocabulary is :data:`FLEET_EVENTS` — registry-synced against
 ``_lint/registry.py::FLEET_EVENT_REGISTRY`` and the chaos matrix
 ``testing/fault_injection.py::HUB_CHAOS_MATRIX`` by graphlint rule
 **FLT001**; each event increments the ``serve.fleet.<event>`` telemetry
-counter family.
+counter family. The lease/fence vocabulary is :data:`LEASE_EVENTS` —
+registry-synced against ``_lint/registry.py::LEASE_EVENT_REGISTRY`` and
+``testing/fault_injection.py::LEASE_CHAOS_MATRIX`` by graphlint rule
+**FLT002**; lease events count as ``fleet.lease.<event>`` except the
+rejected write itself, which counts as the loud ``fleet.fenced_write``.
 """
 
 from __future__ import annotations
@@ -50,7 +67,9 @@ from typing import TYPE_CHECKING, Any, Callable, Mapping, Sequence
 
 from optuna_tpu import flight, locksan, telemetry
 from optuna_tpu import checkpoint as _ckpt
+from optuna_tpu.exceptions import StaleLeaseError
 from optuna_tpu.logging import get_logger
+from optuna_tpu.storages._base import _ForwardingStorage
 from optuna_tpu.storages._retry import RetryPolicy, TransientStorageError
 
 if TYPE_CHECKING:
@@ -86,6 +105,55 @@ REPLAY_SLOTS = 256
 
 _TOKEN_ATTR_PREFIX = "serve:fleet:tok:"
 _WATERMARK_ATTR_PREFIX = "serve:fleet:wm:"
+
+#: The lease/fence event vocabulary: every ownership transition the lease
+#: layer can take, each forced by a chaos scenario. Counted as
+#: ``fleet.lease.<event>`` — except ``fenced_write``, whose counter is the
+#: loud standalone ``fleet.fenced_write`` the chaos acceptance asserts
+#: exactly. Canonical mirror: ``_lint/registry.py::LEASE_EVENT_REGISTRY`` —
+#: graphlint rule **FLT002** fails if this copy (or the chaos matrix in
+#: ``testing/fault_injection.py::LEASE_CHAOS_MATRIX``) drifts.
+LEASE_EVENTS: dict[str, str] = {
+    "acquire": "a hub claimed an unleased study: epoch 1, the fence baseline every later takeover bumps past",
+    "renew": "the lease owner re-asserted its claim at the adaptive renewal cadence (read-check-then-write, injectable clock)",
+    "takeover": "a successor (re-home) or the returning ring primary (failback) bumped the epoch and displaced the recorded owner",
+    "demote": "a hub observed its claim was stale (fence trip or renewal check) and stopped writing serve state for the study",
+    "fenced_write": "a stale-epoch serve-state write was rejected by the lease fence with a typed StaleLeaseError",
+}
+
+#: Study-lease system-attr prefix; the full key is
+#: ``lease:study:<study_id>`` (self-describing — the record also names its
+#: owner and epoch, so a journal tail is readable without the key).
+LEASE_ATTR_PREFIX = "lease:study:"
+
+#: Default lease time-to-live. A lease is *expired* once its age exceeds
+#: ``grace_factor x ttl_s`` — the same adaptive-grace discipline hub
+#: liveness applies to slow health publishers
+#: (:data:`optuna_tpu.health.LIVENESS_GRACE_FACTOR`), so a slow renewer is
+#: not deposed by one missed beat.
+DEFAULT_LEASE_TTL_S = 15.0
+
+#: Ownership transitions kept on the lease record itself (newest last):
+#: the evidence trail the doctor's ``service.hub_flapping`` /
+#: ``service.partition_suspected`` checks read.
+LEASE_HISTORY_LIMIT = 8
+
+
+def lease_attr_key(study_id: int) -> str:
+    return f"{LEASE_ATTR_PREFIX}{study_id}"
+
+
+def read_lease(storage: "BaseStorage", study_id: int) -> dict | None:
+    """The persisted lease record for a study (None when unleased).
+    Shape: ``{"owner", "epoch", "ttl_s", "granted_unix", "renewed_unix",
+    "history": [{"owner", "epoch", "unix"}, ...]}``."""
+    lease = storage.get_study_system_attrs(study_id).get(lease_attr_key(study_id))
+    return dict(lease) if isinstance(lease, Mapping) else None
+
+
+def _count_lease_event(event: str, meta: dict | None = None) -> None:
+    name = "fleet.fenced_write" if event == "fenced_write" else f"fleet.lease.{event}"
+    telemetry.count(name, meta=meta)
 
 
 class HubUnavailableError(TransientStorageError):
@@ -210,8 +278,11 @@ class FleetReplicator:
     *redialed* asks (the client marks them), never on the hot path.
     """
 
-    def __init__(self, storage: "BaseStorage") -> None:
+    def __init__(
+        self, storage: "BaseStorage", *, now: Callable[[], float] = time.time
+    ) -> None:
         self._storage = storage
+        self._now = now
 
     @staticmethod
     def _slot(token: str) -> int:
@@ -219,13 +290,25 @@ class FleetReplicator:
             REPLAY_SLOTS
         )
 
-    def record_ask(self, study_id: int, token: str, resp: Mapping[str, Any]) -> None:
+    def record_ask(
+        self, study_id: int, token: str, resp: Mapping[str, Any], *, fence: int = 0
+    ) -> None:
         try:
             self._storage.set_study_system_attr(
                 study_id,
                 f"{_TOKEN_ATTR_PREFIX}{self._slot(token)}",
-                {"token": token, "resp": dict(resp)},
+                {
+                    "token": token,
+                    "resp": dict(resp),
+                    "fence": int(fence),
+                    "ts": self._now(),
+                },
             )
+        except StaleLeaseError:
+            # The fence already counted the rejection (fleet.fenced_write)
+            # and demoted this hub before raising: a zombie's replay record
+            # simply does not land, quietly.
+            _logger.info(f"fleet replay record for study {study_id} fenced.")
         except Exception as err:  # graphlint: ignore[PY001] -- replication is best-effort durability: the ask was answered; a record write blip must not fail it (the uncovered window equals today's single-hub behavior)
             _logger.warning(f"fleet replay record for study {study_id} raised {err!r}.")
 
@@ -239,17 +322,49 @@ class FleetReplicator:
         if isinstance(record, Mapping) and record.get("token") == token:
             resp = record.get("resp")
             return dict(resp) if isinstance(resp, Mapping) else None
+        if isinstance(record, Mapping) and "ts" in record:
+            # The slot was overwritten by a different token. If the
+            # overwrite is younger than the retry window, the record this
+            # redial needed may have been evicted while its client could
+            # still legally redial — the silent-re-execution hazard the
+            # op-token eviction hardening makes loud (satellite of ISSUE
+            # 20): the redialed ask now re-executes instead of replaying
+            # (still deduped by the answering hub's in-process token cache
+            # when it survived, but no longer across a hub death).
+            from optuna_tpu.storages._grpc.client import OP_TOKEN_REPLAY_WINDOW_S
+
+            age = self._now() - float(record.get("ts") or 0.0)
+            if 0.0 <= age < OP_TOKEN_REPLAY_WINDOW_S:
+                telemetry.count(
+                    "grpc.op_token_evicted_live",
+                    meta={"layer": "fleet", "slot": self._slot(token)},
+                )
+                _logger.warning(
+                    f"fleet replay slot for study {study_id} was overwritten "
+                    f"{age:.1f}s ago (< {OP_TOKEN_REPLAY_WINDOW_S:.0f}s retry "
+                    f"window): a live replay record was evicted; the redial "
+                    f"re-executes."
+                )
         return None
 
     def record_watermark(
-        self, study_id: int, hub: str, *, epoch: int, asks: int = 0
+        self, study_id: int, hub: str, *, epoch: int, asks: int = 0, fence: int = 0
     ) -> None:
         try:
             self._storage.set_study_system_attr(
                 study_id,
                 _WATERMARK_ATTR_PREFIX + hub,
-                {"hub": hub, "epoch": int(epoch), "asks": int(asks)},
+                {
+                    "hub": hub,
+                    "epoch": int(epoch),
+                    "asks": int(asks),
+                    "fence": int(fence),
+                    "ts": self._now(),
+                },
             )
+        except StaleLeaseError:
+            # See record_ask: counted and demoted at the fence already.
+            _logger.info(f"fleet watermark for study {study_id} fenced.")
         except Exception as err:  # graphlint: ignore[PY001] -- same best-effort contract as record_ask: a missed watermark means a successor starts one epoch behind, which the invalidation machinery already tolerates
             _logger.warning(f"fleet watermark for study {study_id} raised {err!r}.")
 
@@ -270,6 +385,311 @@ class FleetReplicator:
                 except (TypeError, ValueError):
                     continue
         return epoch
+
+
+# --------------------------------------------------------------- leases
+
+
+class StudyLeases:
+    """Epoch-numbered study-ownership leases persisted through the shared
+    storage (``lease:study:<id>`` system attr).
+
+    The epoch is the write fence: it only ever goes up (every ownership
+    transition bumps it), a hub's serve-state writes are valid only while
+    the persisted record still names this hub at the epoch it holds, and a
+    losing racer discovers the loss on its next fence check or renewal —
+    last-writer-wins storage is enough, no CAS needed, because two racers
+    writing the same epoch still disagree on ``owner`` and exactly one of
+    them fails the owner comparison.
+
+    Renewal is read-check-then-write on the injectable clock (the
+    ``RetryPolicy`` discipline): at most one storage round-trip per
+    ``ttl_s / 2`` per study, and the read half doubles as the stale-claim
+    detector. Fence checks cache the persisted view for ``check_ttl_s``
+    (0 → read-through, the chaos tests' deterministic mode; the default
+    amortizes the read the same way hub liveness does).
+    """
+
+    def __init__(
+        self,
+        storage: "BaseStorage",
+        owner: str,
+        *,
+        ttl_s: float = DEFAULT_LEASE_TTL_S,
+        grace_factor: float | None = None,
+        check_ttl_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        now: Callable[[], float] = time.time,
+    ) -> None:
+        from optuna_tpu import health
+
+        self._storage = storage
+        self.owner = owner
+        self.ttl_s = float(ttl_s)
+        self.grace_factor = float(
+            health.LIVENESS_GRACE_FACTOR if grace_factor is None else grace_factor
+        )
+        self.check_ttl_s = float(check_ttl_s)
+        self._clock = clock
+        self._now = now
+        self._lock = locksan.lock("fleet.lease")
+        #: study_id -> epoch this hub holds (locally; the fence compares it
+        #: against the persisted record).
+        self._held: dict[int, int] = {}
+        #: study_id -> monotonic deadline of the next renewal.
+        self._next_renew: dict[int, float] = {}
+        #: study_id -> (expires_monotonic, persisted_epoch, persisted_owner).
+        self._fence_cache: dict[int, tuple[float, int, str]] = {}
+
+    # ------------------------------------------------------------- record
+
+    def read(self, study_id: int) -> dict | None:
+        return read_lease(self._storage, study_id)
+
+    def expired(self, lease: Mapping[str, Any], *, now: float | None = None) -> bool:
+        """A lease whose renewal age exceeds the grace window: safe for any
+        successor to take over without a liveness verdict. A released lease
+        (``renewed_unix == 0``) is immediately expired — the clean-drain
+        handoff path."""
+        now = self._now() if now is None else now
+        renewed = float(lease.get("renewed_unix", 0.0))
+        ttl = float(lease.get("ttl_s", self.ttl_s)) or self.ttl_s
+        return now - renewed > self.grace_factor * ttl
+
+    def held_epoch(self, study_id: int) -> int:
+        with self._lock:
+            return self._held.get(study_id, 0)
+
+    def _write(self, study_id: int, record: dict) -> None:
+        # Storage write outside the lock (CONC002); the local tables update
+        # after the write lands so a failed write never fabricates a claim.
+        self._storage.set_study_system_attr(
+            study_id, lease_attr_key(study_id), record
+        )
+        with self._lock:
+            self._held[study_id] = int(record["epoch"])
+            self._next_renew[study_id] = self._clock() + self.ttl_s / 2.0
+            self._fence_cache[study_id] = (
+                self._clock() + self.check_ttl_s,
+                int(record["epoch"]),
+                str(record["owner"]),
+            )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def acquire(self, study_id: int, *, takeover: bool = False) -> int:
+        """Claim (or re-assert) the study. Returns the held epoch, or 0 when
+        another owner's valid lease stands and ``takeover`` was not
+        requested. ``takeover=True`` is the re-home/failback path: bump the
+        epoch past the recorded owner's — its in-flight writes are fenced
+        from this moment on."""
+        current = self.read(study_id)
+        now = self._now()
+        history = list(current.get("history") or []) if current else []
+        if current is None:
+            epoch, event = 1, "acquire"
+            granted = now
+        elif current.get("owner") == self.owner:
+            epoch = int(current.get("epoch", 0)) or 1
+            event = None  # refresh of an existing claim, not a transition
+            granted = float(current.get("granted_unix", now))
+        elif takeover or self.expired(current, now=now):
+            epoch = int(current.get("epoch", 0)) + 1
+            event = "takeover"
+            granted = now
+        else:
+            return 0
+        if event is not None:
+            history.append({"owner": self.owner, "epoch": epoch, "unix": now})
+            history = history[-LEASE_HISTORY_LIMIT:]
+        self._write(
+            study_id,
+            {
+                "owner": self.owner,
+                "epoch": epoch,
+                "ttl_s": self.ttl_s,
+                "granted_unix": granted,
+                "renewed_unix": now,
+                "history": history,
+            },
+        )
+        if event is not None:
+            _count_lease_event(
+                event, meta={"study": study_id, "owner": self.owner, "epoch": epoch}
+            )
+        return epoch
+
+    def tick(self, study_id: int) -> int:
+        """Hot-path upkeep: returns the held epoch (0 = no claim) and, when
+        the adaptive renewal cadence is due, re-reads and re-asserts the
+        lease — raising :class:`StaleLeaseError` if it was taken over. The
+        not-due path is two dict reads and a clock compare: no storage
+        traffic, no allocations."""
+        with self._lock:
+            held = self._held.get(study_id, 0)
+            due = held > 0 and self._clock() >= self._next_renew.get(study_id, 0.0)
+        if due:
+            self._renew(study_id, held)
+        return held
+
+    def _renew(self, study_id: int, held: int) -> None:
+        current = self.read(study_id)
+        now = self._now()
+        if current is not None:
+            epoch = int(current.get("epoch", 0))
+            owner = current.get("owner")
+            if epoch > held or (epoch >= held and owner != self.owner):
+                raise StaleLeaseError(
+                    study_id, held_epoch=held, fence_epoch=epoch, owner=owner
+                )
+        record = dict(current) if current is not None else {
+            "owner": self.owner,
+            "epoch": held,
+            "ttl_s": self.ttl_s,
+            "granted_unix": now,
+            "history": [{"owner": self.owner, "epoch": held, "unix": now}],
+        }
+        record["renewed_unix"] = now
+        self._write(study_id, record)
+        _count_lease_event(
+            "renew", meta={"study": study_id, "owner": self.owner, "epoch": held}
+        )
+
+    def check_fence(self, study_id: int) -> int:
+        """The write fence: a no-op for unleased studies (epoch 0 — the
+        pre-lease legacy write path a spill peer or solo hub takes), else
+        compares the held epoch against the persisted record (cached for
+        ``check_ttl_s``) and raises :class:`StaleLeaseError` when the claim
+        is stale. A read blip passes the write through — availability over
+        strictness, matching every other best-effort serve-state path."""
+        with self._lock:
+            held = self._held.get(study_id, 0)
+            if held == 0:
+                return 0
+            cached = self._fence_cache.get(study_id)
+            fresh = cached if cached is not None and self._clock() < cached[0] else None
+        if fresh is None:
+            try:
+                current = self.read(study_id)
+            except Exception as err:  # graphlint: ignore[PY001] -- a fence that cannot read must not block the write: the uncovered window equals today's pre-lease behavior, and the next readable check re-arms it
+                _logger.warning(
+                    f"lease fence read for study {study_id} raised {err!r}; "
+                    f"write passed unfenced."
+                )
+                return held
+            epoch = int(current.get("epoch", held)) if current else held
+            owner = str((current or {}).get("owner", self.owner))
+            with self._lock:
+                self._fence_cache[study_id] = (
+                    self._clock() + self.check_ttl_s, epoch, owner
+                )
+        else:
+            epoch, owner = fresh[1], fresh[2]
+        if epoch > held or (epoch == held and owner != self.owner):
+            raise StaleLeaseError(
+                study_id, held_epoch=held, fence_epoch=epoch, owner=owner
+            )
+        return held
+
+    def release(self, study_id: int) -> None:
+        """Clean handoff (drain/close): mark the persisted record released
+        (``renewed_unix = 0`` — instantly expired) so a successor takes over
+        without waiting out the grace window. The local epoch stays held:
+        any write this hub still attempts remains fence-checked."""
+        current = self.read(study_id)
+        if current is None or current.get("owner") != self.owner:
+            return
+        record = dict(current)
+        record["renewed_unix"] = 0.0
+        record["released"] = True
+        self._storage.set_study_system_attr(
+            study_id, lease_attr_key(study_id), record
+        )
+
+    def release_all(self) -> None:
+        with self._lock:
+            held = list(self._held)
+        for study_id in held:
+            try:
+                self.release(study_id)
+            except Exception as err:  # graphlint: ignore[PY001] -- release is a courtesy to the successor (skip the grace wait); a drain must complete even when the shared storage is already gone
+                _logger.warning(
+                    f"lease release for study {study_id} raised {err!r}."
+                )
+
+    def invalidate(self, study_id: int | None = None) -> None:
+        """Drop the cached fence view (the chaos kit flips ownership
+        mid-burst; real traffic just waits out ``check_ttl_s``)."""
+        with self._lock:
+            if study_id is None:
+                self._fence_cache.clear()
+            else:
+                self._fence_cache.pop(study_id, None)
+
+
+class LeaseFencedStorage(_ForwardingStorage):
+    """The hub-side storage stack's fence (the storage layer that rejects
+    stale-epoch writes): wraps the storage a hub writes its serve state
+    through and checks the lease fence on every serve-state study attr —
+    replay records (``serve:fleet:tok:*``), epoch watermarks
+    (``serve:fleet:wm:*``), and checkpoints (``ckpt:*``). A stale claim
+    raises the typed :class:`StaleLeaseError`, counts the loud
+    ``fleet.fenced_write``, and notifies the hub's demotion ladder — the
+    write never reaches the backing storage.
+
+    Everything else passes through untouched: client-originated writes ride
+    the *mounted* storage (a different wrapper entirely), health snapshots
+    must keep flowing from a zombie (that is how flapping stays
+    observable), and the hub's per-trial fallback-diagnostics attr is
+    single-writer by construction (only the hub that answered that trial's
+    ask ever writes it), so none of them are split-brain hazards.
+    """
+
+    _FENCED_STUDY_PREFIXES = (
+        _TOKEN_ATTR_PREFIX,
+        _WATERMARK_ATTR_PREFIX,
+        _ckpt.CKPT_ATTR_PREFIX,
+    )
+
+    def __init__(
+        self,
+        inner: "BaseStorage",
+        leases: StudyLeases,
+        *,
+        on_fenced: Callable[[int, StaleLeaseError], None] | None = None,
+    ) -> None:
+        super().__init__(inner)
+        self._leases = leases
+        self._on_fenced = on_fenced
+
+    def __getattr__(self, name: str) -> Any:
+        # Backend-specific extras beyond the BaseStorage surface (e.g. the
+        # proxy's incremental-read hook) must keep flowing through the fence.
+        return getattr(object.__getattribute__(self, "_backend"), name)
+
+    def fence_epoch(self, study_id: int) -> int:
+        """The epoch this hub's writes carry for the study (0 = unleased):
+        what ``_write_hub_checkpoint`` stamps into the ``ckpt:hub`` frame."""
+        return self._leases.held_epoch(study_id)
+
+    def set_study_system_attr(self, study_id: int, key: str, value: Any) -> None:
+        if key.startswith(self._FENCED_STUDY_PREFIXES):
+            try:
+                self._leases.check_fence(study_id)
+            except StaleLeaseError as err:
+                _count_lease_event(
+                    "fenced_write",
+                    meta={
+                        "study": study_id,
+                        "key": key,
+                        "held": err.held_epoch,
+                        "fence": err.fence_epoch,
+                    },
+                )
+                if self._on_fenced is not None:
+                    self._on_fenced(study_id, err)
+                raise
+        return self._backend.set_study_system_attr(study_id, key, value)
 
 
 # ------------------------------------------------------------------ hub
@@ -297,6 +717,9 @@ class FleetHub:
         *,
         peers: Mapping[str, Any] | None = None,
         liveness_ttl_s: float = 1.0,
+        lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+        lease_check_ttl_s: float = 1.0,
+        leases: StudyLeases | None = None,
         clock: Callable[[], float] = time.monotonic,
         now: Callable[[], float] = time.time,
     ) -> None:
@@ -312,8 +735,45 @@ class FleetHub:
 
             service._health_worker_id = name + health.HUB_WORKER_ID_SUFFIX
         self.router = router
-        self.replicator = FleetReplicator(storage)
         self._storage = storage
+        if len(router.hubs) == 1:
+            # A fleet of one has no successor to fence against: skip the
+            # lease machinery entirely so the solo twin stays write-for-write
+            # identical to a bare single hub (no lease attrs, no extra reads).
+            self.leases: StudyLeases | None = None
+            self.replicator = FleetReplicator(storage, now=now)
+        else:
+            self.leases = (
+                leases
+                if leases is not None
+                else StudyLeases(
+                    storage,
+                    name,
+                    ttl_s=lease_ttl_s,
+                    check_ttl_s=lease_check_ttl_s,
+                    clock=clock,
+                    now=now,
+                )
+            )
+            # Single enforcement point for every serve-state write this hub
+            # originates: the service's own (ckpt:hub blobs via note_tell's
+            # checkpoint cadence) and the replicator's (replay records,
+            # epoch watermarks) both flow through the lease fence. Lease
+            # records themselves ride the RAW storage — displacing a zombie
+            # must never be blocked by the zombie's own stale claim. A
+            # service double without a storage (liveness-only harnesses)
+            # originates no serve-state writes, so it has nothing to fence.
+            if hasattr(service, "_storage"):
+                service._storage = LeaseFencedStorage(
+                    service._storage, self.leases, on_fenced=self._on_fenced
+                )
+            self.replicator = FleetReplicator(
+                LeaseFencedStorage(storage, self.leases, on_fenced=self._on_fenced),
+                now=now,
+            )
+        #: study_id -> usurping owner name ("" when unknown) once a fence
+        #: trip demoted this hub for the study; cleared on failback.
+        self._fenced_studies: dict[int, str] = {}
         self._peers: dict[str, Any] = dict(peers or {})
         self._liveness_ttl_s = float(liveness_ttl_s)
         self._clock = clock
@@ -350,6 +810,15 @@ class FleetHub:
     def set_peer(self, name: str, peer: Any) -> None:
         self._peers[name] = peer
 
+    def drain(self) -> None:
+        """Clean shutdown: drain the wrapped service first (every parked ask
+        gets its verdict), then release every held lease — a released lease
+        is instantly expired, so successors take over without waiting out
+        the grace window."""
+        self.service.drain()
+        if self.leases is not None:
+            self.leases.release_all()
+
     # ------------------------------------------------------------ liveness
 
     def alive_hubs(self, study_id: int) -> frozenset[str]:
@@ -379,6 +848,10 @@ class FleetHub:
                 self._liveness_cache.clear()
             else:
                 self._liveness_cache.pop(study_id, None)
+        if self.leases is not None:
+            # Ownership and liveness flip together in the chaos kit: a hub
+            # told liveness changed should re-read the lease fence too.
+            self.leases.invalidate(study_id)
 
     # ----------------------------------------------------------------- ask
 
@@ -442,6 +915,12 @@ class FleetHub:
         alive: frozenset[str],
     ) -> dict:
         self._adopt(study_id, alive)
+        self._ensure_lease(study_id, alive)
+        demoted_to = self._demoted_for(study_id)
+        if demoted_to is not None:
+            return self._drain_to_owner(
+                demoted_to, study_id, trial_id, trial_number, op_token, alive
+            )
         resp = self.service.service_ask(study_id, trial_id, trial_number)
         if resp.get("shed") == "reject":
             forwarded = self._shed_forward(study_id, trial_id, trial_number, op_token, alive)
@@ -452,9 +931,116 @@ class FleetHub:
             and not self.solo
             and resp.get("shed") != "reject"
         ):
-            self.replicator.record_ask(study_id, op_token, resp)
+            fence = self.leases.held_epoch(study_id) if self.leases is not None else 0
+            self.replicator.record_ask(study_id, op_token, resp, fence=fence)
         self._publish_watermark(study_id)
         return resp
+
+    # -------------------------------------------------------------- leases
+
+    def _ensure_lease(self, study_id: int, alive: frozenset[str]) -> None:
+        """Lease upkeep on the local answer path. Ring-preferred and
+        unleased → acquire (bumping past any recorded owner: the re-home
+        path). Already leased → tick (renewal at the adaptive cadence; a
+        stale claim surfaces here as :class:`StaleLeaseError` → demotion).
+        Demoted but ring-preferred again → *failback*: re-acquire with a
+        further epoch bump — the interim owner's next check demotes it, so
+        ownership converges on the ring's preference instead of flapping.
+        Not preferred and unleased → answer unfenced (epoch 0): the
+        spill-peer path, whose writes were always best-effort."""
+        if self.leases is None:
+            return
+        preferred = self.router.route(study_id, alive) == self.name
+        try:
+            with self._adopt_lock:
+                demoted = study_id in self._fenced_studies
+            if demoted:
+                if preferred:
+                    self.leases.acquire(study_id, takeover=True)
+                    with self._adopt_lock:
+                        self._fenced_studies.pop(study_id, None)
+                return
+            if self.leases.held_epoch(study_id) > 0:
+                self.leases.tick(study_id)
+            elif preferred:
+                self.leases.acquire(study_id, takeover=True)
+        except StaleLeaseError as err:
+            self._on_fenced(study_id, err)
+        except Exception as err:  # graphlint: ignore[PY001] -- lease upkeep must never fail an ask: an unreadable lease record leaves this hub on the unfenced epoch-0 path, exactly the pre-lease behavior, until the record reads again
+            _logger.warning(
+                f"lease upkeep for study {study_id} on hub {self.name!r} "
+                f"raised {err!r}."
+            )
+
+    def _on_fenced(self, study_id: int, err: StaleLeaseError) -> None:
+        """Fence trip → self-demotion: remember the usurper (asks drain
+        toward it), count the demotion once per episode, and invalidate the
+        ready queue so no parked proposal minted under the lost claim is
+        ever served."""
+        with self._adopt_lock:
+            already = study_id in self._fenced_studies
+            self._fenced_studies[study_id] = err.owner or ""
+        if already:
+            return
+        _count_lease_event(
+            "demote",
+            meta={
+                "study": study_id,
+                "hub": self.name,
+                "owner": err.owner,
+                "held": err.held_epoch,
+                "fence": err.fence_epoch,
+            },
+        )
+        _logger.warning(
+            f"hub {self.name!r} demoted for study {study_id}: its lease "
+            f"epoch {err.held_epoch} is fenced by epoch {err.fence_epoch} "
+            f"(owner {err.owner!r}); asks drain toward the owner."
+        )
+        handle = self.service._handles.get(study_id)
+        if handle is not None:
+            handle.queue.invalidate()
+
+    def _demoted_for(self, study_id: int) -> str | None:
+        """The usurping owner to drain toward while demoted ("" when the
+        fence could not name one), or None when not demoted."""
+        if self.leases is None:
+            return None
+        with self._adopt_lock:
+            if study_id not in self._fenced_studies:
+                return None
+            return self._fenced_studies[study_id]
+
+    def _drain_to_owner(
+        self,
+        owner: str,
+        study_id: int,
+        trial_id: int,
+        trial_number: int,
+        op_token: str | None,
+        alive: frozenset[str],
+    ) -> dict:
+        """The self-demotion ladder: a fence-tripped hub hands asks to the
+        lease owner — forwarded when the owner is a reachable peer, else a
+        redial-to-successor shed verdict — never a client-visible abort and
+        never a locally minted proposal whose serve-state writes the fence
+        would reject anyway."""
+        if owner and owner in self._peers and owner in alive:
+            resp = self._forward(owner, study_id, trial_id, trial_number, op_token)
+            if resp is not None:
+                return resp
+        from optuna_tpu.storages._grpc.suggest_service import RESOURCE_EXHAUSTED
+
+        return {
+            "params": {},
+            "dists": {},
+            "fallback": None,
+            "shed": "reject",
+            "status": RESOURCE_EXHAUSTED,
+            "retry_after_s": 0.05,
+            "redial_to": owner or None,
+            "source": "lease",
+        }
 
     def _forward(
         self,
@@ -624,8 +1210,10 @@ class FleetHub:
         if self._published_epochs.get(study_id) == epoch:
             return
         self._published_epochs[study_id] = epoch
+        fence = self.leases.held_epoch(study_id) if self.leases is not None else 0
         self.replicator.record_watermark(
-            study_id, self.name, epoch=epoch, asks=handle.asks_since_fill
+            study_id, self.name, epoch=epoch, asks=handle.asks_since_fill,
+            fence=fence,
         )
 
 
@@ -676,10 +1264,16 @@ class FleetClient:
     def ask(self, study_id: int, trial_id: int, number: int, token: str) -> dict:
         order = self.router.successors(study_id)
         attempt = 0
+        redial_to: str | None = None
         while True:
-            hub = order[attempt % len(order)]
+            hub = (
+                redial_to
+                if redial_to is not None and redial_to in self._asks
+                else order[attempt % len(order)]
+            )
+            redial_to = None
             try:
-                return self._asks[hub](
+                resp = self._asks[hub](
                     study_id, trial_id, number, token, attempt > 0
                 )
             except Exception as err:  # graphlint: ignore[PY001] -- the injected classifier decides retryability; everything else re-raises to the sampler's degradation boundary
@@ -694,6 +1288,30 @@ class FleetClient:
                 # the shared replay record, so a committed-but-unacked ask
                 # is answered, not re-executed.
                 self._retry.backoff(attempt)
+                continue
+            if (
+                isinstance(resp, Mapping)
+                and resp.get("source") == "lease"
+                and resp.get("shed") == "reject"
+                and attempt + 1 < self._retry.max_attempts
+            ):
+                # A demoted (fence-tripped) hub drained us toward the lease
+                # owner: redial there with the same token — the owner either
+                # answers fresh or replays the shared record. Never an
+                # abort; a fleet that cannot name a live owner just walks
+                # the ring like any unavailable-hub redial.
+                attempt += 1
+                target = resp.get("redial_to")
+                redial_to = target if isinstance(target, str) else None
+                _logger.warning(
+                    f"fleet hub {hub!r} is demoted for study {study_id}; "
+                    f"redialing"
+                    + (f" lease owner {redial_to!r}" if redial_to else " next replica")
+                    + f" (attempt {attempt})."
+                )
+                self._retry.backoff(attempt)
+                continue
+            return resp
 
 
 def _default_unavailable(err: BaseException) -> bool:
@@ -782,11 +1400,16 @@ def attach_hub(
     name: str,
     *,
     replicas: int = 64,
+    lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+    lease_check_ttl_s: float = 1.0,
 ) -> FleetHub:
     """Wrap ``service`` as fleet member ``name`` of an endpoint-named fleet
     (``run_grpc_proxy_server(..., fleet_hubs=..., fleet_name=...)`` calls
     this): the returned hub is the ``suggest_service`` the server mounts."""
     router = FleetRouter(hubs, replicas=replicas)
     return FleetHub(
-        name, service, router, storage, peers=remote_peers(router.hubs, name)
+        name, service, router, storage,
+        peers=remote_peers(router.hubs, name),
+        lease_ttl_s=lease_ttl_s,
+        lease_check_ttl_s=lease_check_ttl_s,
     )

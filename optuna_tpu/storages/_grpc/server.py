@@ -8,6 +8,7 @@ the real storage, so the backing store sees a single client.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from concurrent import futures
 from typing import TYPE_CHECKING
@@ -42,6 +43,9 @@ _OP_TOKEN_CACHE_SIZE = 8192
 def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None" = None):
     import grpc
 
+    from optuna_tpu.logging import warn_once
+    from optuna_tpu.storages._grpc.client import OP_TOKEN_REPLAY_WINDOW_S
+
     _HEARTBEAT_DEFAULTS = {
         "get_heartbeat_interval": None,
         "_get_stale_trial_ids": [],
@@ -49,13 +53,18 @@ def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None"
         "get_failed_trial_callback": None,
     }
 
-    # token -> encoded successful response. Replaying the recorded bytes (not
-    # re-executing) makes client retries of replay-unsafe writes exactly-
-    # once: the first execution's trial id comes back on every replay.
+    # token -> (encoded successful response, monotonic insert time).
+    # Replaying the recorded bytes (not re-executing) makes client retries of
+    # replay-unsafe writes exactly-once: the first execution's trial id comes
+    # back on every replay. The insert time is the eviction age floor's
+    # evidence: an entry evicted younger than the client retry window
+    # (``OP_TOKEN_REPLAY_WINDOW_S``) could still receive a legal retry that
+    # would now silently re-execute — counted loud as
+    # ``grpc.op_token_evicted_live`` instead of discovered as a double-apply.
     # `token_in_flight` coalesces a retry that arrives while the original is
     # STILL EXECUTING (connection died mid-call): the latecomer waits for the
     # owner to finish instead of racing it into a double-apply.
-    token_cache: "OrderedDict[str, bytes]" = OrderedDict()
+    token_cache: "OrderedDict[str, tuple[bytes, float]]" = OrderedDict()
     token_in_flight: dict = {}  # token -> threading.Event
     token_lock = locksan.lock("server.op_token")
 
@@ -89,7 +98,7 @@ def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None"
                         f"Replaying recorded response for retried {method_name} "
                         f"(op token {op_token[:8]}...)."
                     )
-                    return replay
+                    return replay[0]
                 if pending is None:
                     break  # owner: fall through and execute
                 # Original attempt still executing; wait, then re-check the
@@ -120,14 +129,36 @@ def _make_handler(storage: BaseStorage, suggest_service: "SuggestService | None"
             error_response = encode_response(False, e)
         finally:
             if op_token is not None:
+                evicted_live: list[float] = []
                 with token_lock:
                     if response is not None:
-                        token_cache[op_token] = response
+                        token_cache[op_token] = (response, time.monotonic())
                         while len(token_cache) > _OP_TOKEN_CACHE_SIZE:
-                            token_cache.popitem(last=False)
+                            _, (_, born) = token_cache.popitem(last=False)
+                            age = time.monotonic() - born
+                            if age < OP_TOKEN_REPLAY_WINDOW_S:
+                                evicted_live.append(age)
                     waiter = token_in_flight.pop(op_token, None)
                 if waiter is not None:
                     waiter.set()
+                for age in evicted_live:
+                    # A still-replayable entry fell off the LRU: the cache is
+                    # undersized for this token churn, and a delayed retry of
+                    # the evicted op would now silently re-execute a
+                    # replay-unsafe write. Loud counter + one warning (the
+                    # counter keeps counting; the log does not flood).
+                    telemetry.count(
+                        "grpc.op_token_evicted_live",
+                        meta={"layer": "server", "age_s": round(age, 3)},
+                    )
+                    warn_once(
+                        _logger,
+                        "op_token_evicted_live",
+                        f"op-token cache evicted an entry only {age:.1f}s old "
+                        f"(< {OP_TOKEN_REPLAY_WINDOW_S:.0f}s retry window): a "
+                        f"delayed duplicate of that op would re-execute; raise "
+                        f"_OP_TOKEN_CACHE_SIZE for this churn rate.",
+                    )
         return response if response is not None else error_response
 
     class Handler(grpc.GenericRpcHandler):

@@ -737,6 +737,15 @@ class SuggestService:
     def _ask_impl(self, study_id: int, trial_id: int, trial_number: int) -> dict:
         handle = self._handle(study_id)
         handle.asks_since_fill += 1
+        if self._health_reporting:
+            from optuna_tpu import health
+
+            # Serving asks IS liveness: a hub whose clients tell through a
+            # *different* storage endpoint never reaches note_tell, and
+            # without a -serve snapshot its death is "unknown, not dead" to
+            # the fleet — no re-home, no lease takeover. The reporter
+            # rate-limits to its interval, so this is a clock read per ask.
+            health.maybe_report(handle.study)
         self._publish_depth_gauges(study_id, handle)
         entry = handle.queue.pop_fresh(self.max_stale_epochs)
         if entry is not None:
@@ -1175,6 +1184,12 @@ class SuggestService:
             return
         if handle.ckpt_seq is None:
             handle.ckpt_seq = _ckpt.max_slot_seq(self._storage, study_id, "hub") + 1
+        # Fleet members swap in a lease-fenced storage (fleet.py): stamp the
+        # held fencing epoch into the frame for provenance, and let the fence
+        # itself reject the write when the claim went stale (write_checkpoint
+        # absorbs the StaleLeaseError as its usual best-effort skip — the
+        # fence already counted fleet.fenced_write and demoted the hub).
+        fence_of = getattr(self._storage, "fence_epoch", None)
         _ckpt.write_checkpoint(
             self._storage,
             study_id,
@@ -1182,6 +1197,7 @@ class SuggestService:
             {"sampler": state, "epoch": int(epoch)},
             n_told=handle.tells_total,
             seq=handle.ckpt_seq,
+            fence=int(fence_of(study_id)) if callable(fence_of) else 0,
         )
         handle.ckpt_seq += 1
 

@@ -10,6 +10,16 @@ all workers sharing the backend converge on the same state; CAS semantics
 replay* and reported back to the issuing worker through an own-op result map.
 This storage is also the template for the ICI allgather journal in
 :mod:`optuna_tpu.parallel` (same ops, different transport).
+
+The serving plane keeps its replicated state in study *system attrs* on
+top of this log, under reserved key namespaces: ``serve:fleet:tok:`` /
+``serve:fleet:wm:`` (op-token replay ring, epoch watermarks), ``ckpt:``
+(sampler-state checkpoints), ``health:worker:`` (doctor snapshots), and
+``lease:study:<id>`` — the epoch-numbered study-ownership lease the hub
+fleet fences its serve-state writes against (see
+:mod:`optuna_tpu.storages._grpc.fleet`). The journal itself treats these
+as opaque attrs; the fencing that keeps a deposed hub's stale writes out
+happens in the fleet's storage wrapper *before* an op is appended.
 """
 
 from __future__ import annotations

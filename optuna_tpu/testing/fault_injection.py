@@ -365,6 +365,15 @@ HEALTH_CHECK_CHAOS_MATRIX: dict[str, str] = {
     "corrupt-blob leg): each blob is CRC-rejected and counted, the resume falls back to the "
     "recompute-from-history path, and the doctor reports the rejection totals; the "
     "clean-resume twin stays unflagged",
+    "service.hub_flapping": "bounce a study's lease between two hubs (repeated kill/heal, "
+    "LeaseChaosPlan's flap leg): three takeovers land in the lease history inside the "
+    "window, the doctor names both hubs, and the single-takeover twin stays clean",
+    "service.hub_zombie_fenced": "push tells through a partitioned owner (the zombie); "
+    "its stale-epoch writes are fenced and fleet.fenced_write lands in the -serve "
+    "snapshot, so the doctor reports the zombie before operators chase ghost writes",
+    "service.partition_suspected": "take over a study's lease while the deposed hub's "
+    "-serve snapshot is still fresh (alive behind the partition): the doctor flags "
+    "partition-not-crash; the crashed-hub twin (stale snapshot) reports hub_dead instead",
 }
 
 
@@ -765,6 +774,69 @@ def hub_chaos_plan() -> HubChaosPlan:
     return HubChaosPlan()
 
 
+# Chaos matrix for the lease/fence layer's ownership transitions: every
+# lease event the fencing layer can record (``storages/_grpc/fleet.py::
+# LEASE_EVENTS``) maps to the gray-failure scenario
+# ``tests/test_lease_chaos.py`` must prove forces it. Deliberately a
+# hand-written literal (not an import of ``fleet.LEASE_EVENTS``): graphlint
+# rule FLT002 cross-checks both against
+# ``_lint/registry.py::LEASE_EVENT_REGISTRY`` — adding a lease transition
+# without a partition scenario that forces it is a lint failure (the
+# STO001/.../FLT001 pattern), because an unexercised fence admits its first
+# double-applied zombie write during exactly the partition it was built for.
+LEASE_CHAOS_MATRIX: dict[str, str] = {
+    "acquire": "serve the first ask of a fresh study on its ring-preferred hub; the "
+    "lease:study: record lands with epoch 1 and that hub as owner, and the fault-free "
+    "solo twin writes no lease attrs at all",
+    "renew": "keep serving past the renewal cadence (ttl/2, injectable clock); the owner "
+    "re-asserts the record in place — same epoch, refreshed renewed_unix, no history entry",
+    "takeover": "partition the owning hub mid-burst (FakeHubFleet.kill); the ring "
+    "successor re-homes, bumps the epoch, and on heal the returning primary bumps it "
+    "again to reclaim (failback) — both transitions land in the bounded lease history",
+    "demote": "let the partitioned owner keep serving behind the partition; its first "
+    "fenced write (or renewal check) reveals the successor's higher epoch and it stops "
+    "answering locally, draining parked asks with a redial-to-successor verdict",
+    "fenced_write": "drive tells through the zombie so its checkpoint/replay/watermark "
+    "writes carry the stale epoch; the fence rejects every one with StaleLeaseError and "
+    "fleet.fenced_write counts them exactly — zero reach the shared journal",
+}
+
+
+@dataclass(frozen=True)
+class LeaseChaosPlan:
+    """One deterministic lease-fencing chaos scenario: a fleet over ONE
+    shared journal storage, a client burst, an asymmetric partition of the
+    owning hub mid-burst (killed for RPCs, alive in-process — the zombie),
+    tells pushed through the zombie's still-mounted storage, then a heal
+    and failback — plus the exact outcome the acceptance test asserts
+    (``tests/test_lease_chaos.py``): every zombie serve-state write is
+    fenced and counted (``fleet.fenced_write`` equals the rejection count
+    exactly), zero double-applied tells, zero lost parked asks (drained
+    with redial verdicts, never aborted), the healed primary reclaims the
+    lease with a fresh epoch, and the best value is bit-identical to the
+    fault-free twin — all under the armed lock sanitizer.
+
+    ``lease_check_ttl_s`` is 0 so every fence check reads through to
+    storage: the test is deterministic, not cache-timing dependent.
+    """
+
+    n_hubs: int = 2
+    n_trials: int = 16
+    seed: int = 13
+    #: Trials served before the partition strikes — mid-burst by design.
+    partition_after_trials: int = 5
+    #: Tells pushed through the zombie while partitioned; each drives a
+    #: checkpoint write (checkpoint_every=1) the fence must reject.
+    zombie_tells: int = 3
+    lease_check_ttl_s: float = 0.0
+
+
+def lease_chaos_plan() -> LeaseChaosPlan:
+    """The default :class:`LeaseChaosPlan` the chaos suite runs — a
+    two-hub fleet, partition after five trials, three zombie tells."""
+    return LeaseChaosPlan()
+
+
 # The preemption scenario required for every checkpoint lifecycle event.
 # Canonical key source: ``checkpoint.CHECKPOINT_EVENTS``; graphlint rule
 # CKPT001 cross-checks both against
@@ -873,6 +945,8 @@ class FakeHubFleet:
         *,
         replicas: int = 64,
         liveness_ttl_s: float = 0.0,
+        lease_ttl_s: float | None = None,
+        lease_check_ttl_s: float = 1.0,
     ) -> None:
         import types
 
@@ -890,6 +964,8 @@ class FakeHubFleet:
         self._killed: set[str] = set()
         self._drops: dict[tuple[str, str], int] = {}
         self._lock = threading.Lock()
+        if lease_ttl_s is None:
+            lease_ttl_s = fleet_mod.DEFAULT_LEASE_TTL_S
         for name in hub_names:
             service = service_factory(name)
             hub = fleet_mod.FleetHub(
@@ -898,6 +974,8 @@ class FakeHubFleet:
                 self.router,
                 storage,
                 liveness_ttl_s=liveness_ttl_s,
+                lease_ttl_s=lease_ttl_s,
+                lease_check_ttl_s=lease_check_ttl_s,
             )
             mounted = hub.wrap_storage(storage)
             handler = _make_handler(mounted, hub)
@@ -1063,6 +1141,129 @@ class _FleetPeerStub:
 
     def service_burn_verdict(self) -> dict:
         return self._fleet.rpc(self.name, "service_burn_verdict")
+
+
+class SocketHubFleet(FakeHubFleet):
+    """:class:`FakeHubFleet`'s real-socket twin: the same N fleet hubs over
+    ONE shared storage, but each hub listens on its own loopback gRPC
+    server and every client and peer RPC crosses a real channel — wire
+    codec, HTTP/2 framing, kernel TCP, and server thread-pool dispatch all
+    paid for. ``mounted[name]`` is a
+    :class:`~optuna_tpu.storages._grpc.client.GrpcStorageProxy`, so study
+    create/load/tell traffic rides the wire too, exactly like a remote
+    worker's.
+
+    The chaos taps (:meth:`kill` / :meth:`heal` / :meth:`drop_response`)
+    sever the CLIENT side of the channel, which is what a network partition
+    does: the server keeps running behind the cut and its lease keeps
+    aging — the gray-failure geometry ISSUE 20's fencing exists for.
+
+    Used by ``bench.py --loop=serve --transport=socket`` (the serve numbers'
+    real-channel-latency twin — the ARCHITECTURE Known-gaps row) and by
+    netchaos tests that want faults on a real channel rather than the
+    handler-direct seam."""
+
+    def __init__(
+        self,
+        storage: BaseStorage,
+        hub_names: Sequence[str],
+        service_factory: Callable[[str], Any],
+        *,
+        replicas: int = 64,
+        liveness_ttl_s: float = 0.0,
+        lease_ttl_s: float | None = None,
+        lease_check_ttl_s: float = 1.0,
+        host: str = "localhost",
+    ) -> None:
+        import grpc
+
+        from optuna_tpu.storages._grpc import _service as wire
+        from optuna_tpu.storages._grpc import fleet as fleet_mod
+        from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+        from optuna_tpu.storages._grpc.server import make_grpc_server
+        from optuna_tpu.testing.storages import _find_free_port
+
+        self._wire = wire
+        self._fleet_mod = fleet_mod
+        self.storage = storage
+        self.router = fleet_mod.FleetRouter(hub_names, replicas=replicas)
+        self.hubs: dict[str, Any] = {}
+        self.mounted: dict[str, BaseStorage] = {}
+        self._rpc: dict[str, Callable[..., Any]] = {}
+        self._killed: set[str] = set()
+        self._drops: dict[tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+        self._servers: list[Any] = []
+        self._channels: dict[str, Any] = {}
+        self._proxies: list[Any] = []
+        self.ports: dict[str, int] = {}
+        if lease_ttl_s is None:
+            lease_ttl_s = fleet_mod.DEFAULT_LEASE_TTL_S
+        for name in hub_names:
+            service = service_factory(name)
+            hub = fleet_mod.FleetHub(
+                name,
+                service,
+                self.router,
+                storage,
+                liveness_ttl_s=liveness_ttl_s,
+                lease_ttl_s=lease_ttl_s,
+                lease_check_ttl_s=lease_check_ttl_s,
+            )
+            port = _find_free_port()
+            # make_grpc_server mounts the hub's tell observer over the raw
+            # storage itself — passing a pre-wrapped mount would observe
+            # every tell twice.
+            server = make_grpc_server(storage, host, port, suggest_service=hub)
+            server.start()
+            channel = grpc.insecure_channel(f"{host}:{port}")
+            proxy = GrpcStorageProxy(host=host, port=port)
+
+            def rpc(method, *args, _ch=channel, _name=name, **kwargs):
+                self._check_alive(_name)
+                raw = _ch.unary_unary(f"/{wire.SERVICE_NAME}/{method}")(
+                    wire.encode_request(method, args, kwargs), timeout=120.0
+                )
+                self._maybe_drop(_name, method)
+                ok, payload = wire.decode_response(raw)
+                if not ok:
+                    raise payload
+                return payload
+
+            self.hubs[name] = hub
+            self.mounted[name] = proxy
+            self._rpc[name] = rpc
+            self._servers.append(server)
+            self._channels[name] = channel
+            self._proxies.append(proxy)
+            self.ports[name] = port
+        for name, hub in self.hubs.items():
+            for peer_name in hub_names:
+                if peer_name != name:
+                    hub.set_peer(peer_name, _FleetPeerStub(self, peer_name))
+
+    def channel(self, name: str) -> Any:
+        """The hub's client-side channel — the seam
+        ``testing.netchaos.NetChaos.intercept`` wraps for socket chaos."""
+        return self._channels[name]
+
+    def close(self) -> None:
+        super().close()
+        for proxy in self._proxies:
+            try:
+                proxy.remove_session()
+            except Exception:  # graphlint: ignore[PY001] -- teardown best-effort: one proxy's close must not strand the rest
+                pass
+        for channel in self._channels.values():
+            try:
+                channel.close()
+            except Exception:  # graphlint: ignore[PY001] -- teardown best-effort: one channel's close must not strand the rest
+                pass
+        for server in self._servers:
+            try:
+                server.stop(0)
+            except Exception:  # graphlint: ignore[PY001] -- teardown best-effort: one server's stop must not strand the rest
+                pass
 
 
 # ------------------------------------------------------------- pod-bus chaos

@@ -1,0 +1,5 @@
+# graphlint fixture: FLT002 negative — both copies agree with the registry.
+LEASE_CHAOS_MATRIX = {
+    "claim_grab": "partition the owner; the successor grabs the claim",
+    "claim_bump": "heal the partition; the primary bumps the epoch back",
+}

@@ -1,0 +1,5 @@
+# graphlint fixture: FLT002 negative — both copies agree with the registry.
+LEASE_EVENTS = {
+    "claim_grab": "what the transition means for the study's write fence",
+    "claim_bump": "what the transition means for the study's write fence",
+}

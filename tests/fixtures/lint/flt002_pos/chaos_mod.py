@@ -1,0 +1,2 @@
+# graphlint fixture: FLT002 — this copy DRIFTED: 'claim_bump' is missing.
+LEASE_CHAOS_MATRIX = {"claim_grab": "scenario"}  # EXPECT: FLT002

@@ -1,0 +1,6 @@
+# graphlint fixture: FLT002 — this copy DRIFTED: 'fence_phantom' is extra.
+LEASE_EVENTS = {  # EXPECT: FLT002
+    "claim_grab": "scenario",
+    "claim_bump": "scenario",
+    "fence_phantom": "scenario",
+}

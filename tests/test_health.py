@@ -988,3 +988,196 @@ def test_disabled_maybe_report_allocates_no_per_trial_objects():
     after = sys.getallocatedblocks()
     assert after - before < 500
     assert "_health_reporter" not in study.__dict__  # nothing was built
+
+
+# ---------------------------------------------------- lease fleet checks
+
+
+def _lease_fleet(history, *, workers=None, counters=None):
+    """A synthetic fleet snapshot carrying a lease record, for the
+    partition-era checks (flapping / zombie-fenced / partition-suspected)."""
+    fleet = _fleet(counters=counters, workers=workers)
+    last = history[-1] if history else {}
+    fleet["lease"] = {
+        "owner": last.get("owner"),
+        "epoch": int(last.get("epoch", 0)),
+        "ttl_s": 15.0,
+        "granted_unix": float(history[0]["unix"]) if history else 0.0,
+        "renewed_unix": float(last.get("unix", 0.0)),
+        "history": list(history),
+    }
+    return fleet
+
+
+def test_hub_flapping_fires_on_three_takeovers_inside_the_window():
+    history = [
+        {"owner": "hub-a", "epoch": 1, "unix": 1000.0},
+        {"owner": "hub-b", "epoch": 2, "unix": 1100.0},
+        {"owner": "hub-a", "epoch": 3, "unix": 1200.0},
+        {"owner": "hub-b", "epoch": 4, "unix": 1300.0},
+    ]
+    findings = health.diagnose(
+        _lease_fleet(history), [], MIN, checks=["service.hub_flapping"]
+    )
+    assert [f.check for f in findings] == ["service.hub_flapping"]
+    evidence = findings[0].evidence
+    assert evidence["takeovers_in_window"] == 3
+    assert evidence["hubs"] == ["hub-a", "hub-b"]
+    assert evidence["epoch"] == 4
+
+
+def test_hub_flapping_silent_below_threshold_and_override_tightens():
+    # Two takeovers: a failover plus a failback is normal operations.
+    calm = [
+        {"owner": "hub-a", "epoch": 1, "unix": 1000.0},
+        {"owner": "hub-b", "epoch": 2, "unix": 1100.0},
+        {"owner": "hub-a", "epoch": 3, "unix": 1200.0},
+    ]
+    assert (
+        health.diagnose(_lease_fleet(calm), [], MIN, checks=["service.hub_flapping"])
+        == []
+    )
+    tightened = health.diagnose(
+        _lease_fleet(calm),
+        [],
+        MIN,
+        checks=["service.hub_flapping"],
+        hub_flap_min_takeovers=2,
+    )
+    assert [f.check for f in tightened] == ["service.hub_flapping"]
+
+
+def test_hub_flapping_window_anchors_on_newest_takeover():
+    """An old resolved flap must age out identically everywhere: the window
+    anchors on the newest takeover, not wall-clock now, so three ancient
+    bounces followed by one recent clean failover stay silent."""
+    history = [
+        {"owner": "hub-a", "epoch": 1, "unix": 0.0},
+        {"owner": "hub-b", "epoch": 2, "unix": 10.0},
+        {"owner": "hub-a", "epoch": 3, "unix": 20.0},
+        {"owner": "hub-b", "epoch": 4, "unix": 30.0},
+        {"owner": "hub-a", "epoch": 5, "unix": 100_000.0},
+    ]
+    assert (
+        health.diagnose(
+            _lease_fleet(history), [], MIN, checks=["service.hub_flapping"]
+        )
+        == []
+    )
+
+
+def test_zombie_fenced_fires_on_any_rejected_stale_write():
+    fleet = _lease_fleet(
+        [
+            {"owner": "hub-a", "epoch": 1, "unix": 1000.0},
+            {"owner": "hub-b", "epoch": 2, "unix": 1100.0},
+        ],
+        counters={"fleet.fenced_write": 3, "fleet.lease.demote": 1},
+    )
+    findings = health.diagnose(
+        fleet, [], MIN, checks=["service.hub_zombie_fenced"]
+    )
+    assert [f.check for f in findings] == ["service.hub_zombie_fenced"]
+    assert findings[0].evidence == {
+        "fenced_writes": 3,
+        "demotions": 1,
+        "owner": "hub-b",
+        "epoch": 2,
+    }
+    quiet = _lease_fleet(
+        [{"owner": "hub-a", "epoch": 1, "unix": 1000.0}], counters={}
+    )
+    assert (
+        health.diagnose(quiet, [], MIN, checks=["service.hub_zombie_fenced"]) == []
+    )
+
+
+def test_partition_suspected_needs_a_live_deposed_hub():
+    history = [
+        {"owner": "hub-a", "epoch": 1, "unix": 1000.0},
+        {"owner": "hub-b", "epoch": 2, "unix": 1100.0},
+    ]
+    deposed_alive = [
+        {"worker": "hub-a-serve", "alive": True, "age_s": 0.4},
+        {"worker": "hub-b-serve", "alive": True, "age_s": 0.1},
+    ]
+    findings = health.diagnose(
+        _lease_fleet(history, workers=deposed_alive),
+        [],
+        MIN,
+        checks=["service.partition_suspected"],
+    )
+    assert [f.check for f in findings] == ["service.partition_suspected"]
+    assert findings[0].evidence["deposed"] == "hub-a"
+    assert findings[0].evidence["owner"] == "hub-b"
+    # A *stale* deposed snapshot is a crash — service.hub_dead's story.
+    deposed_stale = [{"worker": "hub-a-serve", "alive": False, "age_s": 120.0}]
+    assert (
+        health.diagnose(
+            _lease_fleet(history, workers=deposed_stale),
+            [],
+            MIN,
+            checks=["service.partition_suspected"],
+        )
+        == []
+    )
+    # A first acquire (epoch 1) displaced nobody.
+    first = [{"owner": "hub-a", "epoch": 1, "unix": 1000.0}]
+    assert (
+        health.diagnose(
+            _lease_fleet(first, workers=deposed_alive),
+            [],
+            MIN,
+            checks=["service.partition_suspected"],
+        )
+        == []
+    )
+
+
+def test_lease_checks_fire_through_the_report_surface():
+    """End to end: a synthesized lease record plus a fresh deposed-hub
+    snapshot in real storage surface both partition-era findings through
+    ``health_report`` — the same dict the CLI doctor and /health.json
+    serve."""
+    import time as _time
+
+    from optuna_tpu.storages._grpc.fleet import lease_attr_key
+
+    storage = InMemoryStorage()
+    study = optuna_tpu.create_study(storage=storage)
+    sid = study._study_id
+    now = _time.time()
+    storage.set_study_system_attr(
+        sid,
+        lease_attr_key(sid),
+        {
+            "owner": "hub-b",
+            "epoch": 4,
+            "ttl_s": 15.0,
+            "granted_unix": now - 300.0,
+            "renewed_unix": now,
+            "history": [
+                {"owner": "hub-a", "epoch": 1, "unix": now - 300.0},
+                {"owner": "hub-b", "epoch": 2, "unix": now - 200.0},
+                {"owner": "hub-a", "epoch": 3, "unix": now - 100.0},
+                {"owner": "hub-b", "epoch": 4, "unix": now - 50.0},
+            ],
+        },
+    )
+    storage.set_study_system_attr(
+        sid,
+        health.WORKER_ATTR_PREFIX + "hub-a" + health.HUB_WORKER_ID_SUFFIX,
+        {
+            "pid": 1,
+            "seq": 1,
+            "last_seen_unix": now,
+            "interval_s": 5.0,
+            "counters": {"fleet.fenced_write": 1},
+        },
+    )
+    report = health.health_report(storage, sid, now=now)
+    fired = {f["check"] for f in report["findings"]}
+    assert "service.hub_flapping" in fired
+    assert "service.partition_suspected" in fired
+    assert "service.hub_zombie_fenced" in fired
+    assert not report["healthy"]

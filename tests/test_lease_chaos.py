@@ -1,0 +1,518 @@
+"""Lease-fencing chaos acceptance (ISSUE 20 / LeaseChaosPlan / LEASE_CHAOS_MATRIX).
+
+Partition the study-owning hub of a two-hub fleet mid-burst: the ring
+successor re-homes and takes the lease over with a bumped epoch; tells
+pushed through the still-running zombie drive its checkpoint writes into
+the lease fence, every one rejected with a typed ``StaleLeaseError`` and
+counted on ``fleet.fenced_write`` exactly; the zombie self-demotes (once)
+and hands asks toward the owner — forwarded when reachable, else a
+redial-to-successor shed verdict a :class:`FleetClient` follows — and on
+heal the returning primary reclaims with a further epoch bump (failback).
+Zero double-applied tells, zero lost asks, the best value bit-identical to
+the fault-free twin, all under the armed lock sanitizer. Focused tests
+below cover the :class:`StudyLeases` clock algebra, the fence wrapper, the
+drain verdict shape, and the client's lease redial in isolation.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import checkpoint, flight, health, locksan, telemetry
+from optuna_tpu.distributions import FloatDistribution
+from optuna_tpu.exceptions import StaleLeaseError
+from optuna_tpu.samplers._base import BaseSampler
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.storages._base import BaseStorage
+from optuna_tpu.storages._grpc.fleet import (
+    LEASE_EVENTS,
+    FleetClient,
+    FleetRouter,
+    LeaseFencedStorage,
+    StudyLeases,
+    lease_attr_key,
+    read_lease,
+)
+from optuna_tpu.storages._grpc.suggest_service import (
+    RESOURCE_EXHAUSTED,
+    SuggestService,
+    ThinClientSampler,
+)
+from optuna_tpu.storages._retry import RetryPolicy
+from optuna_tpu.testing.fault_injection import (
+    LEASE_CHAOS_MATRIX,
+    FakeHubFleet,
+    lease_chaos_plan,
+)
+from optuna_tpu.testing.netchaos import NetChaos
+from optuna_tpu.trial._state import TrialState
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer():
+    """Every lease chaos scenario runs under the armed lock sanitizer —
+    the lease table, fence cache, and demotion ladder all take named locks
+    while ownership flips mid-burst, and ZERO verdicts is part of the
+    acceptance."""
+    locksan.enable()
+    yield
+    verdicts = locksan.report()["verdicts"]
+    locksan.disable()
+    locksan.reset()
+    assert verdicts == [], verdicts
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability(_lock_sanitizer):
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    saved_flight = flight.enabled()
+    health_was = health.enabled()
+    health.enable(interval_s=0.0)
+    yield
+    health.disable()
+    if health_was:
+        health.enable()
+    flight.disable()
+    if saved_flight:
+        flight.enable()
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+def _pure_param(name: str, number: int, low: float = -5.0, high: float = 5.0) -> float:
+    salt = sum(ord(c) for c in name)
+    frac = ((number * 37 + salt * 11) % 101) / 100.0
+    return low + (high - low) * frac
+
+
+class PureSampler(BaseSampler):
+    """Params are a pure function of the trial number: any hub (or the
+    local twin) proposes the identical point for trial N, so bit-identical
+    best values survive failover without sharing RNG state — the fence
+    machinery is what is under test, not the surrogate. Exports a (trivial)
+    fitted state so the hub checkpoint cadence actually writes ``ckpt:hub``
+    frames for the fence to reject."""
+
+    def __init__(self) -> None:
+        self._space = {
+            "x": FloatDistribution(-5.0, 5.0),
+            "y": FloatDistribution(-5.0, 5.0),
+        }
+
+    def reseed_rng(self) -> None:
+        pass
+
+    def infer_relative_search_space(self, study, trial):
+        return dict(self._space)
+
+    def sample_relative(self, study, trial, search_space):
+        return {name: _pure_param(name, trial.number) for name in search_space}
+
+    def sample_independent(self, study, trial, param_name, param_distribution):
+        return _pure_param(param_name, trial.number)
+
+    def export_fitted_state(self):
+        return {"pure": True}
+
+    def restore_fitted_state(self, state) -> bool:
+        return True
+
+
+def _objective(trial) -> float:
+    x = trial.suggest_float("x", -5.0, 5.0)
+    y = trial.suggest_float("y", -5.0, 5.0)
+    return (x - 1.0) ** 2 + (y + 2.0) ** 2
+
+
+def _service_factory(storage, **overrides):
+    def factory(name):
+        kwargs = dict(ready_ahead=0, coalesce_window_s=0.0, checkpoint_every=1)
+        kwargs.update(overrides)
+        return SuggestService(storage, PureSampler, **kwargs)
+
+    return factory
+
+
+def _fleet(storage, names, plan, **overrides) -> FakeHubFleet:
+    return FakeHubFleet(
+        storage,
+        names,
+        _service_factory(storage, **overrides),
+        lease_check_ttl_s=plan.lease_check_ttl_s,
+    )
+
+
+def _ckpt_attrs(storage, study_id: int) -> dict:
+    return {
+        key: value
+        for key, value in storage.get_study_system_attrs(study_id).items()
+        if key.startswith(checkpoint.CKPT_ATTR_PREFIX)
+    }
+
+
+def _zombie_ask(fleet: FakeHubFleet, name: str):
+    """An ask closure bound to the partitioned hub's in-process service —
+    the clients stranded on the zombie's side of the partition."""
+
+    def ask(study_id, trial_id, number, token):
+        return fleet.hubs[name].service_ask(study_id, trial_id, number, op_token=token)
+
+    return ask
+
+
+def test_lease_chaos_matrix_covers_every_event():
+    assert set(LEASE_CHAOS_MATRIX) == set(LEASE_EVENTS)
+
+
+def test_lease_partition_chaos_acceptance():
+    """The tentpole acceptance: partition the owner mid-burst, drive tells
+    through the zombie, heal, and assert the exact fence arithmetic —
+    every zombie serve-state write rejected and counted, one demotion, two
+    takeovers (re-home + failback), zero double-applied tells, zero lost
+    asks, best value bit-identical to the fault-free twin."""
+    plan = lease_chaos_plan()
+    storage = InMemoryStorage()
+    names = [f"hub-{i}" for i in range(plan.n_hubs)]
+    fleet = _fleet(storage, names, plan)
+    chaos = NetChaos()
+    chaos.attach_fleet(fleet)
+    try:
+        optuna_tpu.create_study(storage=storage, study_name="lease", direction="minimize")
+        sid = storage.get_study_id_from_name("lease")
+        victim = fleet.router.hub_for(sid)
+        successor = next(n for n in names if n != victim)
+        # The burst study rides the RAW shared storage: its tells are
+        # client writes (never fenced), and no hub's tell observer fires
+        # for them — so fleet.fenced_write counts ONLY the zombie's.
+        study = optuna_tpu.load_study(
+            study_name="lease", storage=storage, sampler=fleet.thin_client()
+        )
+
+        def run_trials(count):
+            for _ in range(count):
+                trial = study.ask()
+                study.tell(trial, _objective(trial))
+
+        # ---- phase 1: the owner serves and claims the lease at epoch 1.
+        run_trials(plan.partition_after_trials)
+        lease = read_lease(storage, sid)
+        assert lease is not None and lease["owner"] == victim and lease["epoch"] == 1
+
+        # ---- phase 2: the partition strikes mid-burst. kill() severs the
+        # hub's RPCs and stales its -serve snapshots (the health heartbeats
+        # stop crossing the partition); the symmetric netchaos partition is
+        # the same fault at the transport layer, so redials observe it too.
+        fleet.kill(victim)
+        chaos.partition(victim, "symmetric")
+
+        # ---- phase 3: the ring successor re-homes and takes over (epoch 2).
+        successor_trials = (
+            plan.n_trials - plan.partition_after_trials - plan.zombie_tells - 3
+        )
+        run_trials(successor_trials)
+        lease = read_lease(storage, sid)
+        assert lease["owner"] == successor and lease["epoch"] == 2
+
+        # ---- phase 4: the zombie returns. Its clients' asks are forwarded
+        # (or drained) to the owner — never aborted, never answered from a
+        # claim the fence would reject — while its tells drive checkpoint
+        # writes into the fence, every one rejected. The zombie's health
+        # heartbeat after each tell is re-staled: heartbeats no more cross
+        # the partition than asks do (in-process, the shared storage would
+        # otherwise deliver them).
+        ckpt_before = _ckpt_attrs(storage, sid)
+        zombie_study = optuna_tpu.load_study(
+            study_name="lease",
+            storage=fleet.mounted[victim],
+            sampler=ThinClientSampler(_zombie_ask(fleet, victim)),
+        )
+        for _ in range(plan.zombie_tells):
+            trial = zombie_study.ask()
+            zombie_study.tell(trial, _objective(trial))
+            fleet.kill(victim)
+        assert _ckpt_attrs(storage, sid) == ckpt_before  # nothing landed
+        lease = read_lease(storage, sid)
+        assert lease["owner"] == successor and lease["epoch"] == 2
+
+        # ---- phase 5: heal; the returning primary reclaims (epoch 3).
+        chaos.heal(victim)
+        fleet.heal(victim)
+        run_trials(3)
+
+        # ---- zero lost asks, zero double-applied tells, pure params: every
+        # trial completed exactly once with the point trial N was always
+        # going to get, no matter which side of the partition asked.
+        trials = study.trials
+        assert len(trials) == plan.n_trials
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+        assert sorted(t.number for t in trials) == list(range(plan.n_trials))
+        for t in trials:
+            assert t.params["x"] == _pure_param("x", t.number)
+            assert t.params["y"] == _pure_param("y", t.number)
+
+        # ---- the exact fence arithmetic, on the one vocabulary.
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("fleet.fenced_write", 0) == plan.zombie_tells
+        assert counters.get("fleet.lease.demote", 0) == 1
+        assert counters.get("fleet.lease.takeover", 0) == 2
+        assert counters.get("fleet.lease.acquire", 0) == 1
+        assert counters.get("serve.fleet.ask_replayed", 0) == 0
+        assert counters.get("serve.fleet.hub_rehome", 0) >= 1
+        assert chaos.injected.get("partition_drop", 0) >= 1
+
+        # ---- the lease record tells the whole story: 1 -> 2 -> 3.
+        lease = read_lease(storage, sid)
+        assert lease["owner"] == victim and lease["epoch"] == 3
+        assert [h["epoch"] for h in lease["history"]] == [1, 2, 3]
+        assert [h["owner"] for h in lease["history"]] == [victim, successor, victim]
+
+        # ---- bit-identical to the fault-free twin.
+        twin_storage = InMemoryStorage()
+        optuna_tpu.create_study(
+            storage=twin_storage, study_name="twin", direction="minimize"
+        )
+        twin = optuna_tpu.load_study(
+            study_name="twin", storage=twin_storage, sampler=PureSampler()
+        )
+        for _ in range(plan.n_trials):
+            trial = twin.ask()
+            twin.tell(trial, _objective(trial))
+        assert study.best_value == twin.best_value
+        assert study.best_params == twin.best_params
+
+        # ---- the doctor saw the zombie (and no false flapping page).
+        report = study.health_report()
+        findings = {f["check"]: f for f in report["findings"]}
+        assert "service.hub_zombie_fenced" in findings
+        assert findings["service.hub_zombie_fenced"]["evidence"]["fenced_writes"] > 0
+        assert "service.hub_flapping" not in findings
+    finally:
+        fleet.close()
+
+
+def test_demoted_hub_drains_with_redial_verdict_when_owner_unreachable():
+    """The demotion ladder's last rung: a fence-tripped hub whose lease
+    owner cannot be reached (netchaos symmetric partition on the peer
+    link) answers with the redial-to-successor shed verdict — a typed
+    hand-off, never an abort and never a locally minted proposal."""
+    plan = lease_chaos_plan()
+    storage = InMemoryStorage()
+    names = ["hub-0", "hub-1"]
+    fleet = _fleet(storage, names, plan)
+    chaos = NetChaos()
+    chaos.attach_fleet(fleet)
+    try:
+        optuna_tpu.create_study(storage=storage, study_name="drain", direction="minimize")
+        sid = storage.get_study_id_from_name("drain")
+        victim = fleet.router.hub_for(sid)
+        successor = next(n for n in names if n != victim)
+        study = optuna_tpu.load_study(
+            study_name="drain", storage=storage, sampler=fleet.thin_client()
+        )
+        trial = study.ask()
+        study.tell(trial, _objective(trial))  # victim acquires epoch 1
+        fleet.kill(victim)
+        trial = study.ask()
+        study.tell(trial, _objective(trial))  # successor takes over (epoch 2)
+
+        # One tell through the zombie trips the fence and demotes it.
+        zombie_study = optuna_tpu.load_study(
+            study_name="drain",
+            storage=fleet.mounted[victim],
+            sampler=ThinClientSampler(_zombie_ask(fleet, victim)),
+        )
+        trial = zombie_study.ask()
+        zombie_study.tell(trial, _objective(trial))
+        fleet.kill(victim)  # the tell's heartbeat does not cross the partition
+        assert telemetry.snapshot()["counters"].get("fleet.lease.demote", 0) == 1
+
+        # Now the owner is unreachable from the zombie too: parked asks
+        # drain with the redial verdict instead of a forward.
+        chaos.partition(successor, "symmetric")
+        trial_id = storage.create_new_trial(sid)
+        number = storage.get_trial(trial_id).number
+        verdict = fleet.hubs[victim].service_ask(sid, trial_id, number, op_token="tok-d")
+        assert verdict["shed"] == "reject"
+        assert verdict["status"] == RESOURCE_EXHAUSTED
+        assert verdict["source"] == "lease"
+        assert verdict["redial_to"] == successor
+        assert verdict["retry_after_s"] > 0
+        assert verdict["params"] == {}
+        assert chaos.injected.get("partition_drop", 0) >= 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_client_redials_lease_verdict_to_owner():
+    """A drain verdict is a routing instruction, not a failure: the client
+    redials the named owner with the SAME op token (marked fleet_redial so
+    the owner checks the shared replay record first) and the study never
+    sees the shed."""
+    router = FleetRouter(["a", "b"])
+    sid = next(s for s in range(64) if router.successors(s)[0] == "a")
+    verdict = {
+        "params": {},
+        "dists": {},
+        "fallback": None,
+        "shed": "reject",
+        "status": RESOURCE_EXHAUSTED,
+        "retry_after_s": 0.0,
+        "redial_to": "b",
+        "source": "lease",
+    }
+    answer = {"params": {"x": 1.5}, "dists": {}, "fallback": None, "shed": None}
+    calls: list[tuple[str, str, bool]] = []
+
+    def make(name, resp):
+        def ask(study_id, trial_id, number, token, redial):
+            calls.append((name, token, redial))
+            return dict(resp)
+
+        return ask
+
+    client = FleetClient(
+        router,
+        {"a": make("a", verdict), "b": make("b", answer)},
+        retry_policy=RetryPolicy(max_attempts=5, sleep=lambda _s: None),
+    )
+    resp = client.ask(sid, 1, 0, "tok-r")
+    assert resp == answer
+    assert calls == [("a", "tok-r", False), ("b", "tok-r", True)]
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _leases(storage, owner, clock, **kwargs):
+    kwargs.setdefault("ttl_s", 10.0)
+    kwargs.setdefault("check_ttl_s", 0.0)
+    return StudyLeases(storage, owner, clock=clock, now=clock, **kwargs)
+
+
+def _study_id(storage) -> int:
+    optuna_tpu.create_study(storage=storage, study_name="leases", direction="minimize")
+    return storage.get_study_id_from_name("leases")
+
+
+def test_lease_acquire_and_adaptive_renewal_cadence():
+    storage = InMemoryStorage()
+    sid = _study_id(storage)
+    clock = _FakeClock()
+    a = _leases(storage, "a", clock)
+    assert a.acquire(sid) == 1
+    record = read_lease(storage, sid)
+    assert record["owner"] == "a" and record["epoch"] == 1
+    assert len(record["history"]) == 1
+    # Before the cadence (ttl/2) a tick is two dict reads: no storage write.
+    assert a.tick(sid) == 1
+    assert telemetry.snapshot()["counters"].get("fleet.lease.renew", 0) == 0
+    clock.t += 6.0  # past ttl/2 = 5s: the renewal is due
+    assert a.tick(sid) == 1
+    assert telemetry.snapshot()["counters"].get("fleet.lease.renew", 0) == 1
+    record = read_lease(storage, sid)
+    assert record["renewed_unix"] == clock.t
+    assert len(record["history"]) == 1  # a renewal is not a transition
+
+
+def test_lease_takeover_bumps_epoch_and_fences_the_loser():
+    storage = InMemoryStorage()
+    sid = _study_id(storage)
+    clock = _FakeClock()
+    a = _leases(storage, "a", clock)
+    b = _leases(storage, "b", clock)
+    assert a.acquire(sid) == 1
+    assert b.acquire(sid) == 0  # a's lease is fresh: no silent steal
+    assert b.acquire(sid, takeover=True) == 2
+    with pytest.raises(StaleLeaseError) as err:
+        a.check_fence(sid)
+    assert err.value.held_epoch == 1
+    assert err.value.fence_epoch == 2
+    assert err.value.owner == "b"
+    # The stale renewal path surfaces the same typed error.
+    clock.t += 6.0
+    with pytest.raises(StaleLeaseError):
+        a.tick(sid)
+
+
+def test_lease_expiry_and_release_allow_uncontested_takeover():
+    storage = InMemoryStorage()
+    sid = _study_id(storage)
+    clock = _FakeClock()
+    a = _leases(storage, "a", clock, grace_factor=2.0)
+    b = _leases(storage, "b", clock, grace_factor=2.0)
+    assert a.acquire(sid) == 1
+    clock.t += 21.0  # past grace_factor x ttl: expired, no takeover needed
+    assert b.acquire(sid) == 2
+    # Clean release: instantly expired, the next owner walks straight in.
+    b.release(sid)
+    record = read_lease(storage, sid)
+    assert record["released"] is True and record["renewed_unix"] == 0.0
+    assert a.acquire(sid) == 3
+
+
+def test_lease_fenced_storage_rejects_stale_serve_state_writes():
+    storage = InMemoryStorage()
+    sid = _study_id(storage)
+    clock = _FakeClock()
+    a = _leases(storage, "a", clock)
+    b = _leases(storage, "b", clock)
+    a.acquire(sid)
+    b.acquire(sid, takeover=True)
+    fenced_events: list[tuple[int, StaleLeaseError]] = []
+    fenced = LeaseFencedStorage(
+        storage, a, on_fenced=lambda s, e: fenced_events.append((s, e))
+    )
+    # The wrapper is a real BaseStorage: Study construction over it must
+    # keep working (get_storage() type-checks its argument).
+    assert isinstance(fenced, BaseStorage)
+    with pytest.raises(StaleLeaseError):
+        fenced.set_study_system_attr(sid, "serve:fleet:tok:0", {"x": 1})
+    with pytest.raises(StaleLeaseError):
+        fenced.set_study_system_attr(
+            sid, checkpoint.CKPT_ATTR_PREFIX + "hub:0", {"x": 1}
+        )
+    counters = telemetry.snapshot()["counters"]
+    assert counters.get("fleet.fenced_write", 0) == 2
+    assert len(fenced_events) == 2
+    assert fenced_events[0][0] == sid
+    attrs = storage.get_study_system_attrs(sid)
+    assert "serve:fleet:tok:0" not in attrs  # nothing reached the backend
+    # Everything else flows: client-attr writes and the lease record itself.
+    fenced.set_study_system_attr(sid, "not:serve:state", 7)
+    assert storage.get_study_system_attrs(sid)["not:serve:state"] == 7
+    assert fenced.fence_epoch(sid) == 1
+
+
+def test_solo_fleet_skips_leases_entirely():
+    """A fleet of one has no successor to fence against: zero lease attrs,
+    zero lease counters — the solo twin stays write-for-write identical to
+    a bare single hub."""
+    plan = lease_chaos_plan()
+    storage = InMemoryStorage()
+    fleet = _fleet(storage, ["solo"], plan)
+    try:
+        optuna_tpu.create_study(storage=storage, study_name="solo", direction="minimize")
+        sid = storage.get_study_id_from_name("solo")
+        study = optuna_tpu.load_study(
+            study_name="solo", storage=storage, sampler=fleet.thin_client()
+        )
+        for _ in range(3):
+            trial = study.ask()
+            study.tell(trial, _objective(trial))
+        assert read_lease(storage, sid) is None
+        assert lease_attr_key(sid) not in storage.get_study_system_attrs(sid)
+        counters = telemetry.snapshot()["counters"]
+        assert not any(name.startswith("fleet.lease") for name in counters)
+        assert counters.get("fleet.fenced_write", 0) == 0
+    finally:
+        fleet.close()

@@ -349,6 +349,75 @@ def test_flt001_fixture_in_sync_is_silent():
     assert not result.findings, [f.format() for f in result.findings]
 
 
+def test_flt002_registry_matches_runtime_sets():
+    """The canonical lease-event registry equals the *runtime* values of
+    both hand-written copies (the lint compares them statically) — and
+    every transition has its counter home in the telemetry vocabulary:
+    the fleet.lease.<event> suffixed family, plus the exact
+    fleet.fenced_write (the rejection is loud by design)."""
+    from optuna_tpu import telemetry
+    from optuna_tpu.storages._grpc import fleet
+    from optuna_tpu.testing.fault_injection import LEASE_CHAOS_MATRIX
+
+    canonical = set(lint_registry.LEASE_EVENT_REGISTRY)
+    assert set(fleet.LEASE_EVENTS) == canonical
+    assert set(LEASE_CHAOS_MATRIX) == canonical
+    assert "fleet.lease" in telemetry.COUNTERS
+    assert "fleet.fenced_write" in telemetry.COUNTERS
+
+
+def test_flt002_gate_rejects_drift():
+    """Point FLT002 at the real files with a registry containing a lease
+    transition the code does not know: both copies must be reported as
+    drifted — adding a lease/fence transition without a gray-failure
+    scenario that forces it is a lint failure (the STO001/.../FLT001
+    discipline): an unexercised fence admits its first double-applied
+    zombie write during exactly the partition it was built for."""
+    fat_registry = dict(lint_registry.LEASE_EVENT_REGISTRY)
+    fat_registry["fence_phantom_event"] = "made-up event to prove the gate is live"
+    config = Config(flt002_registry=fat_registry, base_dir=REPO_ROOT)
+    result = run_lint(
+        [os.path.join(REPO_ROOT, suffix) for suffix, _, _ in config.flt002_targets],
+        config,
+    )
+    drifted = [f for f in result.findings if f.rule == "FLT002"]
+    assert len(drifted) == 2, [f.format() for f in result.findings]
+    assert all("fence_phantom_event" in f.message for f in drifted)
+
+
+_FLT002_FIXTURE_REGISTRY = {
+    "claim_grab": "a hub grabbed the study's claim",
+    "claim_bump": "the claim's epoch went up",
+}
+
+
+def _flt002_config(tree: str) -> Config:
+    return Config(
+        base_dir=REPO_ROOT,
+        flt002_registry=_FLT002_FIXTURE_REGISTRY,
+        flt002_targets=(
+            (f"fixtures/lint/{tree}/fleet_mod.py", "LEASE_EVENTS", "event vocabulary"),
+            (f"fixtures/lint/{tree}/chaos_mod.py", "LEASE_CHAOS_MATRIX", "chaos"),
+        ),
+    )
+
+
+def test_flt002_fixture_drift_detected():
+    tree = os.path.join(FIXTURES, "flt002_pos")
+    result = run_lint([tree], _flt002_config("flt002_pos"))
+    members = [os.path.join(tree, n) for n in sorted(os.listdir(tree))]
+    assert found_triples(result) == expected_markers(*members)
+    by_file = {os.path.basename(f.path): f.message for f in result.findings}
+    assert "fence_phantom" in by_file["fleet_mod.py"]
+    assert "missing" in by_file["chaos_mod.py"]
+
+
+def test_flt002_fixture_in_sync_is_silent():
+    tree = os.path.join(FIXTURES, "flt002_neg")
+    result = run_lint([tree], _flt002_config("flt002_neg"))
+    assert not result.findings, [f.format() for f in result.findings]
+
+
 def test_ckpt001_registry_matches_runtime_sets():
     """The canonical checkpoint-event registry equals the *runtime* values
     of both hand-written copies (the lint compares them statically) — and

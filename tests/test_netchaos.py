@@ -1,0 +1,392 @@
+"""Deterministic network-chaos layer tests (ISSUE 20 / testing.netchaos).
+
+The unit half pins the engine's contract: scheduled faults strike the
+exact call indices promised, seeded probabilistic faults replay
+identically and respect ``max_faults``, partitions block on the correct
+side of ``execute`` (symmetric before, one-way after — the
+committed-but-unacked shape), and pause is a stall, not a failure. The
+integration half drives BOTH serve transports through the same plans: a
+handler-direct :class:`FakeHubFleet` (drop → redial, duplicate → op-token
+dedupe) and a real loopback gRPC channel via
+:meth:`NetChaos.wrap_proxy` (drop → UNAVAILABLE-classified retry, one-way
+partition → same-token replay), plus the op-token replay-cache eviction
+boundary: an entry evicted younger than the client retry window is
+counted loud, and a delayed duplicate of the evicted op demonstrably
+re-executes — the double-apply the counter exists to page on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import optuna_tpu
+from optuna_tpu import flight, health, locksan, telemetry
+from optuna_tpu.samplers._random import RandomSampler
+from optuna_tpu.storages import InMemoryStorage
+from optuna_tpu.storages._grpc._service import OP_TOKEN_KEY
+from optuna_tpu.storages._grpc.fleet import HubUnavailableError
+from optuna_tpu.storages._grpc.suggest_service import SuggestService
+from optuna_tpu.storages._retry import RetryPolicy
+from optuna_tpu.testing.fault_injection import FakeHubFleet
+from optuna_tpu.testing.netchaos import ANY_METHOD, NetChaos, NetChaosPlan
+from optuna_tpu.trial._state import TrialState
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer():
+    locksan.enable()
+    yield
+    verdicts = locksan.report()["verdicts"]
+    locksan.disable()
+    locksan.reset()
+    assert verdicts == [], verdicts
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observability(_lock_sanitizer):
+    saved_registry = telemetry.get_registry()
+    saved_enabled = telemetry.enabled()
+    telemetry.enable(telemetry.MetricsRegistry())
+    saved_flight = flight.enabled()
+    health_was = health.enabled()
+    health.enable(interval_s=0.0)
+    yield
+    health.disable()
+    if health_was:
+        health.enable()
+    flight.disable()
+    if saved_flight:
+        flight.enable()
+    telemetry.enable(saved_registry)
+    if not saved_enabled:
+        telemetry.disable()
+    optuna_tpu.logging.reset_warn_once()
+
+
+class _Unavailable(Exception):
+    pass
+
+
+# ------------------------------------------------------------ engine unit
+
+
+def test_scheduled_drop_strikes_exact_indices():
+    chaos = NetChaos(NetChaosPlan(drop={"m": [1, 3]}))
+    delivered: list[int] = []
+
+    def call(i: int):
+        return chaos.apply("p", "m", lambda: delivered.append(i) or i, _Unavailable)
+
+    results = []
+    for i in range(5):
+        try:
+            results.append(call(i))
+        except _Unavailable:
+            results.append("dropped")
+    assert results == [0, "dropped", 2, "dropped", 4]
+    assert delivered == [0, 2, 4]
+    assert chaos.injected == {"drop": 2}
+    # Schedules key per (link, method): a different method is untouched.
+    assert chaos.apply("p", "other", lambda: "ok", _Unavailable) == "ok"
+
+
+def test_any_method_schedule_applies_per_method_counter():
+    chaos = NetChaos(NetChaosPlan(drop={ANY_METHOD: [0]}))
+    for method in ("m", "n"):
+        with pytest.raises(_Unavailable):
+            chaos.apply("p", method, lambda: "ok", _Unavailable)
+        assert chaos.apply("p", method, lambda: "ok", _Unavailable) == "ok"
+    assert chaos.injected == {"drop": 2}
+
+
+def test_scheduled_duplicate_delivers_twice_and_returns_second():
+    chaos = NetChaos(NetChaosPlan(duplicate={"m": [0]}))
+    executions = []
+
+    def execute():
+        executions.append(len(executions))
+        return len(executions)
+
+    # The duplicate delivery rides the same bytes: the caller sees what the
+    # wire would hand a client that saw both — here the second execution.
+    assert chaos.apply("p", "m", execute, _Unavailable) == 2
+    assert chaos.apply("p", "m", execute, _Unavailable) == 3
+    assert chaos.injected == {"duplicate": 1}
+
+
+def test_scheduled_delay_and_lone_reorder_degrade_to_delivery():
+    chaos = NetChaos(
+        NetChaosPlan(delay={"m": [0]}, delay_s=0.001, reorder={"n": [0]},
+                     reorder_hold_s=0.01)
+    )
+    assert chaos.apply("p", "m", lambda: "late", _Unavailable) == "late"
+    # A lone in-flight request has nothing to swap with: the hold expires
+    # and the request delivers anyway.
+    assert chaos.apply("p", "n", lambda: "held", _Unavailable) == "held"
+    assert chaos.injected == {"delay": 1, "reorder": 1}
+
+
+def test_reorder_holds_until_the_links_next_request():
+    chaos = NetChaos(NetChaosPlan(reorder={"m": [0]}, reorder_hold_s=5.0))
+    second_arrived = threading.Event()
+    observed: list[bool] = []
+
+    def first():
+        chaos.apply(
+            "p", "m",
+            lambda: observed.append(second_arrived.is_set()),
+            _Unavailable,
+        )
+
+    t = threading.Thread(target=first)
+    t.start()
+    time.sleep(0.05)  # let the first request reach its hold
+    second_arrived.set()
+    chaos.apply("p", "m", lambda: "second", _Unavailable)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert observed == [True]  # the held request delivered after the swap
+    assert chaos.injected == {"reorder": 1}
+
+
+def test_symmetric_partition_blocks_before_execute_oneway_after():
+    chaos = NetChaos()
+    executed: list[str] = []
+    chaos.partition("p", "symmetric")
+    with pytest.raises(_Unavailable):
+        chaos.apply("p", "m", lambda: executed.append("sym"), _Unavailable)
+    assert executed == []  # the request never arrived
+    chaos.heal("p")
+    chaos.partition("p", "oneway")
+    with pytest.raises(_Unavailable):
+        chaos.apply("p", "m", lambda: executed.append("oneway"), _Unavailable)
+    assert executed == ["oneway"]  # committed server-side, response dropped
+    chaos.heal("p")
+    chaos.apply("p", "m", lambda: executed.append("healed"), _Unavailable)
+    assert executed == ["oneway", "healed"]
+    assert chaos.injected == {"partition_drop": 1, "partition_oneway": 1}
+
+
+def test_pause_is_a_stall_not_a_failure():
+    chaos = NetChaos()
+    chaos.pause("p")
+    results: list[str] = []
+
+    def call():
+        results.append(chaos.apply("p", "m", lambda: "ok", _Unavailable))
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive() and results == []  # parked, not errored
+    chaos.resume("p")
+    t.join(timeout=5.0)
+    assert results == ["ok"]
+    assert chaos.injected == {"pause": 1}
+
+
+def test_seeded_rates_replay_identically_and_respect_max_faults():
+    def run() -> tuple[list[bool], dict[str, int]]:
+        chaos = NetChaos(NetChaosPlan(seed=7, drop_rate=0.5, max_faults=3))
+        outcomes = []
+        for _ in range(24):
+            try:
+                chaos.apply("p", "m", lambda: True, _Unavailable)
+                outcomes.append(True)
+            except _Unavailable:
+                outcomes.append(False)
+        return outcomes, dict(chaos.injected)
+
+    first, first_injected = run()
+    second, second_injected = run()
+    assert first == second  # seeded per link: bit-identical replay
+    assert first_injected == second_injected
+    assert first_injected.get("drop", 0) == 3  # the budget caps the total
+    assert first.count(False) == 3
+
+
+def test_scheduled_faults_are_exempt_from_the_budget():
+    chaos = NetChaos(NetChaosPlan(drop={"m": [0, 1]}, max_faults=0))
+    for _ in range(2):
+        with pytest.raises(_Unavailable):
+            chaos.apply("p", "m", lambda: "ok", _Unavailable)
+    assert chaos.injected == {"drop": 2}  # a schedule is a promise
+
+
+# ------------------------------------------- handler-direct fleet transport
+
+
+def _service_factory(storage):
+    def factory(name):
+        return SuggestService(
+            storage,
+            lambda: RandomSampler(seed=5),
+            ready_ahead=0,
+            coalesce_window_s=0.0,
+        )
+
+    return factory
+
+
+def _run_trials(study, count):
+    for _ in range(count):
+        trial = study.ask()
+        study.tell(trial, trial.suggest_float("x", -5.0, 5.0) ** 2)
+
+
+def test_attach_fleet_drop_is_absorbed_by_redial():
+    storage = InMemoryStorage()
+    fleet = FakeHubFleet(storage, ["hub-0", "hub-1"], _service_factory(storage))
+    chaos = NetChaos(NetChaosPlan(drop={"service_ask": [0]}))
+    chaos.attach_fleet(fleet)
+    try:
+        optuna_tpu.create_study(storage=storage, study_name="drop", direction="minimize")
+        study = optuna_tpu.load_study(
+            study_name="drop", storage=storage, sampler=fleet.thin_client(seed=1)
+        )
+        _run_trials(study, 3)
+        trials = study.trials
+        assert len(trials) == 3
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+        # The drop schedule counts per link: the first ask on the owner link
+        # dropped, the redialed successor's first ask dropped too, and the
+        # walk continued — the client saw neither.
+        assert chaos.injected.get("drop", 0) >= 1
+    finally:
+        fleet.close()
+
+
+def test_attach_fleet_duplicate_collapses_through_op_token_dedupe():
+    storage = InMemoryStorage()
+    fleet = FakeHubFleet(storage, ["hub-0", "hub-1"], _service_factory(storage))
+    chaos = NetChaos(NetChaosPlan(duplicate={"service_ask": [0]}))
+    chaos.attach_fleet(fleet)
+    try:
+        optuna_tpu.create_study(storage=storage, study_name="dup", direction="minimize")
+        study = optuna_tpu.load_study(
+            study_name="dup", storage=storage, sampler=fleet.thin_client(seed=1)
+        )
+        _run_trials(study, 2)
+        trials = study.trials
+        assert len(trials) == 2
+        assert all(t.state == TrialState.COMPLETE for t in trials)
+        assert chaos.injected.get("duplicate", 0) == 1
+        # The duplicate delivery carried the same bytes and op token: the
+        # handler replayed the recorded response instead of re-executing.
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("grpc.op_token_dedup", 0) >= 1
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------- real loopback channel
+
+
+def _socket_server(storage):
+    from optuna_tpu.storages._grpc.server import make_grpc_server
+    from optuna_tpu.testing.storages import _find_free_port
+
+    port = _find_free_port()
+    server = make_grpc_server(storage, "localhost", port, thread_pool_size=4)
+    server.start()
+    return server, port
+
+
+def _proxy(port, **kwargs):
+    from optuna_tpu.storages._grpc.client import GrpcStorageProxy
+
+    kwargs.setdefault(
+        "retry_policy", RetryPolicy(max_attempts=3, sleep=lambda _s: None)
+    )
+    return GrpcStorageProxy(host="localhost", port=port, **kwargs)
+
+
+def test_wrap_proxy_drop_retries_over_real_channel():
+    pytest.importorskip("grpc")
+    storage = InMemoryStorage()
+    optuna_tpu.create_study(storage=storage, study_name="sock", direction="minimize")
+    sid = storage.get_study_id_from_name("sock")
+    server, port = _socket_server(storage)
+    chaos = NetChaos(NetChaosPlan(drop={"create_new_trial": [0]}))
+    proxy = chaos.wrap_proxy(_proxy(port))
+    try:
+        # The dropped request never reached the server; the proxy classified
+        # the UNAVAILABLE-coded error and retried with the same op token.
+        trial_id = proxy.create_new_trial(sid)
+        assert storage.get_trial(trial_id).number == 0
+        assert len(storage.get_all_trials(sid)) == 1
+        assert chaos.injected.get("drop", 0) == 1
+    finally:
+        proxy.remove_session()
+        server.stop(0)
+
+
+def test_oneway_partition_commits_and_same_token_replays_over_real_channel():
+    """Committed-but-unacked over a real socket: the one-way partition
+    drops only the response, the client's retry carries the SAME op token,
+    and the server replays the recorded response — exactly one trial."""
+    pytest.importorskip("grpc")
+    storage = InMemoryStorage()
+    optuna_tpu.create_study(storage=storage, study_name="oneway", direction="minimize")
+    sid = storage.get_study_id_from_name("oneway")
+    server, port = _socket_server(storage)
+    chaos = NetChaos()
+    proxy = chaos.wrap_proxy(_proxy(port, retry_policy=RetryPolicy(max_attempts=1)))
+    try:
+        chaos.partition("server", "oneway")
+        with pytest.raises(Exception):
+            proxy._call("create_new_trial", sid, **{OP_TOKEN_KEY: "tok-oneway"})
+        assert len(storage.get_all_trials(sid)) == 1  # the write committed
+        chaos.heal("server")
+        replayed_id = proxy._call(
+            "create_new_trial", sid, **{OP_TOKEN_KEY: "tok-oneway"}
+        )
+        assert len(storage.get_all_trials(sid)) == 1  # replayed, not re-run
+        assert storage.get_trial(replayed_id).number == 0
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("grpc.op_token_dedup", 0) == 1
+        assert chaos.injected.get("partition_oneway", 0) == 1
+    finally:
+        proxy.remove_session()
+        server.stop(0)
+
+
+def test_op_token_eviction_boundary_recreates_the_double_apply(monkeypatch):
+    """The replay-cache eviction boundary (ISSUE 20 satellite): with the
+    cache squeezed to one slot, a committed-but-unacked op's token is
+    evicted — younger than the client retry window, counted loud on
+    ``grpc.op_token_evicted_live`` — and the delayed retry of that op
+    silently re-executes: the double-apply the counter exists to page on
+    before anyone debugs it from journal forensics."""
+    pytest.importorskip("grpc")
+    from optuna_tpu.storages._grpc import server as server_mod
+
+    monkeypatch.setattr(server_mod, "_OP_TOKEN_CACHE_SIZE", 1)
+    storage = InMemoryStorage()
+    optuna_tpu.create_study(storage=storage, study_name="evict", direction="minimize")
+    sid = storage.get_study_id_from_name("evict")
+    server, port = _socket_server(storage)
+    chaos = NetChaos()
+    proxy = chaos.wrap_proxy(_proxy(port, retry_policy=RetryPolicy(max_attempts=1)))
+    try:
+        chaos.partition("server", "oneway")
+        with pytest.raises(Exception):
+            proxy._call("create_new_trial", sid, **{OP_TOKEN_KEY: "tok-evict"})
+        assert len(storage.get_all_trials(sid)) == 1  # committed, unacked
+        chaos.heal("server")
+        # An unrelated op squeezes the one-slot cache: tok-evict falls out
+        # while its client could still legally retry.
+        proxy.create_new_trial(sid)
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("grpc.op_token_evicted_live", 0) >= 1
+        # The delayed retry of the evicted op re-executes: a third trial.
+        proxy._call("create_new_trial", sid, **{OP_TOKEN_KEY: "tok-evict"})
+        assert len(storage.get_all_trials(sid)) == 3
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("grpc.op_token_dedup", 0) == 0
+    finally:
+        proxy.remove_session()
+        server.stop(0)
